@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Headline benchmark: one RunOnce scale-up simulation at reference-killing scale.
+
+Scenario (BASELINE.json config #5 shape): 50k pending pods × 5k candidate
+nodes × 20 node groups, with taints/tolerations, nodeSelectors, GPU extended
+resources and self-anti-affinity groups. The reference's own positioning for
+this problem: 1000-node clusters with a ≤60 s scale-up SLO and microbenchmarks
+that disclaim absolute numbers (BASELINE.md); our target is the sim in
+< 200 ms on one TPU chip.
+
+Measures: p50 on-device latency of ops.autoscale_step.scale_up_sim — the
+filter-out-schedulable pack + all 20 binpacking expansion options + expander
+scoring (reference hot loops A+B, SURVEY.md §3.1) — after compilation, over
+`--iters` runs. Host-side string→tensor encoding happens once per cluster
+*change* in production and is reported separately on stderr, not in the metric
+(the reference benchmark likewise builds its snapshot outside the timed loop,
+core/bench/benchmark_runonce_test.go:404-418).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": <p50 ms>, "unit": "ms", "vs_baseline": <200/value>}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_world(n_nodes: int, n_pods: int, n_groups: int, n_nodegroups: int):
+    from kubernetes_autoscaler_tpu.models.api import Taint, Toleration
+    from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS
+    from kubernetes_autoscaler_tpu.models.encode import (
+        encode_cluster,
+        encode_node_groups,
+    )
+    from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+    rng = np.random.RandomState(0)
+    zones = ["us-a", "us-b", "us-c"]
+    nodes = []
+    for i in range(n_nodes):
+        taints = [Taint("dedicated", "infra", "NoSchedule")] if i % 10 == 0 else []
+        nodes.append(
+            build_test_node(
+                f"node-{i}",
+                cpu_milli=16000,
+                mem_mib=65536,
+                pods=110,
+                labels={"pool": "a" if i % 2 else "b", "disk": "ssd" if i % 3 else "hdd"},
+                taints=taints,
+                zone=zones[i % 3],
+                gpus=8 if i % 25 == 0 else 0,
+            )
+        )
+
+    per_group = n_pods // n_groups
+    pods = []
+    for g in range(n_groups):
+        cpu = int(rng.choice([250, 500, 1000, 2000, 4000]))
+        mem = int(rng.choice([256, 512, 2048, 8192]))
+        sel = {"disk": "ssd"} if g % 4 == 0 else {}
+        tol = [Toleration(key="dedicated", operator="Equal", value="infra",
+                          effect="NoSchedule")] if g % 5 == 0 else []
+        gpus = 1 if g % 7 == 0 else 0
+        for i in range(per_group):
+            p = build_test_pod(
+                f"pod-{g}-{i}", cpu_milli=cpu, mem_mib=mem, owner_name=f"rs-{g}",
+                node_selector=sel, tolerations=tol, gpus=gpus,
+            )
+            pods.append(p)
+
+    t0 = time.perf_counter()
+    enc = encode_cluster(nodes, pods, node_bucket=256, group_bucket=64)
+    encode_s = time.perf_counter() - t0
+
+    # Pre-existing load: 40% of every node's cpu/mem already requested
+    # (reference scale-down benchmark shape, benchmark_runonce_test.go:424-453).
+    import jax.numpy as jnp
+
+    alloc = np.asarray(enc.nodes.cap) * 0
+    cap = np.asarray(enc.nodes.cap)
+    alloc[:, 0] = (cap[:, 0] * 0.4).astype(np.int32)
+    alloc[:, 1] = (cap[:, 1] * 0.4).astype(np.int32)
+    alloc[:, 3] = (cap[:, 3] * 0.3).astype(np.int32)
+    enc.nodes = enc.nodes.replace(alloc=jnp.asarray(alloc))
+
+    templates = []
+    for k in range(n_nodegroups):
+        cpu = [4000, 8000, 16000, 32000][k % 4]
+        mem = [16384, 32768, 65536, 131072][k % 4]
+        tmpl = build_test_node(
+            f"template-{k}", cpu_milli=cpu, mem_mib=mem, pods=110,
+            labels={"pool": "a" if k % 2 else "b", "disk": "ssd" if k % 3 else "hdd"},
+            zone=zones[k % 3], gpus=8 if k % 5 == 0 else 0,
+        )
+        templates.append((tmpl, 1000, float(1 + k)))
+    groups = encode_node_groups(templates, enc.registry, enc.zone_table)
+    return enc, groups, encode_s
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=5000)
+    ap.add_argument("--pods", type=int, default=50000)
+    ap.add_argument("--pod-groups", type=int, default=25)
+    ap.add_argument("--nodegroups", type=int, default=20)
+    ap.add_argument("--max-new-nodes", type=int, default=1024)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+
+    from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS
+    from kubernetes_autoscaler_tpu.ops.autoscale_step import scale_up_sim
+
+    enc, groups, encode_s = build_world(
+        args.nodes, args.pods, args.pod_groups, args.nodegroups
+    )
+
+    def run():
+        out = scale_up_sim(
+            enc.nodes, enc.specs, enc.scheduled, groups,
+            DEFAULT_DIMS, args.max_new_nodes, "least-waste",
+        )
+        jax.block_until_ready(out)
+        return out
+
+    t0 = time.perf_counter()
+    out = run()
+    compile_s = time.perf_counter() - t0
+
+    times = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        run()
+        times.append((time.perf_counter() - t0) * 1000.0)
+    p50 = float(np.percentile(times, 50))
+
+    checks = int(np.asarray(enc.specs.count).sum()) * args.nodes
+    print(
+        f"[bench] device={jax.devices()[0].platform} encode={encode_s:.2f}s "
+        f"compile={compile_s:.1f}s p50={p50:.2f}ms best_group={int(out.best)} "
+        f"scheduled={int(out.estimate.scheduled[int(out.best)].sum())} "
+        f"new_nodes={int(out.estimate.node_count[int(out.best)])} "
+        f"fit_checks/s={checks / (p50 / 1e3):.3e}",
+        file=sys.stderr,
+    )
+    kp = args.pods // 1000
+    kn = args.nodes // 1000 if args.nodes >= 1000 else args.nodes
+    unit_n = "knodes" if args.nodes >= 1000 else "nodes"
+    print(json.dumps({
+        "metric": f"scaleup_sim_p50_ms_{kp}kpods_{kn}{unit_n}_{args.nodegroups}ng",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(200.0 / p50, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""TPU-native cluster-autoscaling simulation framework.
+
+A from-scratch re-design of the capabilities of openshift/kubernetes-autoscaler
+(reference layout surveyed in /root/repo/SURVEY.md) around one idea: the Cluster
+Autoscaler's scale-up/scale-down *simulation* — scheduler-predicate checking
+(reference: cluster-autoscaler/simulator/clustersnapshot/predicate/plugin_runner.go:54),
+binpacking node estimation (reference: cluster-autoscaler/estimator/binpacking_estimator.go:102)
+and drain/reschedulability analysis (reference: cluster-autoscaler/simulator/cluster.go:131) —
+is evaluated as a vectorized pods×nodes×nodegroups tensor program on TPU via JAX/XLA/pjit,
+instead of serial Go loops.
+
+Package layout (maps SURVEY.md §1 layers):
+  models/        L2 state model: host object model + tensorized ClusterState + functional snapshot
+  ops/           L3 kernels: predicate masks, FFD binpack scan, drain masks, expander scoring
+  parallel/      device-mesh sharding of the pods/nodes axes (ICI), multi-host (DCN)
+  simulator/     L3 simulation API mirroring the reference ClusterSnapshot verbs + drainability
+  estimator/     L4 scale-up sizing (reference: estimator/)
+  expander/      L4 node-group choice strategies (reference: expander/)
+  processors/    L4 policy hook points (reference: processors/processors.go:38-79)
+  core/          L5 orchestration: StaticAutoscaler.RunOnce, scaleup/, scaledown/
+  clusterstate/  node-group health model (reference: clusterstate/clusterstate.go:122)
+  cloudprovider/ L1 SPI + test provider (reference: cloudprovider/cloud_provider.go:117)
+  vpa/           Vertical Pod Autoscaler (reference: vertical-pod-autoscaler/)
+  balancer/      Balancer controller (reference: balancer/)
+  nanny/         Addon Resizer (reference: addon-resizer/)
+  sidecar/       native (C++) snapshot-delta codec + gRPC boundary for external control planes
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,123 @@
+"""Host-side object model: the minimal k8s-shaped surface the framework consumes.
+
+The reference consumes full k8s API objects via client-go informers
+(cluster-autoscaler/utils/kubernetes/). This framework is standalone, so it
+defines a lightweight structural equivalent carrying exactly the fields the
+simulation semantics read (the vendored-scheduler plugin inputs distilled in
+SURVEY.md §7): resources, labels, selectors, taints/tolerations, affinity,
+ports, topology keys, ownership/priority/annotations for drain classification.
+
+These objects are the *boundary* format; they are encoded once per loop into
+dense tensors (models/encode.py) and never consulted inside jitted code.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Taint effects (reference: k8s core/v1; consumed by TaintToleration filter).
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
+
+# Well-known annotations the reference acts on
+# (cluster-autoscaler/utils/drain/drain.go, simulator/drainability/rules/).
+SAFE_TO_EVICT_KEY = "cluster-autoscaler.kubernetes.io/safe-to-evict"
+SCALE_DOWN_DISABLED_KEY = "cluster-autoscaler.kubernetes.io/scale-down-disabled"
+# Taints CA itself places (reference: utils/taints/taints.go).
+TO_BE_DELETED_TAINT = "ToBeDeletedByClusterAutoscaler"
+DELETION_CANDIDATE_TAINT = "DeletionCandidateOfClusterAutoscaler"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = NO_SCHEDULE
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""                 # "" + Exists tolerates everything
+    operator: str = "Equal"       # Equal | Exists
+    value: str = ""
+    effect: str = ""              # "" matches all effects
+
+
+@dataclass(frozen=True)
+class OwnerRef:
+    kind: str = ""                # ReplicaSet | Job | DaemonSet | StatefulSet | Node(mirror) | ...
+    name: str = ""
+    uid: str = ""
+    controller: bool = True
+
+
+@dataclass
+class AffinityTerm:
+    """One required pod-(anti-)affinity term: selector over pod labels within a
+    topology domain (reference: vendored InterPodAffinity filter semantics)."""
+
+    match_labels: dict[str, str] = field(default_factory=dict)
+    topology_key: str = "kubernetes.io/hostname"
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str
+    operator: str = "In"          # In | NotIn | Exists | DoesNotExist
+    values: tuple[str, ...] = ()
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    # Sum of container requests, pre-aggregated (reference aggregates via
+    # resourcehelpers; init-container max() rule applied by the caller/builder).
+    requests: dict[str, float] = field(default_factory=dict)  # name -> amount (cpu in cores, memory in bytes)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    required_node_affinity: list[NodeSelectorRequirement] = field(default_factory=list)
+    tolerations: list[Toleration] = field(default_factory=list)
+    host_ports: tuple[tuple[int, str], ...] = ()              # (port, protocol)
+    anti_affinity: list[AffinityTerm] = field(default_factory=list)
+    pod_affinity: list[AffinityTerm] = field(default_factory=list)
+    topology_spread_max_skew: int = 0                         # 0 = no constraint
+    topology_spread_key: str = ""
+    owner: Optional[OwnerRef] = None
+    priority: int = 0
+    node_name: str = ""                                       # scheduled destination ("" = pending)
+    phase: str = "Pending"                                    # Pending|Running|Succeeded|Failed
+    deletion_timestamp: Optional[float] = None
+    restart_policy: str = "Always"
+    volumes_with_local_storage: int = 0                       # emptyDir/hostPath count (drain rule)
+    pvc_refs: tuple[str, ...] = ()
+
+    def is_daemonset(self) -> bool:
+        return self.owner is not None and self.owner.kind == "DaemonSet"
+
+    def is_mirror(self) -> bool:
+        return "kubernetes.io/config.mirror" in self.annotations
+
+
+@dataclass
+class Node:
+    name: str
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    capacity: dict[str, float] = field(default_factory=dict)
+    allocatable: dict[str, float] = field(default_factory=dict)
+    taints: list[Taint] = field(default_factory=list)
+    ready: bool = True
+    unschedulable: bool = False
+    creation_time: float = 0.0
+    provider_id: str = ""
+
+    def zone(self) -> str:
+        return self.labels.get("topology.kubernetes.io/zone", self.labels.get("failure-domain.beta.kubernetes.io/zone", ""))
+
+    def alloc_or_cap(self) -> dict[str, float]:
+        return self.allocatable if self.allocatable else self.capacity

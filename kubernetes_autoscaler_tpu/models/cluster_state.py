@@ -1,0 +1,180 @@
+"""Tensorized cluster state: the L2 state model as JAX pytrees.
+
+Reference counterpart: cluster-autoscaler/simulator/framework/infos.go:57
+(framework.NodeInfo/PodInfo wrapping the vendored scheduler's NodeInfo) plus the
+DeltaSnapshotStore (simulator/clustersnapshot/store/delta.go:55). The reference
+needs a layered-delta store because forking a pointer-graph snapshot is
+expensive; here the whole cluster is a handful of dense arrays, so a "fork" is
+just holding a reference to an immutable pytree and "commit" is a pointer swap
+(see simulator/snapshot.py) — the delta machinery disappears by construction.
+
+String-world constraints are lowered to int32 hash slots (utils/hashing.fold32),
+padded with 0 (0 is reserved: never a valid hash). All per-pair predicate
+checks in ops/predicates.py are exact over these tensors; anything the dense
+encoding cannot express (rare: overflowing label counts, exotic affinity
+operators) sets `needs_host_check` and is verified on the host for selected
+winners only.
+
+Pending pods are stored as *equivalence groups* (reference:
+core/scaleup/equivalence/groups.go:40 — controller UID + spec hash) so the G
+axis stays small even at 50k pending pods.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from kubernetes_autoscaler_tpu.models.resources import NUM_RESOURCES
+
+
+@dataclass(frozen=True)
+class Dims:
+    """Static padding dims (compile-time shape bucket)."""
+
+    max_labels: int = 64       # label-hash slots per node (2 per label: pair + key)
+    max_taints: int = 6        # taint slots per node
+    max_tolerations: int = 8   # toleration slots per pod group
+    max_sel_terms: int = 6     # ANDed selector requirements per pod group
+    max_sel_alts: int = 4      # OR alternatives inside one requirement (In v1..vk)
+    max_neg_terms: int = 4     # NotIn/DoesNotExist hashes per pod group
+    max_pod_ports: int = 4     # hostPorts per pod group
+    max_node_ports: int = 16   # occupied hostPort slots per node
+    max_aff_terms: int = 2     # (anti-)affinity terms per pod group
+
+
+DEFAULT_DIMS = Dims()
+
+
+class NodeTensors(struct.PyTreeNode):
+    """Dense per-node state, shape leading dim N (padded; `valid` masks real rows)."""
+
+    cap: jax.Array           # i32[N, R] allocatable
+    alloc: jax.Array         # i32[N, R] requested by resident pods
+    label_hash: jax.Array    # i32[N, L] fold32("k=v") and fold32(key-marker) per label
+    taint_exact: jax.Array   # i32[N, T] fold32(key\0value\0effect) for NoSchedule/NoExecute
+    taint_key: jax.Array     # i32[N, T] fold32(key\0effect) (Exists-operator coverage)
+    used_ports: jax.Array    # i32[N, NP] fold32("port/proto") occupied by resident pods
+    zone_id: jax.Array       # i32[N] topology zone index (0 = unknown)
+    group_id: jax.Array      # i32[N] node-group index (-1 = none)
+    ready: jax.Array         # bool[N]
+    schedulable: jax.Array   # bool[N] (= !node.spec.unschedulable && no ToBeDeleted taint)
+    valid: jax.Array         # bool[N]
+
+    @property
+    def n(self) -> int:
+        return self.cap.shape[0]
+
+    def free(self) -> jax.Array:
+        return self.cap - self.alloc
+
+
+class PodGroupTensors(struct.PyTreeNode):
+    """Pending-pod equivalence groups, shape leading dim G."""
+
+    req: jax.Array           # i32[G, R]
+    count: jax.Array         # i32[G] pods in the group
+    sel_req: jax.Array       # i32[G, S, A] ANDed requirements, each an OR over alts (0-padded)
+    sel_neg: jax.Array       # i32[G, Sn] hashes that must be absent from node labels
+    tol_exact: jax.Array     # i32[G, Tl]
+    tol_key: jax.Array       # i32[G, Tl]
+    tolerate_all: jax.Array  # bool[G] ({key:"",op:Exists} toleration)
+    port_hash: jax.Array     # i32[G, PP]
+    anti_affinity_self: jax.Array  # bool[G] pod has self-anti-affinity on hostname
+    valid: jax.Array         # bool[G]
+    needs_host_check: jax.Array  # bool[G] encoding was lossy; verify winner on host
+
+    @property
+    def g(self) -> int:
+        return self.req.shape[0]
+
+    def one_per_node(self) -> jax.Array:
+        """bool[G]: at most one pod of the group per node — hostname
+        self-anti-affinity, or hostPorts (two siblings request the same
+        ports and would conflict; reference: NodePorts filter applied
+        pod-by-pod during the serial binpack, binpacking_estimator.go:163)."""
+        return self.anti_affinity_self | (self.port_hash != 0).any(axis=-1)
+
+
+class ScheduledPodTensors(struct.PyTreeNode):
+    """Per-pod state for pods already placed on nodes (drain/scale-down path).
+
+    Reference counterpart: NodeInfo.Pods (vendored scheduler) consumed by
+    simulator/cluster.go:131 SimulateNodeRemoval. Re-scheduling a drained pod
+    uses its group_ref to reuse the group-level predicate tensors.
+    """
+
+    req: jax.Array        # i32[Ps, R]
+    node_idx: jax.Array   # i32[Ps] current node (-1 = none)
+    group_ref: jax.Array  # i32[Ps] index into a PodGroupTensors for predicate data
+    movable: jax.Array    # bool[Ps] drainability: evictable, must be rescheduled
+    blocks: jax.Array     # bool[Ps] drainability: pod forbids draining its node
+    valid: jax.Array      # bool[Ps]
+
+    @property
+    def p(self) -> int:
+        return self.req.shape[0]
+
+
+class NodeGroupTensors(struct.PyTreeNode):
+    """Per-node-group scale-up template + limits, shape leading dim NG.
+
+    Template rows mirror NodeGroup.TemplateNodeInfo (reference:
+    cloudprovider/cloud_provider.go:180+, sanitized as in
+    simulator/node_info_utils.go).
+    """
+
+    cap: jax.Array           # i32[NG, R]
+    label_hash: jax.Array    # i32[NG, L]
+    taint_exact: jax.Array   # i32[NG, T]
+    taint_key: jax.Array     # i32[NG, T]
+    zone_id: jax.Array       # i32[NG]
+    max_new: jax.Array       # i32[NG] max nodes this group may still add (maxSize - targetSize)
+    price_per_node: jax.Array  # f32[NG] (price expander input; 0 = unknown)
+    valid: jax.Array         # bool[NG]
+
+    @property
+    def ng(self) -> int:
+        return self.cap.shape[0]
+
+    def as_node_tensors(self, dims: Dims) -> NodeTensors:
+        """View each template as a (fresh, empty) node row — for predicate reuse."""
+        ng = self.ng
+        r = self.cap.shape[1]
+        return NodeTensors(
+            cap=self.cap,
+            alloc=jnp.zeros((ng, r), jnp.int32),
+            label_hash=self.label_hash,
+            taint_exact=self.taint_exact,
+            taint_key=self.taint_key,
+            used_ports=jnp.zeros((ng, dims.max_node_ports), jnp.int32),
+            zone_id=self.zone_id,
+            group_id=jnp.arange(ng, dtype=jnp.int32),
+            ready=jnp.ones((ng,), bool),
+            schedulable=jnp.ones((ng,), bool),
+            valid=self.valid,
+        )
+
+
+class ClusterTensors(struct.PyTreeNode):
+    """The full device-resident snapshot: one immutable pytree.
+
+    Fork/commit/revert (reference clustersnapshot.go:43-105) degenerate to
+    holding/swapping references to this value — see simulator/snapshot.py.
+    """
+
+    nodes: NodeTensors
+    pending: PodGroupTensors
+    scheduled: ScheduledPodTensors
+    groups: NodeGroupTensors
+
+
+def pad_to(n: int, bucket: int = 64) -> int:
+    """Round up to a shape bucket so recompilation is bounded (SURVEY.md §7
+    'dynamic shapes' hard part — the reference has no analog; Go has no tracing)."""
+    if n <= 0:
+        return bucket
+    return ((n + bucket - 1) // bucket) * bucket

@@ -1,0 +1,486 @@
+"""Host-side lowering: k8s-shaped objects → dense snapshot tensors.
+
+This is the one-time-per-loop string→tensor boundary. Reference counterpart:
+PredicateSnapshot.SetClusterState (simulator/clustersnapshot/predicate/
+predicate_snapshot.go:72-120), which rebuilds NodeInfos from API objects each
+loop; here the rebuild produces numpy arrays that are shipped to the TPU once
+and then forked for free.
+
+Encoding conventions (consumed by ops/predicates.py):
+  * labels     — each node label (k,v) contributes fold32("k=v") and fold32("k\\x01")
+                 (the key-marker enables Exists selectors).
+  * selectors  — nodeSelector and required node-affinity lower to ANDed
+                 requirements, each an OR over alternative pair hashes (In with
+                 multiple values); NotIn/DoesNotExist lower to must-be-absent
+                 hashes. Anything wider than the padding dims flags
+                 needs_host_check instead of dropping a constraint.
+  * taints     — exact item fold32("k\\0v\\0e") plus key item fold32("k\\0e");
+                 a toleration covers a taint via the exact hash (Equal) or the
+                 key hash (Exists). Empty-effect tolerations expand to both
+                 NoSchedule and NoExecute. PreferNoSchedule never blocks
+                 (scheduler semantics — it is a score, not a filter).
+  * hostPorts  — fold32("port/proto"); conflict = any overlap with the node's
+                 occupied-port set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models import resources as res
+from kubernetes_autoscaler_tpu.models.api import (
+    NO_EXECUTE,
+    NO_SCHEDULE,
+    TO_BE_DELETED_TAINT,
+    Node,
+    Pod,
+)
+from kubernetes_autoscaler_tpu.models.cluster_state import (
+    DEFAULT_DIMS,
+    Dims,
+    NodeGroupTensors,
+    NodeTensors,
+    PodGroupTensors,
+    ScheduledPodTensors,
+    pad_to,
+)
+from kubernetes_autoscaler_tpu.utils.hashing import fold32
+
+_KEY_MARK = "\x01"
+
+
+def _device(tree):
+    """Ship a host-encoded pytree to the default device (jnp arrays)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.asarray, tree)
+
+
+def _label_items(labels: dict[str, str]) -> list[int]:
+    out = []
+    for k, v in labels.items():
+        out.append(fold32(f"{k}={v}"))
+        out.append(fold32(k + _KEY_MARK))
+    return out
+
+
+def _taint_hashes(key: str, value: str, effect: str) -> tuple[int, int]:
+    return fold32(f"{key}\0{value}\0{effect}"), fold32(f"{key}\0{effect}")
+
+
+def _fill(row: np.ndarray, items: list[int]) -> bool:
+    """Fill a padded int32 row; returns False on overflow (caller flags host check)."""
+    k = min(len(items), row.shape[0])
+    if k:
+        row[:k] = np.array(items[:k], dtype=np.int32)
+    return len(items) <= row.shape[0]
+
+
+@dataclass
+class ZoneTable:
+    """Interns zone strings to small ids; id 0 is reserved for 'no zone'."""
+
+    ids: dict[str, int] = field(default_factory=dict)
+
+    def id_for(self, zone: str) -> int:
+        if not zone:
+            return 0
+        if zone not in self.ids:
+            self.ids[zone] = len(self.ids) + 1
+        return self.ids[zone]
+
+
+def pod_request_vector(
+    pod: Pod, registry: res.ExtendedResourceRegistry
+) -> tuple[np.ndarray, bool]:
+    """Pod spec → (int32[R], lossy). Requests round up (resources.py convention).
+
+    lossy=True when an extended resource did not fit the slot registry — the
+    pod must then be verified host-side (needs_host_check)."""
+    v = np.zeros((res.NUM_RESOURCES,), dtype=np.int64)
+    v[res.PODS] = 1
+    lossy = False
+    for name, amount in pod.requests.items():
+        if name == "cpu":
+            v[res.CPU] += res.cpu_request_to_milli(amount)
+        elif name == "memory":
+            v[res.MEMORY] += res.mem_request_to_mib(amount)
+        elif name == "ephemeral-storage":
+            v[res.EPHEMERAL] += res.mem_request_to_mib(amount)
+        else:
+            slot = registry.try_slot_for(name)
+            if slot is None:
+                lossy = True
+            else:
+                v[slot] += int(np.ceil(amount))
+    return v.astype(np.int32), lossy
+
+
+def node_capacity_vector(node: Node, registry: res.ExtendedResourceRegistry) -> np.ndarray:
+    """Node allocatable → int32[R]; capacities round down.
+
+    Unmappable extended resources are dropped — the node simply offers less,
+    which can only under-schedule (the conservative direction)."""
+    v = np.zeros((res.NUM_RESOURCES,), dtype=np.int64)
+    for name, amount in node.alloc_or_cap().items():
+        if name == "cpu":
+            v[res.CPU] = res.cpu_capacity_to_milli(amount)
+        elif name == "memory":
+            v[res.MEMORY] = res.mem_capacity_to_mib(amount)
+        elif name == "ephemeral-storage":
+            v[res.EPHEMERAL] = res.mem_capacity_to_mib(amount)
+        elif name == "pods":
+            v[res.PODS] = int(amount)
+        else:
+            slot = registry.try_slot_for(name)
+            if slot is not None:
+                v[slot] = int(amount)
+    if v[res.PODS] == 0:
+        v[res.PODS] = 110  # kubelet default max-pods
+    return v.astype(np.int32)
+
+
+@dataclass
+class _PodSpecEncoding:
+    sel_req: np.ndarray
+    sel_neg: np.ndarray
+    tol_exact: np.ndarray
+    tol_key: np.ndarray
+    tolerate_all: bool
+    port_hash: np.ndarray
+    anti_affinity_self: bool
+    lossy: bool
+
+
+def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
+    lossy = False
+    # --- selector terms (AND of ORs) ---
+    sel_req = np.zeros((dims.max_sel_terms, dims.max_sel_alts), dtype=np.int32)
+    sel_neg = np.zeros((dims.max_neg_terms,), dtype=np.int32)
+    terms: list[list[int]] = [[fold32(f"{k}={v}")] for k, v in sorted(pod.node_selector.items())]
+    negs: list[int] = []
+    for r in pod.required_node_affinity:
+        if r.operator == "In":
+            terms.append([fold32(f"{r.key}={v}") for v in r.values])
+        elif r.operator == "Exists":
+            terms.append([fold32(r.key + _KEY_MARK)])
+        elif r.operator == "DoesNotExist":
+            negs.append(fold32(r.key + _KEY_MARK))
+        elif r.operator == "NotIn":
+            negs.extend(fold32(f"{r.key}={v}") for v in r.values)
+        else:  # Gt/Lt and friends: not dense-encodable yet
+            lossy = True
+    if len(terms) > dims.max_sel_terms or len(negs) > dims.max_neg_terms:
+        lossy = True
+    for i, alts in enumerate(terms[: dims.max_sel_terms]):
+        if len(alts) > dims.max_sel_alts:
+            lossy = True
+        k = min(len(alts), dims.max_sel_alts)
+        sel_req[i, :k] = np.array(alts[:k], dtype=np.int32)
+    _fill(sel_neg, negs)
+
+    # --- tolerations ---
+    tol_exact = np.zeros((dims.max_tolerations,), dtype=np.int32)
+    tol_key = np.zeros((dims.max_tolerations,), dtype=np.int32)
+    tolerate_all = False
+    ex, ky = [], []
+    for t in pod.tolerations:
+        effects = [t.effect] if t.effect else [NO_SCHEDULE, NO_EXECUTE]
+        if t.operator == "Exists":
+            if not t.key:
+                tolerate_all = True
+                continue
+            for e in effects:
+                ky.append(fold32(f"{t.key}\0{e}"))
+        else:
+            for e in effects:
+                ex.append(fold32(f"{t.key}\0{t.value}\0{e}"))
+    if not (_fill(tol_exact, ex) and _fill(tol_key, ky)):
+        lossy = True
+
+    # --- host ports ---
+    port_hash = np.zeros((dims.max_pod_ports,), dtype=np.int32)
+    if not _fill(port_hash, [fold32(f"{p}/{proto or 'TCP'}") for p, proto in pod.host_ports]):
+        lossy = True
+
+    # --- anti-affinity: dense path covers the common self-anti-affinity-on-hostname
+    #     shape; richer terms go through the host-check tier (SURVEY.md §7 hard part:
+    #     inter-pod affinity breaks pods×nodes independence). ---
+    anti_self = False
+    for term in pod.anti_affinity:
+        if (
+            term.topology_key == "kubernetes.io/hostname"
+            and term.match_labels
+            and all(pod.labels.get(k) == v for k, v in term.match_labels.items())
+        ):
+            anti_self = True
+        else:
+            lossy = True
+    if pod.pod_affinity or pod.topology_spread_max_skew:
+        lossy = True
+
+    return _PodSpecEncoding(sel_req, sel_neg, tol_exact, tol_key, tolerate_all, port_hash, anti_self, lossy)
+
+
+def equivalence_key(pod: Pod) -> int:
+    """Pods with equal keys are schedulable-equivalent (reference:
+    core/scaleup/equivalence/groups.go:40 — controller UID + drop-irrelevant-
+    fields spec hash). We hash the predicate-relevant spec directly."""
+    parts = [
+        pod.namespace,
+        repr(sorted(pod.requests.items())),
+        repr(sorted(pod.node_selector.items())),
+        repr([(r.key, r.operator, tuple(r.values)) for r in pod.required_node_affinity]),
+        repr([(t.key, t.operator, t.value, t.effect) for t in pod.tolerations]),
+        repr(pod.host_ports),
+        repr([(sorted(t.match_labels.items()), t.topology_key) for t in pod.anti_affinity]),
+        pod.owner.uid if pod.owner else pod.name,
+    ]
+    return fold32("|".join(parts))
+
+
+@dataclass
+class EncodedCluster:
+    """Host handle for one encoded snapshot: tensors + name/index maps."""
+
+    nodes: NodeTensors
+    specs: PodGroupTensors          # spec table; `count` counts PENDING pods per row
+    scheduled: ScheduledPodTensors  # resident pods, group_ref → specs row
+    node_names: list[str]
+    node_index: dict[str, int]
+    zone_table: ZoneTable
+    registry: res.ExtendedResourceRegistry
+    dims: Dims
+    group_pods: list[list[int]]     # specs row → indices into `pending_pods`
+    pending_pods: list[Pod]
+    scheduled_pods: list[Pod]
+
+
+def encode_cluster(
+    nodes: list[Node],
+    pods: list[Pod],
+    registry: res.ExtendedResourceRegistry | None = None,
+    dims: Dims = DEFAULT_DIMS,
+    node_group_ids: dict[str, int] | None = None,
+    node_bucket: int = 64,
+    group_bucket: int = 64,
+    pod_bucket: int = 256,
+) -> EncodedCluster:
+    """Lower a (nodes, pods) world into one EncodedCluster.
+
+    Pods with node_name set and a live node become `scheduled` rows and charge
+    their node's alloc/ports; the rest become pending equivalence groups.
+    """
+    registry = registry or res.ExtendedResourceRegistry()
+    zone_table = ZoneTable()
+    node_group_ids = node_group_ids or {}
+
+    node_index = {nd.name: i for i, nd in enumerate(nodes)}
+    # Terminal pods neither charge capacity nor ask for it (reference: the
+    # kube listers feeding RunOnce filter Succeeded/Failed, and drainability's
+    # terminal rule skips them — utils/kubernetes + drainability/rules/terminal).
+    live = [p for p in pods if p.phase not in ("Succeeded", "Failed")]
+    pending = [p for p in live if not p.node_name or p.node_name not in node_index]
+    resident = [p for p in live if p.node_name in node_index]
+
+    # ---- nodes ----
+    n_pad = pad_to(len(nodes), node_bucket)
+    r = res.NUM_RESOURCES
+    cap = np.zeros((n_pad, r), np.int32)
+    alloc = np.zeros((n_pad, r), np.int32)
+    label_hash = np.zeros((n_pad, dims.max_labels), np.int32)
+    taint_exact = np.zeros((n_pad, dims.max_taints), np.int32)
+    taint_key = np.zeros((n_pad, dims.max_taints), np.int32)
+    used_ports = np.zeros((n_pad, dims.max_node_ports), np.int32)
+    zone_id = np.zeros((n_pad,), np.int32)
+    group_id = np.full((n_pad,), -1, np.int32)
+    ready = np.zeros((n_pad,), bool)
+    schedulable = np.zeros((n_pad,), bool)
+    valid = np.zeros((n_pad,), bool)
+
+    for i, nd in enumerate(nodes):
+        cap[i] = node_capacity_vector(nd, registry)
+        if not _fill(label_hash[i], _label_items(nd.labels)):
+            # Losing label hashes would create false "does not match" — the one
+            # direction the encoding contract forbids. Fail fast; the caller
+            # re-encodes with a larger Dims.max_labels.
+            raise ValueError(
+                f"node {nd.name!r}: {len(nd.labels)} labels overflow "
+                f"Dims.max_labels={dims.max_labels} (2 slots per label)"
+            )
+        tx, tk = [], []
+        blocked = False
+        for t in nd.taints:
+            if t.effect not in (NO_SCHEDULE, NO_EXECUTE):
+                continue  # PreferNoSchedule: score-only, never filters
+            if t.key == TO_BE_DELETED_TAINT:
+                blocked = True
+            e, k = _taint_hashes(t.key, t.value, t.effect)
+            tx.append(e)
+            tk.append(k)
+        if not (_fill(taint_exact[i], tx) and _fill(taint_key[i], tk)):
+            # Losing a taint would silently ADMIT intolerant pods — fail fast.
+            raise ValueError(
+                f"node {nd.name!r}: {len(tx)} filterable taints overflow "
+                f"Dims.max_taints={dims.max_taints}"
+            )
+        zone_id[i] = zone_table.id_for(nd.zone())
+        group_id[i] = node_group_ids.get(nd.name, -1)
+        ready[i] = nd.ready
+        schedulable[i] = not nd.unschedulable and not blocked
+        valid[i] = True
+
+    # ---- resident pods: charge alloc + ports; collect spec rows ----
+    spec_rows: dict[int, int] = {}       # equivalence key -> specs row
+    row_encodings: list[tuple[np.ndarray, _PodSpecEncoding]] = []
+    row_pending_count: list[int] = []
+    group_pods: list[list[int]] = []
+
+    def row_for(pod: Pod) -> int:
+        key = equivalence_key(pod)
+        if key not in spec_rows:
+            spec_rows[key] = len(row_encodings)
+            req, req_lossy = pod_request_vector(pod, registry)
+            spec = _encode_pod_spec(pod, dims)
+            spec.lossy = spec.lossy or req_lossy
+            row_encodings.append((req, spec))
+            row_pending_count.append(0)
+            group_pods.append([])
+        return spec_rows[key]
+
+    p_pad = pad_to(len(resident), pod_bucket)
+    s_req = np.zeros((p_pad, r), np.int32)
+    s_node = np.full((p_pad,), -1, np.int32)
+    s_group = np.zeros((p_pad,), np.int32)
+    s_movable = np.zeros((p_pad,), bool)
+    s_blocks = np.zeros((p_pad,), bool)
+    s_valid = np.zeros((p_pad,), bool)
+    node_port_lists: dict[int, list[int]] = {}
+
+    for j, pod in enumerate(resident):
+        ni = node_index[pod.node_name]
+        req, _ = pod_request_vector(pod, registry)
+        alloc[ni] += req
+        for p, proto in pod.host_ports:
+            node_port_lists.setdefault(ni, []).append(fold32(f"{p}/{proto or 'TCP'}"))
+        s_req[j] = req
+        s_node[j] = ni
+        s_group[j] = row_for(pod)
+        # Conservative default: every resident pod blocks draining until the
+        # drainability rules (simulator/drainability/rules.py) classify it —
+        # an unclassified snapshot must never report nodes as freely drainable.
+        s_blocks[j] = True
+        s_valid[j] = True
+    for ni, ports in node_port_lists.items():
+        if not _fill(used_ports[ni], ports):
+            # Losing an occupied port would admit conflicting pods — fail fast.
+            raise ValueError(
+                f"node index {ni}: {len(ports)} occupied hostPorts overflow "
+                f"Dims.max_node_ports={dims.max_node_ports}"
+            )
+
+    # ---- pending pods → groups ----
+    for idx, pod in enumerate(pending):
+        row = row_for(pod)
+        row_pending_count[row] += 1
+        group_pods[row].append(idx)
+
+    g_pad = pad_to(max(len(row_encodings), 1), group_bucket)
+    g_req = np.zeros((g_pad, r), np.int32)
+    g_count = np.zeros((g_pad,), np.int32)
+    g_sel_req = np.zeros((g_pad, dims.max_sel_terms, dims.max_sel_alts), np.int32)
+    g_sel_neg = np.zeros((g_pad, dims.max_neg_terms), np.int32)
+    g_tol_exact = np.zeros((g_pad, dims.max_tolerations), np.int32)
+    g_tol_key = np.zeros((g_pad, dims.max_tolerations), np.int32)
+    g_tol_all = np.zeros((g_pad,), bool)
+    g_ports = np.zeros((g_pad, dims.max_pod_ports), np.int32)
+    g_anti_self = np.zeros((g_pad,), bool)
+    g_valid = np.zeros((g_pad,), bool)
+    g_hostcheck = np.zeros((g_pad,), bool)
+
+    for row, (req, enc) in enumerate(row_encodings):
+        g_req[row] = req
+        g_count[row] = row_pending_count[row]
+        g_sel_req[row] = enc.sel_req
+        g_sel_neg[row] = enc.sel_neg
+        g_tol_exact[row] = enc.tol_exact
+        g_tol_key[row] = enc.tol_key
+        g_tol_all[row] = enc.tolerate_all
+        g_ports[row] = enc.port_hash
+        g_anti_self[row] = enc.anti_affinity_self
+        g_valid[row] = True
+        g_hostcheck[row] = enc.lossy
+
+    return EncodedCluster(
+        nodes=_device(NodeTensors(
+            cap=cap, alloc=alloc, label_hash=label_hash, taint_exact=taint_exact,
+            taint_key=taint_key, used_ports=used_ports, zone_id=zone_id,
+            group_id=group_id, ready=ready, schedulable=schedulable, valid=valid,
+        )),
+        specs=_device(PodGroupTensors(
+            req=g_req, count=g_count, sel_req=g_sel_req, sel_neg=g_sel_neg,
+            tol_exact=g_tol_exact, tol_key=g_tol_key, tolerate_all=g_tol_all,
+            port_hash=g_ports, anti_affinity_self=g_anti_self, valid=g_valid,
+            needs_host_check=g_hostcheck,
+        )),
+        scheduled=_device(ScheduledPodTensors(
+            req=s_req, node_idx=s_node, group_ref=s_group, movable=s_movable,
+            blocks=s_blocks, valid=s_valid,
+        )),
+        node_names=[nd.name for nd in nodes],
+        node_index=node_index,
+        zone_table=zone_table,
+        registry=registry,
+        dims=dims,
+        group_pods=group_pods,
+        pending_pods=pending,
+        scheduled_pods=resident,
+    )
+
+
+def encode_node_groups(
+    templates: list[tuple[Node, int, float]],
+    registry: res.ExtendedResourceRegistry,
+    zone_table: ZoneTable,
+    dims: Dims = DEFAULT_DIMS,
+    bucket: int = 8,
+) -> NodeGroupTensors:
+    """Lower node-group templates (template node, max_new, price/node) to tensors.
+
+    Reference: MixedTemplateNodeInfoProvider (processors/nodeinfosprovider)
+    produces a NodeInfo per group; sanitization (simulator/node_info_utils.go)
+    is mirrored by the caller passing a clean template Node.
+    """
+    ng_pad = pad_to(max(len(templates), 1), bucket)
+    r = res.NUM_RESOURCES
+    cap = np.zeros((ng_pad, r), np.int32)
+    label_hash = np.zeros((ng_pad, dims.max_labels), np.int32)
+    taint_exact = np.zeros((ng_pad, dims.max_taints), np.int32)
+    taint_key = np.zeros((ng_pad, dims.max_taints), np.int32)
+    zone_id = np.zeros((ng_pad,), np.int32)
+    max_new = np.zeros((ng_pad,), np.int32)
+    price = np.zeros((ng_pad,), np.float32)
+    valid = np.zeros((ng_pad,), bool)
+    for i, (tmpl, mx, pr) in enumerate(templates):
+        cap[i] = node_capacity_vector(tmpl, registry)
+        _fill(label_hash[i], _label_items(tmpl.labels))
+        tx, tk = [], []
+        for t in tmpl.taints:
+            if t.effect not in (NO_SCHEDULE, NO_EXECUTE):
+                continue
+            e, k = _taint_hashes(t.key, t.value, t.effect)
+            tx.append(e)
+            tk.append(k)
+        _fill(taint_exact[i], tx)
+        _fill(taint_key[i], tk)
+        zone_id[i] = zone_table.id_for(tmpl.zone())
+        max_new[i] = mx
+        price[i] = pr
+        valid[i] = True
+    return _device(NodeGroupTensors(
+        cap=cap, label_hash=label_hash, taint_exact=taint_exact, taint_key=taint_key,
+        zone_id=zone_id, max_new=max_new, price_per_node=price, valid=valid,
+    ))

@@ -1,0 +1,122 @@
+"""The fused autoscaling simulation step — the framework's flagship kernel.
+
+One jitted program covering the simulation content of a whole
+StaticAutoscaler.RunOnce (core/static_autoscaler.go:296): filter-out-
+schedulable, every node group's binpacking expansion option, expander scoring,
+and the scale-down eligibility + drain sweep. The reference spreads this over
+three serial hot loops (SURVEY.md §3.1/§3.2 loops A/B/C); here it is one
+device dispatch over the pods×nodes×nodegroups tensors.
+
+The host control plane (core/) calls these; __graft_entry__.py exposes them
+for compile checking and multi-chip dry runs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from kubernetes_autoscaler_tpu.models.cluster_state import (
+    ClusterTensors,
+    Dims,
+    NodeGroupTensors,
+    NodeTensors,
+    PodGroupTensors,
+    ScheduledPodTensors,
+)
+from kubernetes_autoscaler_tpu.ops import drain, schedule, scoring, utilization
+from kubernetes_autoscaler_tpu.ops.binpack import EstimateResult, estimate_all
+from kubernetes_autoscaler_tpu.ops.scoring import OptionScores
+
+
+class ScaleUpSim(struct.PyTreeNode):
+    fits_existing: jax.Array    # i32[G] pending pods absorbed by current capacity
+    remaining: jax.Array        # i32[G] pods that actually need new nodes
+    estimate: EstimateResult    # per-nodegroup expansion options
+    scores: OptionScores
+    best: jax.Array             # i32 winning node group index (-1 = none)
+
+
+class ScaleDownSim(struct.PyTreeNode):
+    eligible: jax.Array         # bool[N] below utilization threshold
+    removal: drain.RemovalResult  # per-candidate drain verdicts (C == N here)
+    utilization: jax.Array      # f32[N]
+
+
+@partial(jax.jit, static_argnames=("dims", "max_new_nodes", "strategy"))
+def scale_up_sim(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors,
+    groups: NodeGroupTensors,
+    dims: Dims,
+    max_new_nodes: int = 256,
+    strategy: str = "least-waste",
+) -> ScaleUpSim:
+    """Loops A+B of the reference hot path as one program."""
+    packed = schedule.schedule_pending_on_existing(nodes, specs, scheduled)
+    remaining = jnp.maximum(specs.count - packed.scheduled, 0)
+    pending = specs.replace(count=remaining)
+    est = estimate_all(pending, groups, dims, max_new_nodes)
+    sc = scoring.score_options(est, groups)
+    best = scoring.best_option(sc, strategy)
+    return ScaleUpSim(
+        fits_existing=packed.scheduled,
+        remaining=remaining,
+        estimate=est,
+        scores=sc,
+        best=best,
+    )
+
+
+@partial(jax.jit, static_argnames=("max_pods_per_node", "chunk"))
+def scale_down_sim(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors,
+    threshold: float = 0.5,
+    max_pods_per_node: int = 128,
+    chunk: int = 32,
+) -> ScaleDownSim:
+    """Loop C of the reference hot path: eligibility + full drain sweep.
+
+    Every node is a candidate (the reference caps candidates and applies a
+    simulation timeout, planner.go:297-309 — unnecessary at TPU throughput);
+    the planner applies policy (unneeded time, limits) on the verdicts."""
+    util = utilization.node_utilization(nodes)
+    eligible = utilization.eligible_for_scale_down(nodes, threshold)
+    candidates = jnp.arange(nodes.n, dtype=jnp.int32)
+    removal = drain.simulate_removals(
+        nodes,
+        specs,
+        scheduled,
+        candidates,
+        dest_allowed=~eligible,  # destinations: nodes staying up
+        max_pods_per_node=max_pods_per_node,
+        chunk=chunk,
+    )
+    return ScaleDownSim(eligible=eligible, removal=removal, utilization=util)
+
+
+@partial(jax.jit, static_argnames=("dims", "max_new_nodes", "strategy", "max_pods_per_node"))
+def run_once_sim(
+    cluster: ClusterTensors,
+    dims: Dims,
+    max_new_nodes: int = 256,
+    strategy: str = "least-waste",
+    threshold: float = 0.5,
+    max_pods_per_node: int = 128,
+) -> tuple[ScaleUpSim, ScaleDownSim]:
+    """Full RunOnce simulation content in a single dispatch."""
+    up = scale_up_sim.__wrapped__(
+        cluster.nodes, cluster.pending, cluster.scheduled, cluster.groups,
+        dims, max_new_nodes, strategy,
+    )
+    down = scale_down_sim.__wrapped__(
+        cluster.nodes, cluster.pending, cluster.scheduled, threshold,
+        max_pods_per_node, 32,
+    )
+    return up, down

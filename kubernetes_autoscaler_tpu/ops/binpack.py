@@ -1,0 +1,78 @@
+"""Binpacking node estimation: all node groups' expansion options in one kernel.
+
+Reference counterpart: BinpackingNodeEstimator.Estimate
+(estimator/binpacking_estimator.go:102-161) — for ONE node group, simulate
+adding template nodes one at a time and first-fit pods onto them, with an
+arithmetic fastpath (:274-324). The orchestrator then loops node groups
+serially (core/scaleup/orchestrator/orchestrator.go:379-414).
+
+TPU re-design: all node groups are estimated simultaneously. Each group gets a
+pool of `max_new` identical empty template bins; a vmapped first-fit scan
+(ops/pack.py) packs every pod equivalence group into every pool at once. The
+reference's fastpath extrapolation is unnecessary — the full pack is already
+one fused device program — and its early-exit for pods that do not fit an
+empty template node (:234) falls out of fit_count()==0.
+
+Output shapes: NG node groups × G pod groups × M max-new-nodes (static).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from kubernetes_autoscaler_tpu.models.cluster_state import (
+    Dims,
+    NodeGroupTensors,
+    PodGroupTensors,
+)
+from kubernetes_autoscaler_tpu.ops import predicates
+from kubernetes_autoscaler_tpu.ops.pack import ffd_order, pack_groups
+
+
+class EstimateResult(struct.PyTreeNode):
+    node_count: jax.Array      # i32[NG] new nodes needed by each expansion option
+    scheduled: jax.Array       # i32[NG, G] pods of group g the option schedules
+    pods_per_node: jax.Array   # i32[NG, M] pods landing on each new node
+    free_after: jax.Array      # i32[NG, M, R] leftover capacity (expander scoring input)
+    template_fits: jax.Array   # bool[NG, G] group's exemplar passes template predicates
+
+
+def estimate_all(
+    specs: PodGroupTensors,
+    groups: NodeGroupTensors,
+    dims: Dims,
+    max_new_nodes: int,
+) -> EstimateResult:
+    """Compute every node group's expansion option for the pending pod set."""
+    tmpl_nodes = groups.as_node_tensors(dims)
+    # bool[G, NG]: placement-independent predicates vs each template
+    # (capacity is enforced by the packer against the empty bins).
+    mask_gt = predicates.feasibility_mask(tmpl_nodes, specs, check_resources=False)
+    order = ffd_order(specs.req, specs.valid & (specs.count > 0))
+    count = jnp.where(specs.valid, specs.count, 0)
+
+    def one_group(cap_row, max_new, feas_col):
+        free0 = jnp.broadcast_to(cap_row[None, :], (max_new_nodes, cap_row.shape[0]))
+        bin_open = jnp.arange(max_new_nodes, dtype=jnp.int32) < max_new
+        mask = feas_col[:, None] & bin_open[None, :]
+        res = pack_groups(
+            free0, mask, specs.req, count, order, specs.one_per_node()
+        )
+        pods_per_node = res.placed.sum(axis=0)
+        node_cnt = (pods_per_node > 0).sum().astype(jnp.int32)
+        return node_cnt, res.scheduled, pods_per_node, res.free_after
+
+    node_count, scheduled, pods_per_node, free_after = jax.vmap(one_group)(
+        groups.cap, groups.max_new, mask_gt.T
+    )
+    node_count = jnp.where(groups.valid, node_count, 0)
+    scheduled = scheduled * groups.valid[:, None]
+    return EstimateResult(
+        node_count=node_count,
+        scheduled=scheduled,
+        pods_per_node=pods_per_node,
+        free_after=free_after,
+        template_fits=mask_gt.T,
+    )

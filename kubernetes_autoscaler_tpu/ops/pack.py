@@ -1,0 +1,81 @@
+"""First-fit packing primitive: place pod equivalence groups onto node bins.
+
+This is the vectorized replacement for the reference's one-pod-at-a-time
+SchedulePod loop (estimator/binpacking_estimator.go:163-238 and the
+HintingSimulator's TrySchedulePods, simulator/scheduling/hinting_simulator.go:53).
+Instead of scheduling pod-by-pod with fork/revert, a whole equivalence group is
+placed in one step: per node, `how many exemplars still fit` is an integer
+divide over the free-resource vector, and first-fit order becomes a cumulative
+sum — pods spill across nodes in index order exactly as a serial first-fit
+would, but with no inner loop.
+
+The outer loop over groups is a `lax.scan` carrying the free-capacity tensor:
+binpacking is inherently sequential across groups (SURVEY.md §7 hard part),
+but each scan step does all-nodes work on the VPU, so the serial depth is G
+(≈ distinct pod shapes), not P (pods).
+
+Tie-break/ordering contract: nodes are filled in ascending index order; callers
+control placement preference by passing a node permutation (the reference's
+pluggable NodeOrdering, plugin_runner.go:89-131, becomes "sort the axis").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+class PackResult(struct.PyTreeNode):
+    free_after: jax.Array   # i32[N, R] remaining capacity after placement
+    placed: jax.Array       # i32[G, N] pods of group g placed on node n
+    scheduled: jax.Array    # i32[G] total pods placed per group (≤ count)
+
+
+def fit_count(free: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
+    """i32[N]: how many pods with request vector `req` fit into `free` rows.
+
+    Resource slots with req==0 impose no constraint. Negative free → 0."""
+    big = jnp.int32(1 << 30)
+    safe = jnp.maximum(req, 1)[None, :]                  # avoid /0
+    per_r = jnp.where(req[None, :] > 0, jnp.clip(free, 0) // safe, big)
+    return jnp.min(per_r, axis=-1)
+
+
+def pack_groups(
+    free: jnp.ndarray,       # i32[N, R]
+    mask: jnp.ndarray,       # bool[G, N] placement-independent feasibility
+    req: jnp.ndarray,        # i32[G, R]
+    count: jnp.ndarray,      # i32[G] pods wanted per group
+    order: jnp.ndarray,      # i32[G] group processing order (e.g. FFD by size)
+    limit_one: jnp.ndarray,  # bool[G] cap placement at 1/node (self-anti-affinity)
+) -> PackResult:
+    """First-fit-decreasing placement of all groups onto the node bins."""
+    free = jnp.asarray(free)
+    mask = jnp.asarray(mask)
+    req = jnp.asarray(req)
+    count = jnp.asarray(count)
+    order = jnp.asarray(order)
+    limit_one = jnp.asarray(limit_one)
+
+    def step(free_c, g):
+        reqg = req[g]
+        c = fit_count(free_c, reqg)
+        c = jnp.where(mask[g], c, 0)
+        c = jnp.where(limit_one[g], jnp.minimum(c, 1), c)
+        cum = jnp.cumsum(c)
+        place = jnp.clip(count[g] - (cum - c), 0, c)
+        free_c = free_c - place[:, None] * reqg[None, :]
+        return free_c, place
+
+    free_after, placed_in_order = jax.lax.scan(step, free, order)
+    placed = jnp.zeros_like(placed_in_order).at[order].set(placed_in_order)
+    return PackResult(free_after=free_after, placed=placed, scheduled=placed.sum(axis=-1))
+
+
+def ffd_order(req: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Decreasing-size group order (reference: estimator/decreasing_pod_orderer.go —
+    exemplar score over cpu+memory). Invalid rows sort last."""
+    score = req[:, 0].astype(jnp.float32) + req[:, 1].astype(jnp.float32) / 1024.0
+    score = jnp.where(valid, score, -1.0)
+    return jnp.argsort(-score).astype(jnp.int32)

@@ -1,0 +1,118 @@
+"""Batched scheduler-predicate evaluation: the pods×nodes feasibility mask.
+
+Reference counterpart: SchedulerPluginRunner.RunFiltersUntilPassingNode /
+RunFiltersOnNode (simulator/clustersnapshot/predicate/plugin_runner.go:54-182),
+which runs the vendored kube-scheduler Filter plugins serially per pod with a
+goroutine-parallel node scan (plugin_runner.go:135, √n chunking). Here the
+entire (pod-group × node) plane is evaluated as one fused tensor expression —
+the per-pair cost is a handful of int32 compares, so the TPU evaluates the
+whole plane exhaustively instead of early-exiting per pod.
+
+Implemented filter semantics (the simulable subset, SURVEY.md §7):
+  * NodeResourcesFit     — dense int32 resource vectors (models/resources.py)
+  * NodeUnschedulable    — `schedulable` gate (spec.unschedulable + ToBeDeleted taint)
+  * NodeAffinity + nodeSelector — AND-of-OR hash requirements + negatives
+  * TaintToleration      — exact/key hash coverage per taint
+  * NodePorts            — occupied-port hash intersection
+  * readiness/validity gates
+
+Inter-pod (anti-)affinity and topology spread have cross-pod coupling and are
+handled at the packing layer (ops/binpack.py caps per-node placement for
+self-anti-affinity groups) and the host-check tier for richer terms.
+
+All loops below are over *static padding dims* (unrolled at trace time into a
+fused XLA graph); no data-dependent Python control flow.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_autoscaler_tpu.models.cluster_state import NodeTensors, PodGroupTensors
+
+
+def _any_eq(table: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
+    """table: i32[N, K] hash slots, h: i32[G] probes → bool[G, N] membership.
+
+    0 probes never match (0 is the padding sentinel and never a valid hash)."""
+    hit = (table[None, :, :] == h[:, None, None]).any(axis=-1)
+    return hit & (h != 0)[:, None]
+
+
+def resources_fit(nodes: NodeTensors, specs: PodGroupTensors) -> jnp.ndarray:
+    """bool[G, N]: req <= cap - alloc on every resource slot."""
+    free = nodes.free()  # i32[N, R]
+    return (specs.req[:, None, :] <= free[None, :, :]).all(axis=-1)
+
+
+def selector_match(node_labels: jnp.ndarray, specs: PodGroupTensors) -> jnp.ndarray:
+    """bool[G, N]: every ANDed requirement has ≥1 alternative present, and no
+    must-be-absent hash is present. node_labels: i32[N, L]."""
+    g = specs.sel_req.shape[0]
+    n = node_labels.shape[0]
+    ok = jnp.ones((g, n), dtype=bool)
+    s_terms, s_alts = specs.sel_req.shape[1], specs.sel_req.shape[2]
+    for s in range(s_terms):
+        term = specs.sel_req[:, s, :]                      # i32[G, A]
+        term_active = (term != 0).any(axis=-1)             # bool[G]
+        sat = jnp.zeros((g, n), dtype=bool)
+        for a in range(s_alts):
+            sat = sat | _any_eq(node_labels, term[:, a])
+        ok = ok & (~term_active[:, None] | sat)
+    for s in range(specs.sel_neg.shape[1]):
+        ok = ok & ~_any_eq(node_labels, specs.sel_neg[:, s])
+    return ok
+
+
+def taints_tolerated(
+    taint_exact: jnp.ndarray, taint_key: jnp.ndarray, specs: PodGroupTensors
+) -> jnp.ndarray:
+    """bool[G, N]: every NoSchedule/NoExecute taint is covered by a toleration.
+
+    Coverage = exact (key,value,effect) hash match (Equal operator), or
+    (key,effect) hash match (Exists operator), or the tolerate-everything flag.
+    taint_exact/taint_key: i32[N, T]."""
+    g = specs.tol_exact.shape[0]
+    n = taint_exact.shape[0]
+    ok = jnp.ones((g, n), dtype=bool)
+    for t in range(taint_exact.shape[1]):
+        te = taint_exact[:, t]                              # i32[N]
+        tk = taint_key[:, t]
+        active = te != 0                                    # bool[N]
+        covered = jnp.broadcast_to(specs.tolerate_all[:, None], (g, n))
+        for tl in range(specs.tol_exact.shape[1]):
+            covered = covered | (specs.tol_exact[:, tl][:, None] == te[None, :]) & active[None, :]
+            covered = covered | (specs.tol_key[:, tl][:, None] == tk[None, :]) & (tk != 0)[None, :]
+        ok = ok & (~active[None, :] | covered)
+    return ok
+
+
+def ports_free(used_ports: jnp.ndarray, specs: PodGroupTensors) -> jnp.ndarray:
+    """bool[G, N]: none of the pod's hostPorts collide with occupied ports."""
+    g = specs.port_hash.shape[0]
+    n = used_ports.shape[0]
+    conflict = jnp.zeros((g, n), dtype=bool)
+    for pp in range(specs.port_hash.shape[1]):
+        conflict = conflict | _any_eq(used_ports, specs.port_hash[:, pp])
+    return ~conflict
+
+
+def feasibility_mask(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    check_resources: bool = True,
+) -> jnp.ndarray:
+    """The full predicate plane: bool[G, N].
+
+    One entry per (pod-equivalence-group, node): True iff the group's exemplar
+    pod passes every implemented Filter on that node given current allocations.
+    `check_resources=False` yields the placement-independent mask (template
+    matching, where capacity is checked separately by the packer)."""
+    mask = selector_match(nodes.label_hash, specs)
+    mask = mask & taints_tolerated(nodes.taint_exact, nodes.taint_key, specs)
+    mask = mask & ports_free(nodes.used_ports, specs)
+    if check_resources:
+        mask = mask & resources_fit(nodes, specs)
+    gate = nodes.valid & nodes.ready & nodes.schedulable
+    mask = mask & gate[None, :]
+    return mask & specs.valid[:, None]

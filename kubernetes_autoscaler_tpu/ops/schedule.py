@@ -1,0 +1,57 @@
+"""Scheduling pending pods onto the existing cluster (filter-out-schedulable).
+
+Reference counterpart: the filter-out-schedulable pod-list processor
+(core/podlistprocessor/filter_out_schedulable.go:103) driving
+HintingSimulator.TrySchedulePods (simulator/scheduling/hinting_simulator.go:53)
+— a serial per-pod loop with a hint cache (pod→last node) and a negative cache
+of failed equivalence classes (similar_pods.go). The TPU plane needs neither
+cache: equivalence grouping is the negative cache (one predicate row per
+shape), and the full pods×nodes evaluation replaces hint lookups.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_autoscaler_tpu.models.cluster_state import (
+    NodeTensors,
+    PodGroupTensors,
+    ScheduledPodTensors,
+)
+from kubernetes_autoscaler_tpu.ops import predicates
+from kubernetes_autoscaler_tpu.ops.pack import PackResult, ffd_order, pack_groups
+
+
+def resident_group_counts(
+    scheduled: ScheduledPodTensors, g: int, n: int
+) -> jnp.ndarray:
+    """i32[G, N]: how many resident pods of each equivalence group sit on each
+    node. Feeds self-anti-affinity masking: a group with hostname
+    anti-affinity on its own labels cannot land where a sibling already runs."""
+    ok = scheduled.valid & (scheduled.node_idx >= 0)
+    gr = jnp.where(ok, scheduled.group_ref, 0)
+    ni = jnp.where(ok, scheduled.node_idx, 0)
+    return (
+        jnp.zeros((g, n), jnp.int32).at[gr, ni].add(ok.astype(jnp.int32))
+    )
+
+
+def schedule_pending_on_existing(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors | None = None,
+) -> PackResult:
+    """First-fit all pending groups onto current free capacity.
+
+    Returns a PackResult whose `scheduled` says how many pods of each group fit
+    the existing cluster — those are removed from the scale-up problem, exactly
+    the role of filter-out-schedulable in RunOnce (static_autoscaler.go:530)."""
+    mask = predicates.feasibility_mask(nodes, specs, check_resources=False)
+    if scheduled is not None:
+        resident = resident_group_counts(scheduled, specs.g, nodes.n)
+        mask = mask & ~(specs.anti_affinity_self[:, None] & (resident > 0))
+    order = ffd_order(specs.req, specs.valid & (specs.count > 0))
+    count = jnp.where(specs.valid, specs.count, 0)
+    return pack_groups(
+        nodes.free(), mask, specs.req, count, order, specs.one_per_node()
+    )

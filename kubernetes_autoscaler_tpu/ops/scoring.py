@@ -1,0 +1,73 @@
+"""Expander scoring: node-group choice as on-device reductions.
+
+Reference counterpart: expander.Strategy.BestOption (expander/expander.go:55)
+with the strategy zoo under expander/{random,mostpods,waste,leastnodes,price}.
+Those strategies iterate Go maps over the already-computed expansion options;
+here every score is a reduction over the EstimateResult tensors, so all
+strategies are computed for all node groups in one pass and the strategy
+*chain* (expander/factory/chain.go) becomes successive masked argmin/argmax.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from kubernetes_autoscaler_tpu.models.cluster_state import NodeGroupTensors
+from kubernetes_autoscaler_tpu.models.resources import CPU, MEMORY
+from kubernetes_autoscaler_tpu.ops.binpack import EstimateResult
+
+_INF = jnp.float32(3.0e38)
+
+
+class OptionScores(struct.PyTreeNode):
+    valid: jax.Array        # bool[NG] option schedules ≥1 pod with ≥1 node
+    pods: jax.Array         # i32[NG] pods helped (most-pods maximizes)
+    nodes: jax.Array        # i32[NG] new nodes (least-nodes minimizes)
+    waste: jax.Array        # f32[NG] leftover cpu+mem fraction (least-waste minimizes)
+    price: jax.Array        # f32[NG] node_count × price_per_node (price minimizes)
+
+
+def score_options(est: EstimateResult, groups: NodeGroupTensors) -> OptionScores:
+    pods = est.scheduled.sum(axis=-1)
+    nodes = est.node_count
+    valid = groups.valid & (nodes > 0) & (pods > 0)
+
+    used = (est.pods_per_node > 0).astype(jnp.float32)            # f32[NG, M]
+    cap_cpu = groups.cap[:, CPU].astype(jnp.float32)
+    cap_mem = groups.cap[:, MEMORY].astype(jnp.float32)
+    total_cpu = used.sum(-1) * cap_cpu
+    total_mem = used.sum(-1) * cap_mem
+    free_cpu = (est.free_after[:, :, CPU].astype(jnp.float32) * used).sum(-1)
+    free_mem = (est.free_after[:, :, MEMORY].astype(jnp.float32) * used).sum(-1)
+    waste = jnp.where(total_cpu > 0, free_cpu / jnp.maximum(total_cpu, 1.0), 1.0)
+    waste = waste + jnp.where(total_mem > 0, free_mem / jnp.maximum(total_mem, 1.0), 1.0)
+
+    price = nodes.astype(jnp.float32) * groups.price_per_node
+    return OptionScores(valid=valid, pods=pods, nodes=nodes, waste=waste, price=price)
+
+
+def best_option(scores: OptionScores, strategy: str = "least-waste") -> jax.Array:
+    """i32 scalar: index of the winning node group (-1 if no valid option).
+
+    Ties break toward the lowest index — a fixed, documented order (the
+    reference breaks ties randomly, expander/random; determinism here is a
+    feature for testability, SURVEY.md §7 'determinism/tie-breaks')."""
+    if strategy == "most-pods":
+        key = -scores.pods.astype(jnp.float32)
+    elif strategy == "least-nodes":
+        key = scores.nodes.astype(jnp.float32)
+    elif strategy == "price":
+        key = scores.price
+    elif strategy in ("least-waste", "waste"):
+        key = scores.waste
+    elif strategy == "random":
+        # Deterministic stand-in: first valid option. The host-side expander
+        # package provides true randomness (expander/random.py).
+        key = jnp.zeros_like(scores.waste)
+    else:
+        raise ValueError(f"unknown expander strategy {strategy!r}")
+    key = jnp.where(scores.valid, key, _INF)
+    idx = jnp.argmin(key).astype(jnp.int32)
+    return jnp.where(scores.valid.any(), idx, -1)

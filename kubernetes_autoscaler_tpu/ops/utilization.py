@@ -1,0 +1,44 @@
+"""Per-node utilization for scale-down eligibility.
+
+Reference counterpart: simulator/utilization/info.go:50-58 — dominant-resource
+utilization (max of cpu, memory; GPU-only on GPU nodes), consumed by the
+eligibility filter (core/scaledown/eligibility/eligibility.go) against
+per-nodegroup thresholds.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_autoscaler_tpu.models.cluster_state import NodeTensors
+from kubernetes_autoscaler_tpu.models.resources import CPU, MEMORY, NUM_STANDARD
+
+
+def node_utilization(nodes: NodeTensors, gpu_slot: jnp.ndarray | None = None) -> jnp.ndarray:
+    """f32[N] dominant-resource utilization in [0, 1].
+
+    gpu_slot: optional i32 scalar — when a node has capacity in that extended
+    slot, its utilization is that slot's ratio alone (reference GPU rule:
+    utilization/info.go gpu branch)."""
+    cap = nodes.cap.astype(jnp.float32)
+    alloc = nodes.alloc.astype(jnp.float32)
+    ratio = alloc / jnp.maximum(cap, 1.0)
+    util = jnp.maximum(ratio[:, CPU], ratio[:, MEMORY])
+    if gpu_slot is not None:
+        gpu_cap = jnp.take_along_axis(cap, gpu_slot[None, None].repeat(cap.shape[0], 0), axis=1)[:, 0]
+        gpu_ratio = jnp.take_along_axis(ratio, gpu_slot[None, None].repeat(cap.shape[0], 0), axis=1)[:, 0]
+        util = jnp.where(gpu_cap > 0, gpu_ratio, util)
+    return jnp.where(nodes.valid, util, 0.0)
+
+
+def eligible_for_scale_down(
+    nodes: NodeTensors,
+    threshold: float | jnp.ndarray,
+    gpu_slot: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """bool[N]: utilization below threshold and node is a live candidate.
+
+    threshold may be a scalar or f32[N] (per-nodegroup overrides, reference
+    NodeGroupConfigProcessor → ScaleDownUtilizationThreshold)."""
+    util = node_utilization(nodes, gpu_slot)
+    return nodes.valid & nodes.ready & (util < threshold)

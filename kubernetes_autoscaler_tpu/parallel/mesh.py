@@ -1,0 +1,90 @@
+"""Device-mesh sharding of the simulation tensors.
+
+The reference's intra-process parallelism is goroutine fan-out over the node
+scan (plugin_runner.go:135 `workqueue.ParallelizeUntil`, √n chunking) and
+per-nodegroup scale-up goroutines (executor.go:96-143). The TPU equivalent
+(SURVEY.md §2.9 mapping) shards the *axes of the simulation tensors* over a
+`jax.sharding.Mesh`:
+
+  * `nodes` axis  — the N dimension of NodeTensors and of every pods×nodes
+    plane (the TP-analog: the predicate mask's contraction axis). Collectives:
+    per-group `any`/`sum` over node shards ride the ICI.
+  * `pods`  axis  — the G dimension of PodGroupTensors (the DP-analog): whole
+    pod-groups evaluated independently per shard.
+
+Multi-host deployments initialize jax.distributed (parallel/multihost.py) and
+the same named shardings span DCN automatically — there is no NCCL/MPI-style
+explicit backend to port (reference has none either; §2.9).
+
+The packing scan's carry (free capacity) is replicated: each scan step reduces
+over the sharded node axis (cumsum) — XLA inserts the collectives. For the
+estimator, node *groups* are independent → sharded over `pods` too.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODES_AXIS = "nodes"
+PODS_AXIS = "pods"
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    nodes_parallel: int | None = None,
+    devices=None,
+) -> Mesh:
+    """Build a (pods, nodes) mesh over the available devices.
+
+    Default factorization puts all devices on the nodes axis (the dominant
+    dimension at reference scale: 5k nodes vs ~hundreds of pod groups)."""
+    devices = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    npar = nodes_parallel or n
+    assert n % npar == 0, f"{n} devices not divisible by nodes_parallel={npar}"
+    arr = np.asarray(devices).reshape(n // npar, npar)
+    return Mesh(arr, (PODS_AXIS, NODES_AXIS))
+
+
+def cluster_shardings(mesh: Mesh):
+    """NamedShardings for (NodeTensors, PodGroupTensors, ScheduledPodTensors,
+    NodeGroupTensors) — leading axis of node tensors over NODES_AXIS, leading
+    axis of pod/group tensors over PODS_AXIS, templates replicated."""
+
+    def node_spec(ndim):
+        return NamedSharding(mesh, P(NODES_AXIS, *([None] * (ndim - 1))))
+
+    def pod_spec(ndim):
+        return NamedSharding(mesh, P(PODS_AXIS, *([None] * (ndim - 1))))
+
+    repl = NamedSharding(mesh, P())
+    return node_spec, pod_spec, repl
+
+
+def shard_cluster(cluster, mesh: Mesh):
+    """Place a ClusterTensors pytree according to cluster_shardings.
+
+    Shapes must be divisible by the axis sizes — encode.py's bucket padding
+    (pad_to) guarantees this for bucket ≥ mesh axis size."""
+    node_spec, pod_spec, repl = cluster_shardings(mesh)
+
+    def place(path_leaf):
+        kind, leaf = path_leaf
+        if kind == "node":
+            return jax.device_put(leaf, node_spec(leaf.ndim))
+        if kind == "pod":
+            return jax.device_put(leaf, pod_spec(leaf.ndim))
+        return jax.device_put(leaf, repl)
+
+    nodes = jax.tree_util.tree_map(lambda x: place(("node", x)), cluster.nodes)
+    pending = jax.tree_util.tree_map(lambda x: place(("pod", x)), cluster.pending)
+    # scheduled pods index into nodes/groups arbitrarily → replicate for now
+    scheduled = jax.tree_util.tree_map(lambda x: place(("repl", x)), cluster.scheduled)
+    groups = jax.tree_util.tree_map(lambda x: place(("repl", x)), cluster.groups)
+    return cluster.replace(nodes=nodes, pending=pending, scheduled=scheduled, groups=groups)

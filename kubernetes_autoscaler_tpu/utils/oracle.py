@@ -1,0 +1,128 @@
+"""Serial exact predicate oracle — the test-time ground truth.
+
+Plays the role the reference's Go path plays for its TPU sidecar (SURVEY.md §4
+'oracle-checked against a serial reference implementation'): a direct,
+unvectorized implementation of the simulable Filter subset with full string
+semantics. The device kernels (ops/predicates.py) are property-tested against
+this module; the control plane also uses it to exactly verify selected
+winners before actuation (the host-check tier for lossy encodings).
+
+Semantics distilled from the vendored kube-scheduler plugins the reference
+runs (simulator/framework/handle.go:84-89 builds the in-tree registry):
+NodeResourcesFit, NodeAffinity, TaintToleration, NodePorts, NodeUnschedulable.
+"""
+
+from __future__ import annotations
+
+from kubernetes_autoscaler_tpu.models import resources as res
+from kubernetes_autoscaler_tpu.models.api import (
+    NO_EXECUTE,
+    NO_SCHEDULE,
+    TO_BE_DELETED_TAINT,
+    Node,
+    Pod,
+)
+from kubernetes_autoscaler_tpu.models.encode import (
+    node_capacity_vector,
+    pod_request_vector,
+)
+
+
+def resources_fit(pod: Pod, node: Node,
+                  registry: res.ExtendedResourceRegistry | None = None) -> bool:
+    """Fit vs an empty node (resident-pod usage is handled in check_pod_on_node)."""
+    registry = registry or res.ExtendedResourceRegistry()
+    cap = node_capacity_vector(node, registry).astype(int)
+    req, _ = pod_request_vector(pod, registry)
+    return bool((req.astype(int) <= cap).all())
+
+
+def selector_matches(pod: Pod, node: Node) -> bool:
+    for k, v in pod.node_selector.items():
+        if node.labels.get(k) != v:
+            return False
+    for r in pod.required_node_affinity:
+        if r.operator == "In":
+            if node.labels.get(r.key) not in r.values:
+                return False
+        elif r.operator == "NotIn":
+            if node.labels.get(r.key) in r.values:
+                return False
+        elif r.operator == "Exists":
+            if r.key not in node.labels:
+                return False
+        elif r.operator == "DoesNotExist":
+            if r.key in node.labels:
+                return False
+        else:
+            raise NotImplementedError(f"operator {r.operator}")
+    return True
+
+
+def taints_tolerated(pod: Pod, node: Node) -> bool:
+    for t in node.taints:
+        if t.effect not in (NO_SCHEDULE, NO_EXECUTE):
+            continue
+        tolerated = False
+        for tol in pod.tolerations:
+            if tol.effect and tol.effect != t.effect:
+                continue
+            if tol.operator == "Exists":
+                if not tol.key or tol.key == t.key:
+                    tolerated = True
+                    break
+            else:
+                if tol.key == t.key and tol.value == t.value:
+                    tolerated = True
+                    break
+        if not tolerated:
+            return False
+    return True
+
+
+def ports_free(pod: Pod, pods_on_node: list[Pod]) -> bool:
+    wanted = {(p, proto or "TCP") for p, proto in pod.host_ports}
+    if not wanted:
+        return True
+    used = set()
+    for q in pods_on_node:
+        used.update((p, proto or "TCP") for p, proto in q.host_ports)
+    return not (wanted & used)
+
+
+def node_schedulable(node: Node) -> bool:
+    if node.unschedulable or not node.ready:
+        return False
+    return all(t.key != TO_BE_DELETED_TAINT for t in node.taints)
+
+
+def check_pod_on_node(
+    pod: Pod,
+    node: Node,
+    pods_on_node: list[Pod],
+    registry: res.ExtendedResourceRegistry | None = None,
+) -> bool:
+    """Exact verdict: can `pod` schedule on `node` given its resident pods?"""
+    registry = registry or res.ExtendedResourceRegistry()
+    if not node_schedulable(node):
+        return False
+    if not selector_matches(pod, node):
+        return False
+    if not taints_tolerated(pod, node):
+        return False
+    if not ports_free(pod, pods_on_node):
+        return False
+    cap = node_capacity_vector(node, registry).astype(int)
+    used = sum(
+        (pod_request_vector(q, registry)[0].astype(int) for q in pods_on_node),
+        start=cap * 0,
+    )
+    req, _ = pod_request_vector(pod, registry)
+    if not bool((req.astype(int) <= cap - used).all()):
+        return False
+    for term in pod.anti_affinity:
+        if term.topology_key == "kubernetes.io/hostname":
+            for q in pods_on_node:
+                if all(q.labels.get(k) == v for k, v in term.match_labels.items()):
+                    return False
+    return True

@@ -1,0 +1,20 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX import.
+
+Mirrors the reference's test stance (SURVEY.md §4): pure in-memory fixtures,
+no external services. Multi-chip sharding is validated on virtual devices
+(xla_force_host_platform_device_count) exactly as the driver's
+dryrun_multichip does; real-TPU execution is exercised by bench.py.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override: the session env may point at a real TPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's sitecustomize force-registers the axon TPU backend regardless of
+# JAX_PLATFORMS; the config knob still wins if set before first backend use.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

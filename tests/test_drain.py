@@ -1,0 +1,86 @@
+"""Drain/removal simulation: batched SimulateNodeRemoval equivalent."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.ops.drain import simulate_removals
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def world(nodes, pods, movable_names=None, blocking_names=None):
+    enc = encode_cluster(nodes, pods)
+    movable = np.zeros((enc.scheduled.p,), bool)
+    blocks = np.zeros((enc.scheduled.p,), bool)
+    for j, p in enumerate(enc.scheduled_pods):
+        if blocking_names and p.name in blocking_names:
+            blocks[j] = True
+        elif movable_names is None or p.name in movable_names:
+            movable[j] = True
+    enc.scheduled = enc.scheduled.replace(
+        movable=jnp.asarray(movable), blocks=jnp.asarray(blocks)
+    )
+    return enc
+
+
+def run(enc, candidates):
+    n = enc.nodes.n
+    return simulate_removals(
+        enc.nodes, enc.specs, enc.scheduled,
+        jnp.asarray(candidates, jnp.int32),
+        dest_allowed=jnp.ones((n,), bool),
+        max_pods_per_node=16, chunk=4,
+    )
+
+
+def test_empty_node_is_drainable():
+    nodes = [build_test_node("n1"), build_test_node("n2")]
+    enc = world(nodes, [])
+    r = run(enc, [0, 1])
+    assert bool(r.drainable[0]) and bool(r.drainable[1])
+    assert int(r.n_moved[0]) == 0
+
+
+def test_pods_move_to_other_node():
+    nodes = [build_test_node("n1", cpu_milli=2000, mem_mib=2048),
+             build_test_node("n2", cpu_milli=2000, mem_mib=2048)]
+    pods = [build_test_pod("a", cpu_milli=500, mem_mib=256, node_name="n1"),
+            build_test_pod("b", cpu_milli=500, mem_mib=256, node_name="n1")]
+    enc = world(nodes, pods)
+    r = run(enc, [0])
+    assert bool(r.drainable[0])
+    assert int(r.n_moved[0]) == 2
+    dests = np.asarray(r.dest_node[0])
+    assert set(dests[dests >= 0]) == {1}
+
+
+def test_no_capacity_elsewhere_blocks_drain():
+    nodes = [build_test_node("n1", cpu_milli=2000, mem_mib=2048),
+             build_test_node("n2", cpu_milli=600, mem_mib=2048)]
+    pods = [build_test_pod("a", cpu_milli=1000, mem_mib=256, node_name="n1")]
+    enc = world(nodes, pods)
+    r = run(enc, [0])
+    assert not bool(r.drainable[0])
+    assert int(r.n_failed[0]) == 1
+
+
+def test_blocking_pod_prevents_drain():
+    nodes = [build_test_node("n1"), build_test_node("n2")]
+    pods = [build_test_pod("a", cpu_milli=10, mem_mib=16, node_name="n1")]
+    enc = world(nodes, pods, blocking_names={"a"})
+    r = run(enc, [0])
+    assert not bool(r.drainable[0])
+    assert bool(r.has_blocker[0])
+
+
+def test_capacity_contention_between_moved_pods():
+    # Two 800m pods on n1; destination n2 only holds one → not drainable.
+    nodes = [build_test_node("n1", cpu_milli=2000, mem_mib=2048),
+             build_test_node("n2", cpu_milli=1000, mem_mib=2048)]
+    pods = [build_test_pod("a", cpu_milli=800, mem_mib=64, node_name="n1"),
+            build_test_pod("b", cpu_milli=800, mem_mib=64, node_name="n1")]
+    enc = world(nodes, pods)
+    r = run(enc, [0])
+    assert not bool(r.drainable[0])
+    assert int(r.n_moved[0]) == 1
+    assert int(r.n_failed[0]) == 1

@@ -1,0 +1,97 @@
+"""Drainability rule chain (reference: simulator/drainability/rules/)."""
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.api import SAFE_TO_EVICT_KEY, OwnerRef
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
+    DrainOptions,
+    Verdict,
+    apply_drainability,
+    classify_pod,
+)
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def pod(**kw):
+    return build_test_pod("p", cpu_milli=100, mem_mib=64, node_name="n1", **kw)
+
+
+def test_replicated_pod_drains():
+    assert classify_pod(pod(owner_kind="ReplicaSet")) is Verdict.DRAIN
+
+
+def test_naked_pod_blocks():
+    assert classify_pod(pod(owner_kind="")) is Verdict.BLOCK
+
+
+def test_daemonset_skips():
+    assert classify_pod(pod(owner_kind="DaemonSet")) is Verdict.SKIP
+
+
+def test_mirror_skips():
+    p = pod(owner_kind="")
+    p.annotations["kubernetes.io/config.mirror"] = "x"
+    assert classify_pod(p) is Verdict.SKIP
+
+
+def test_terminal_skips():
+    p = pod(owner_kind="ReplicaSet")
+    p.phase = "Succeeded"
+    assert classify_pod(p) is Verdict.SKIP
+
+
+def test_safe_to_evict_overrides():
+    p = pod(owner_kind="")
+    p.annotations[SAFE_TO_EVICT_KEY] = "true"
+    assert classify_pod(p) is Verdict.DRAIN
+    q = pod(owner_kind="ReplicaSet")
+    q.annotations[SAFE_TO_EVICT_KEY] = "false"
+    assert classify_pod(q) is Verdict.BLOCK
+
+
+def test_system_pod_blocks_without_pdb():
+    p = pod(owner_kind="ReplicaSet", namespace="kube-system")
+    assert classify_pod(p) is Verdict.BLOCK
+    assert classify_pod(p, has_pdb=True) is Verdict.DRAIN
+    assert classify_pod(
+        p, DrainOptions(skip_nodes_with_system_pods=False)
+    ) is Verdict.DRAIN
+
+
+def test_local_storage_blocks():
+    p = pod(owner_kind="ReplicaSet")
+    p.volumes_with_local_storage = 1
+    assert classify_pod(p) is Verdict.BLOCK
+    assert classify_pod(
+        p, DrainOptions(skip_nodes_with_local_storage=False)
+    ) is Verdict.DRAIN
+
+
+def test_custom_controller_opt_out():
+    p = pod(owner_kind="CloneSet")
+    assert classify_pod(p) is Verdict.BLOCK
+    assert classify_pod(
+        p, DrainOptions(skip_nodes_with_custom_controller_pods=True)
+    ) is Verdict.DRAIN
+
+
+def test_apply_drainability_fills_tensors():
+    nodes = [build_test_node("n1")]
+    pods = [
+        build_test_pod("rs", cpu_milli=10, mem_mib=16, node_name="n1"),
+        build_test_pod("naked", cpu_milli=10, mem_mib=16, node_name="n1",
+                       owner_kind=""),
+        build_test_pod("ds", cpu_milli=10, mem_mib=16, node_name="n1",
+                       owner_kind="DaemonSet"),
+    ]
+    enc = encode_cluster(nodes, pods)
+    # pre-rules: conservative — everything blocks
+    assert np.asarray(enc.scheduled.blocks)[: 3].all()
+    apply_drainability(enc)
+    by_name = {p.name: j for j, p in enumerate(enc.scheduled_pods)}
+    mv = np.asarray(enc.scheduled.movable)
+    bl = np.asarray(enc.scheduled.blocks)
+    assert mv[by_name["rs"]] and not bl[by_name["rs"]]
+    assert bl[by_name["naked"]] and not mv[by_name["naked"]]
+    assert not mv[by_name["ds"]] and not bl[by_name["ds"]]

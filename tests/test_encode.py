@@ -1,0 +1,66 @@
+"""Host→tensor lowering invariants."""
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models import resources as res
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster, equivalence_key
+from kubernetes_autoscaler_tpu.utils.hashing import fnv1a64, fold32
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def test_fold32_stable_and_nonzero():
+    assert fold32("abc") == fold32("abc")
+    assert fold32("abc") != fold32("abd")
+    assert fold32("") != 0
+    assert fnv1a64("cluster-autoscaler") == fnv1a64(b"cluster-autoscaler")
+
+
+def test_capacity_and_alloc_accounting():
+    nodes = [build_test_node("n1", cpu_milli=4000, mem_mib=8192, pods=50)]
+    pods = [build_test_pod("a", cpu_milli=250, mem_mib=100, node_name="n1"),
+            build_test_pod("b", cpu_milli=150, mem_mib=200, node_name="n1"),
+            build_test_pod("c", cpu_milli=100, mem_mib=300)]
+    enc = encode_cluster(nodes, pods)
+    cap = np.asarray(enc.nodes.cap)[0]
+    alloc = np.asarray(enc.nodes.alloc)[0]
+    assert cap[res.CPU] == 4000 and cap[res.MEMORY] == 8192 and cap[res.PODS] == 50
+    assert alloc[res.CPU] == 400 and alloc[res.MEMORY] == 300 and alloc[res.PODS] == 2
+    assert len(enc.pending_pods) == 1 and len(enc.scheduled_pods) == 2
+
+
+def test_equivalence_grouping_by_owner():
+    pods = [build_test_pod(f"p{i}", cpu_milli=100, mem_mib=64, owner_name="same")
+            for i in range(5)]
+    pods.append(build_test_pod("q", cpu_milli=100, mem_mib=64, owner_name="other"))
+    enc = encode_cluster([], pods)
+    counts = sorted(int(c) for c in np.asarray(enc.specs.count) if c > 0)
+    assert counts == [1, 5]
+
+
+def test_equivalence_key_sensitive_to_spec():
+    a = build_test_pod("a", cpu_milli=100, mem_mib=64, owner_name="o")
+    b = build_test_pod("b", cpu_milli=200, mem_mib=64, owner_name="o")
+    assert equivalence_key(a) != equivalence_key(b)
+
+
+def test_extended_resources_mapped():
+    nodes = [build_test_node("g1", cpu_milli=8000, mem_mib=16384, gpus=4)]
+    pods = [build_test_pod("p", cpu_milli=100, mem_mib=64, gpus=2)]
+    enc = encode_cluster(nodes, pods)
+    slot = enc.registry.slots["nvidia.com/gpu"]
+    assert np.asarray(enc.nodes.cap)[0, slot] == 4
+    g = next(g for g, idxs in enumerate(enc.group_pods) if idxs)
+    assert np.asarray(enc.specs.req)[g, slot] == 2
+
+
+def test_rounding_is_conservative():
+    # 100.5 MiB request rounds up; capacity 1023.9 MiB rounds down.
+    mib = 1024 * 1024
+    pod = build_test_pod("p", cpu_milli=100, mem_mib=0)
+    pod.requests["memory"] = 100.5 * mib
+    node = build_test_node("n", cpu_milli=1000, mem_mib=0)
+    node.capacity["memory"] = node.allocatable["memory"] = 1023.9 * mib
+    enc = encode_cluster([node], [pod])
+    g = next(g for g, idxs in enumerate(enc.group_pods) if idxs)
+    assert np.asarray(enc.specs.req)[g, res.MEMORY] == 101
+    assert np.asarray(enc.nodes.cap)[0, res.MEMORY] == 1023

@@ -1,0 +1,149 @@
+"""Predicate-kernel correctness: device mask vs exact host oracle.
+
+Reference analog: simulator/clustersnapshot/predicate tests
+(predicate_snapshot_test.go) exercising CheckPredicates/SchedulePod semantics.
+"""
+
+import random
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.api import NodeSelectorRequirement, Taint, Toleration
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.ops.predicates import feasibility_mask
+from kubernetes_autoscaler_tpu.utils import oracle
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def mask_for(nodes, pods):
+    enc = encode_cluster(nodes, pods)
+    mask = np.asarray(feasibility_mask(enc.nodes, enc.specs))
+    return enc, mask
+
+
+def test_resources_and_readiness():
+    nodes = [
+        build_test_node("n-big", cpu_milli=4000, mem_mib=8192),
+        build_test_node("n-small", cpu_milli=500, mem_mib=512),
+        build_test_node("n-unready", cpu_milli=4000, mem_mib=8192, ready=False),
+    ]
+    pods = [build_test_pod("p", cpu_milli=1000, mem_mib=1024)]
+    enc, mask = mask_for(nodes, pods)
+    g = enc.group_pods.index([0])
+    assert mask[g, 0]          # fits big
+    assert not mask[g, 1]      # too small
+    assert not mask[g, 2]      # unready
+
+
+def test_node_selector_and_affinity():
+    nodes = [
+        build_test_node("n1", labels={"disk": "ssd", "pool": "a"}),
+        build_test_node("n2", labels={"disk": "hdd", "pool": "a"}),
+        build_test_node("n3", labels={"pool": "b"}),
+    ]
+    sel_pod = build_test_pod("sel", cpu_milli=10, mem_mib=10, node_selector={"disk": "ssd"})
+    aff_pod = build_test_pod("aff", cpu_milli=10, mem_mib=10)
+    aff_pod.required_node_affinity = [
+        NodeSelectorRequirement(key="disk", operator="In", values=("ssd", "hdd"))
+    ]
+    neg_pod = build_test_pod("neg", cpu_milli=10, mem_mib=10)
+    neg_pod.required_node_affinity = [
+        NodeSelectorRequirement(key="disk", operator="DoesNotExist")
+    ]
+    enc, mask = mask_for(nodes, [sel_pod, aff_pod, neg_pod])
+    m = {enc.pending_pods[i].name: mask[g] for g, idxs in enumerate(enc.group_pods)
+         for i in idxs}
+    assert list(m["sel"][:3]) == [True, False, False]
+    assert list(m["aff"][:3]) == [True, True, False]
+    assert list(m["neg"][:3]) == [False, False, True]
+
+
+def test_taints_and_tolerations():
+    nodes = [
+        build_test_node("clean"),
+        build_test_node("tainted", taints=[Taint("dedicated", "gpu", "NoSchedule")]),
+        build_test_node("executed", taints=[Taint("maint", "", "NoExecute")]),
+    ]
+    plain = build_test_pod("plain", cpu_milli=10, mem_mib=10)
+    equal = build_test_pod("equal", cpu_milli=10, mem_mib=10,
+                           tolerations=[Toleration(key="dedicated", operator="Equal",
+                                                   value="gpu", effect="NoSchedule")])
+    exists = build_test_pod("exists", cpu_milli=10, mem_mib=10,
+                            tolerations=[Toleration(key="maint", operator="Exists")])
+    super_tol = build_test_pod("super", cpu_milli=10, mem_mib=10,
+                               tolerations=[Toleration(operator="Exists")])
+    enc, mask = mask_for(nodes, [plain, equal, exists, super_tol])
+    m = {enc.pending_pods[i].name: mask[g] for g, idxs in enumerate(enc.group_pods)
+         for i in idxs}
+    assert list(m["plain"][:3]) == [True, False, False]
+    assert list(m["equal"][:3]) == [True, True, False]
+    assert list(m["exists"][:3]) == [True, False, True]
+    assert list(m["super"][:3]) == [True, True, True]
+
+
+def test_host_ports_conflict():
+    nodes = [build_test_node("n1"), build_test_node("n2")]
+    resident = build_test_pod("res", cpu_milli=10, mem_mib=10, node_name="n1", host_port=8080)
+    wants = build_test_pod("want", cpu_milli=10, mem_mib=10, host_port=8080)
+    enc, mask = mask_for(nodes, [resident, wants])
+    g = next(g for g, idxs in enumerate(enc.group_pods) if idxs)
+    assert not mask[g, 0]
+    assert mask[g, 1]
+
+
+def test_alloc_accounts_resident_pods():
+    nodes = [build_test_node("n1", cpu_milli=1000, mem_mib=1024)]
+    resident = build_test_pod("res", cpu_milli=800, mem_mib=100, node_name="n1")
+    pending = build_test_pod("pend", cpu_milli=300, mem_mib=100)
+    enc, mask = mask_for(nodes, [resident, pending])
+    g = next(g for g, idxs in enumerate(enc.group_pods) if idxs)
+    assert not mask[g, 0]  # 800m used, 300m doesn't fit in 200m
+
+
+def test_randomized_against_oracle():
+    rng = random.Random(7)
+    zones = ["za", "zb", ""]
+    nodes = []
+    for i in range(24):
+        taints = []
+        if rng.random() < 0.3:
+            taints.append(Taint("dedicated", rng.choice(["a", "b"]), "NoSchedule"))
+        nodes.append(
+            build_test_node(
+                f"n{i}",
+                cpu_milli=rng.choice([500, 1000, 4000]),
+                mem_mib=rng.choice([512, 2048, 8192]),
+                labels={"disk": rng.choice(["ssd", "hdd"]), "pool": rng.choice(["a", "b"])},
+                taints=taints,
+                zone=rng.choice(zones),
+                ready=rng.random() > 0.1,
+            )
+        )
+    pods = []
+    for i in range(40):
+        tol = []
+        if rng.random() < 0.4:
+            tol.append(Toleration(key="dedicated", operator="Equal",
+                                  value=rng.choice(["a", "b"]), effect="NoSchedule"))
+        if rng.random() < 0.2:
+            tol.append(Toleration(key="dedicated", operator="Exists"))
+        sel = {}
+        if rng.random() < 0.4:
+            sel["disk"] = rng.choice(["ssd", "hdd"])
+        pods.append(
+            build_test_pod(
+                f"p{i}",
+                cpu_milli=rng.choice([100, 600, 2000]),
+                mem_mib=rng.choice([64, 1024, 4096]),
+                node_selector=sel,
+                tolerations=tol,
+                owner_name=f"own{i}",  # unique → one group per pod
+            )
+        )
+    enc, mask = mask_for(nodes, pods)
+    for g, idxs in enumerate(enc.group_pods):
+        for i in idxs:
+            pod = enc.pending_pods[i]
+            for ni, node in enumerate(nodes):
+                expect = oracle.check_pod_on_node(pod, node, [])
+                assert bool(mask[g, ni]) == expect, (pod.name, node.name)

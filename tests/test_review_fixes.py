@@ -1,0 +1,108 @@
+"""Regression tests for the round-1 code-review findings."""
+
+import numpy as np
+import pytest
+
+from kubernetes_autoscaler_tpu.models import resources as res
+from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS, Dims
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster, encode_node_groups
+from kubernetes_autoscaler_tpu.ops.binpack import estimate_all
+from kubernetes_autoscaler_tpu.ops.schedule import schedule_pending_on_existing
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def test_hostport_group_capped_one_per_node():
+    # 10 identical pods wanting hostPort 8080 onto 2 empty nodes: only 2 fit.
+    nodes = [build_test_node(f"n{i}", cpu_milli=8000, mem_mib=8192) for i in range(2)]
+    pods = [build_test_pod(f"p{i}", cpu_milli=100, mem_mib=64, owner_name="rs",
+                           host_port=8080) for i in range(10)]
+    enc = encode_cluster(nodes, pods)
+    r = schedule_pending_on_existing(enc.nodes, enc.specs, enc.scheduled)
+    g = next(g for g, idxs in enumerate(enc.group_pods) if idxs)
+    assert int(r.scheduled[g]) == 2
+    # ...and the estimator opens one node per pod.
+    tmpl = build_test_node("t", cpu_milli=8000, mem_mib=8192)
+    groups = encode_node_groups([(tmpl, 20, 1.0)], enc.registry, enc.zone_table)
+    est = estimate_all(enc.specs, groups, DEFAULT_DIMS, 32)
+    assert int(est.node_count[0]) == 10
+
+
+def test_terminal_pods_ignored():
+    nodes = [build_test_node("n1", cpu_milli=1000, mem_mib=1024)]
+    done = build_test_pod("done", cpu_milli=900, mem_mib=900, node_name="n1")
+    done.phase = "Succeeded"
+    failed = build_test_pod("failed", cpu_milli=900, mem_mib=900)
+    failed.phase = "Failed"
+    enc = encode_cluster(nodes, [done, failed])
+    assert np.asarray(enc.nodes.alloc)[0].sum() == 0     # no charge
+    assert int(np.asarray(enc.specs.count).sum()) == 0   # no pending group
+    assert not enc.scheduled_pods and not enc.pending_pods
+
+
+def test_cpu_request_rounds_up():
+    assert res.cpu_request_to_milli(0.0004) == 1
+    assert res.cpu_request_to_milli(1.4004) == 1401
+    assert res.cpu_request_to_milli(0.5) == 500
+    assert res.cpu_capacity_to_milli(1.9999) == 1999
+
+
+def test_registry_exhaustion_flags_host_check():
+    pods = []
+    for i in range(6):  # 6 distinct extended resources > 4 slots
+        p = build_test_pod(f"p{i}", cpu_milli=10, mem_mib=16, owner_name=f"o{i}")
+        p.requests[f"vendor{i}.com/dev"] = 1
+        pods.append(p)
+    enc = encode_cluster([], pods)  # must not raise
+    flagged = np.asarray(enc.specs.needs_host_check)
+    valid = np.asarray(enc.specs.valid)
+    assert flagged[valid].sum() == 2  # the two overflowing specs
+
+
+def test_node_label_overflow_raises():
+    node = build_test_node("n", labels={f"k{i}": "v" for i in range(40)})
+    with pytest.raises(ValueError, match="max_labels"):
+        encode_cluster([node], [], dims=Dims(max_labels=16))
+
+
+def test_unclassified_snapshot_never_drainable():
+    import jax.numpy as jnp
+
+    from kubernetes_autoscaler_tpu.ops.drain import simulate_removals
+
+    nodes = [build_test_node("n1"), build_test_node("n2")]
+    pods = [build_test_pod("a", cpu_milli=10, mem_mib=16, node_name="n1")]
+    enc = encode_cluster(nodes, pods)  # no apply_drainability
+    r = simulate_removals(
+        enc.nodes, enc.specs, enc.scheduled,
+        jnp.asarray([0], jnp.int32), jnp.ones((enc.nodes.n,), bool),
+        max_pods_per_node=8, chunk=2,
+    )
+    assert not bool(r.drainable[0])
+
+
+def test_drain_sibling_anti_affinity_not_stacked():
+    import jax.numpy as jnp
+
+    from kubernetes_autoscaler_tpu.models.api import AffinityTerm
+    from kubernetes_autoscaler_tpu.ops.drain import simulate_removals
+    from kubernetes_autoscaler_tpu.simulator.drainability.rules import apply_drainability
+
+    # Two anti-affinity siblings on n1; destinations n2/n3 empty → must split.
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=4096) for i in range(1, 4)]
+    pods = []
+    for i in range(2):
+        p = build_test_pod(f"s{i}", cpu_milli=100, mem_mib=64, node_name="n1",
+                           owner_name="rs", labels={"app": "web"})
+        p.anti_affinity = [AffinityTerm(match_labels={"app": "web"})]
+        pods.append(p)
+    enc = encode_cluster(nodes, pods)
+    apply_drainability(enc)
+    r = simulate_removals(
+        enc.nodes, enc.specs, enc.scheduled,
+        jnp.asarray([0], jnp.int32), jnp.ones((enc.nodes.n,), bool),
+        max_pods_per_node=8, chunk=2,
+    )
+    assert bool(r.drainable[0])
+    dests = np.asarray(r.dest_node[0])
+    dests = dests[dests >= 0]
+    assert len(dests) == 2 and len(set(dests)) == 2  # spread across n2, n3

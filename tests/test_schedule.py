@@ -1,0 +1,33 @@
+"""filter-out-schedulable equivalent: packing pending pods onto existing capacity."""
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.ops.schedule import schedule_pending_on_existing
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def test_pending_absorbed_by_free_capacity():
+    nodes = [build_test_node("n1", cpu_milli=2000, mem_mib=4096),
+             build_test_node("n2", cpu_milli=2000, mem_mib=4096)]
+    resident = [build_test_pod("r1", cpu_milli=1500, mem_mib=512, node_name="n1")]
+    pending = [build_test_pod(f"p{i}", cpu_milli=900, mem_mib=256, owner_name="rs")
+               for i in range(3)]
+    enc = encode_cluster(nodes, resident + pending)
+    res = schedule_pending_on_existing(enc.nodes, enc.specs, enc.scheduled)
+    g = next(g for g, idxs in enumerate(enc.group_pods) if idxs)
+    # n1 has 500m free → 0 fit; n2 has 2000m → 2 fit. One pod remains pending.
+    assert int(res.scheduled[g]) == 2
+    placed = np.asarray(res.placed[g])
+    assert placed[0] == 0 and placed[1] == 2
+
+
+def test_first_fit_spills_across_nodes():
+    nodes = [build_test_node(f"n{i}", cpu_milli=1000, mem_mib=1024) for i in range(4)]
+    pending = [build_test_pod(f"p{i}", cpu_milli=600, mem_mib=128, owner_name="rs")
+               for i in range(4)]
+    enc = encode_cluster(nodes, pending)
+    res = schedule_pending_on_existing(enc.nodes, enc.specs, enc.scheduled)
+    g = next(g for g, idxs in enumerate(enc.group_pods) if idxs)
+    assert int(res.scheduled[g]) == 4
+    assert list(np.asarray(res.placed[g])[:4]) == [1, 1, 1, 1]
