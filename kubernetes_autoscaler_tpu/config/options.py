@@ -1,0 +1,78 @@
+"""AutoscalingOptions: the framework's single configuration bag.
+
+Reference counterpart: config/autoscaling_options.go:107 (~120 fields fed by
+~125 pflags, config/flags/flags.go). Field names keep the reference's meaning;
+durations are seconds (floats) instead of time.Duration. config/flags.py maps
+CLI flags onto this dataclass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NodeGroupDefaults:
+    """Per-nodegroup defaults, overridable via NodeGroup.get_options
+    (reference: config.NodeGroupAutoscalingOptions)."""
+
+    scale_down_utilization_threshold: float = 0.5
+    scale_down_gpu_utilization_threshold: float = 0.5
+    scale_down_unneeded_time_s: float = 600.0
+    scale_down_unready_time_s: float = 1200.0
+    max_node_provision_time_s: float = 900.0
+    ignore_daemonsets_utilization: bool = False
+
+
+@dataclass
+class AutoscalingOptions:
+    # loop
+    scan_interval_s: float = 10.0
+
+    # scale-up
+    estimator: str = "binpacking"                  # reference: estimator.go:53 (sole impl)
+    expander: str = "least-waste"                  # comma-separated chain, reference flags.go
+    max_nodes_per_scaleup: int = 1000              # FAQ.md:1086
+    max_nodes_total: int = 0                       # 0 = unlimited
+    max_cores_total: int = 320000
+    max_memory_total_mib: int = 32 * 10**6
+    balance_similar_node_groups: bool = False
+    new_pod_scale_up_delay_s: float = 0.0
+    expendable_pods_priority_cutoff: int = -10
+    max_binpacking_time_s: float = 5 * 60.0
+
+    # scale-down
+    scale_down_enabled: bool = True
+    scale_down_delay_after_add_s: float = 600.0
+    scale_down_delay_after_delete_s: float = 0.0
+    scale_down_delay_after_failure_s: float = 180.0
+    scale_down_candidates_pool_ratio: float = 1.0
+    scale_down_candidates_pool_min_count: int = 50
+    max_scale_down_parallelism: int = 10
+    max_drain_parallelism: int = 1
+    max_empty_bulk_delete: int = 10
+    max_graceful_termination_s: float = 600.0
+    skip_nodes_with_system_pods: bool = True
+    skip_nodes_with_local_storage: bool = True
+    skip_nodes_with_custom_controller_pods: bool = False
+    min_replica_count: int = 0
+
+    # cluster health (reference: clusterstate config)
+    max_total_unready_percentage: float = 45.0
+    ok_total_unready_count: int = 3
+    max_node_startup_time_s: float = 15 * 60.0
+    unregistered_node_removal_time_s: float = 15 * 60.0
+
+    # backoff (reference: utils/backoff defaults)
+    initial_node_group_backoff_s: float = 5 * 60.0
+    max_node_group_backoff_s: float = 30 * 60.0
+    node_group_backoff_reset_timeout_s: float = 3 * 60 * 60.0
+
+    node_group_defaults: NodeGroupDefaults = field(default_factory=NodeGroupDefaults)
+
+    # TPU data plane
+    max_new_nodes_static: int = 1024               # static bin-pool size per option kernel
+    node_shape_bucket: int = 256                   # compile-cache shape buckets
+    group_shape_bucket: int = 64
+    drain_chunk: int = 32
+    max_pods_per_node: int = 128

@@ -1,0 +1,60 @@
+"""Loop driver: ticks RunOnce, re-running immediately after productive loops.
+
+Reference counterpart: loop/trigger.go:56 LoopTrigger (event-driven wakeup on
+unschedulable-pod events, else scan-interval tick; immediate re-run after a
+productive scale-up/scale-down) and loop/run.go:32 RunAutoscalerOnce (health
+check + metrics wrapper).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from kubernetes_autoscaler_tpu.core.static_autoscaler import (
+    RunOnceStatus,
+    StaticAutoscaler,
+)
+
+
+@dataclass
+class LoopTrigger:
+    scan_interval_s: float = 10.0
+
+    def __post_init__(self):
+        self._event = threading.Event()
+
+    def poke(self) -> None:
+        """Unschedulable-pod observer hook (reference: UnschedulablePodObserver)."""
+        self._event.set()
+
+    def wait(self, last_productive: bool) -> None:
+        """reference: LoopTrigger.Wait :75-103 — immediate re-run after a
+        productive loop; otherwise wait for an event or the tick."""
+        if last_productive:
+            return
+        self._event.wait(timeout=self.scan_interval_s)
+        self._event.clear()
+
+
+def run_loop(
+    autoscaler: StaticAutoscaler,
+    trigger: LoopTrigger | None = None,
+    max_iterations: int | None = None,
+    stop: threading.Event | None = None,
+) -> list[RunOnceStatus]:
+    trigger = trigger or LoopTrigger(autoscaler.options.scan_interval_s)
+    history: list[RunOnceStatus] = []
+    productive = False
+    i = 0
+    while (max_iterations is None or i < max_iterations) and not (stop and stop.is_set()):
+        trigger.wait(productive)
+        status = autoscaler.run_once()
+        history.append(status)
+        productive = bool(
+            (status.scale_up and status.scale_up.scaled_up)
+            or status.scale_down_deleted
+        )
+        i += 1
+    return history

@@ -1,0 +1,165 @@
+"""Scale-down actuator: taint, drain, delete.
+
+Reference counterpart: core/scaledown/actuation/ — StartDeletion
+(actuator.go): apply the ToBeDeleted taint, evict pods with per-node goroutine
+parallelism under budgets (budgets.go, --max-scale-down-parallelism /
+--max-drain-parallelism), batch empty-node deletions per group
+(delete_in_batch.go), and track in-flight deletions
+(deletiontracker/nodedeletiontracker.go).
+
+Eviction here goes through the EvictionSink seam (the kube API in the
+reference; the fake cluster in tests; the sidecar's control plane in
+deployment) so the actuator logic is transport-independent.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Protocol
+
+from kubernetes_autoscaler_tpu.cloudprovider.provider import (
+    CloudProvider,
+    NodeGroupError,
+)
+from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
+from kubernetes_autoscaler_tpu.core.scaledown.planner import NodeToRemove
+from kubernetes_autoscaler_tpu.models.api import (
+    DELETION_CANDIDATE_TAINT,
+    TO_BE_DELETED_TAINT,
+    Node,
+    Pod,
+    Taint,
+)
+
+
+class EvictionSink(Protocol):
+    """Where evictions land (reference: the eviction API in actuation/drain.go)."""
+
+    def evict(self, pod: Pod, node: Node) -> None: ...
+
+
+@dataclass
+class DeletionResult:
+    node: str
+    ok: bool
+    reason: str = ""
+
+
+@dataclass
+class NodeDeletionTracker:
+    """reference: deletiontracker/nodedeletiontracker.go — in-flight registry."""
+
+    deleting: dict[str, float] = field(default_factory=dict)
+    results: list[DeletionResult] = field(default_factory=list)
+
+    def start(self, node: str, now: float) -> None:
+        self.deleting[node] = now
+
+    def finish(self, node: str, ok: bool, reason: str = "") -> None:
+        self.deleting.pop(node, None)
+        self.results.append(DeletionResult(node, ok, reason))
+
+    def in_flight(self) -> int:
+        return len(self.deleting)
+
+
+class Actuator:
+    def __init__(
+        self,
+        provider: CloudProvider,
+        options: AutoscalingOptions,
+        eviction_sink: EvictionSink | None = None,
+        on_taint: Callable[[Node, str], None] | None = None,
+    ):
+        self.provider = provider
+        self.options = options
+        self.eviction_sink = eviction_sink
+        self.on_taint = on_taint
+        self.tracker = NodeDeletionTracker()
+
+    # ---- taints (reference: utils/taints/taints.go) ----
+
+    def taint_to_be_deleted(self, node: Node) -> None:
+        if all(t.key != TO_BE_DELETED_TAINT for t in node.taints):
+            node.taints.append(Taint(TO_BE_DELETED_TAINT, str(int(time.time())),
+                                     "NoSchedule"))
+        if self.on_taint:
+            self.on_taint(node, TO_BE_DELETED_TAINT)
+
+    def taint_deletion_candidate(self, node: Node) -> None:
+        """Soft taint marking scale-down intent — the crash-recovery WAL
+        (reference: softtaint.go + planner LoadFromExistingTaints)."""
+        if all(t.key != DELETION_CANDIDATE_TAINT for t in node.taints):
+            node.taints.append(Taint(DELETION_CANDIDATE_TAINT,
+                                     str(int(time.time())), "PreferNoSchedule"))
+        if self.on_taint:
+            self.on_taint(node, DELETION_CANDIDATE_TAINT)
+
+    def untaint(self, node: Node, key: str) -> None:
+        node.taints = [t for t in node.taints if t.key != key]
+
+    # ---- deletion (reference: StartDeletion, actuator.go) ----
+
+    def start_deletion(
+        self,
+        to_remove: list[NodeToRemove],
+        pods_by_slot: dict[int, Pod] | None = None,
+        now: float | None = None,
+    ) -> list[DeletionResult]:
+        now = time.time() if now is None else now
+        empty = [r for r in to_remove if r.is_empty]
+        drain = [r for r in to_remove if not r.is_empty]
+
+        for r in to_remove:
+            self.taint_to_be_deleted(r.node)
+            self.tracker.start(r.node.name, now)
+
+        results: list[DeletionResult] = []
+        # empty nodes: batched per group (reference: delete_in_batch.go)
+        by_group: dict[str, list[NodeToRemove]] = {}
+        for r in empty:
+            g = self.provider.node_group_for_node(r.node)
+            if g is None:
+                self.tracker.finish(r.node.name, False, "NoNodeGroup")
+                continue
+            by_group.setdefault(g.id(), []).append(r)
+        for gid, rs in by_group.items():
+            g = next(x for x in self.provider.node_groups() if x.id() == gid)
+            batch = rs[: self.options.max_empty_bulk_delete]
+            try:
+                g.delete_nodes([r.node for r in batch])
+                for r in batch:
+                    self.tracker.finish(r.node.name, True)
+                    results.append(DeletionResult(r.node.name, True))
+            except NodeGroupError as e:
+                for r in batch:
+                    self.untaint(r.node, TO_BE_DELETED_TAINT)
+                    self.tracker.finish(r.node.name, False, str(e))
+                    results.append(DeletionResult(r.node.name, False, str(e)))
+
+        # drain nodes: parallel per node under the drain budget
+        def drain_one(r: NodeToRemove) -> DeletionResult:
+            try:
+                if self.eviction_sink and pods_by_slot:
+                    for slot in r.pods_to_move:
+                        pod = pods_by_slot.get(slot)
+                        if pod is not None:
+                            self.eviction_sink.evict(pod, r.node)
+                g = self.provider.node_group_for_node(r.node)
+                if g is None:
+                    raise NodeGroupError("no node group")
+                g.delete_nodes([r.node])
+                self.tracker.finish(r.node.name, True)
+                return DeletionResult(r.node.name, True)
+            except NodeGroupError as e:
+                self.untaint(r.node, TO_BE_DELETED_TAINT)
+                self.tracker.finish(r.node.name, False, str(e))
+                return DeletionResult(r.node.name, False, str(e))
+
+        workers = max(self.options.max_drain_parallelism, 1)
+        if drain:
+            with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
+                results.extend(ex.map(drain_one, drain))
+        return results

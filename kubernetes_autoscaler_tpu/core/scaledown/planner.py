@@ -1,0 +1,242 @@
+"""Scale-down planner: decide which nodes are unneeded and ready to remove.
+
+Reference counterpart: core/scaledown/planner/planner.go —
+UpdateClusterState (:120): eligibility screening (eligibility/eligibility.go,
+utilization thresholds), per-node removal simulation (bounded by
+unneededNodesLimit :385 and a wall-clock timeout :297), unneeded-time accrual,
+then NodesToDelete (:151) selecting empty + drainable nodes under quota and
+min-size constraints.
+
+TPU re-design: the entire candidate sweep — utilization, eligibility, and the
+drain simulation for EVERY candidate — is one device program
+(ops/autoscale_step.scale_down_sim); no candidate caps or timeouts are needed.
+The host then runs the greedy confirmation pass over per-candidate results so
+destination capacity is never double-booked (the role the reference's
+commit-on-success sequencing plays, simulator/cluster.go:174-188).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_autoscaler_tpu.cloudprovider.provider import CloudProvider
+from kubernetes_autoscaler_tpu.clusterstate.registry import _ng_defaults
+from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
+from kubernetes_autoscaler_tpu.core.scaledown.unneeded import (
+    UnneededNodes,
+    UnremovableNodes,
+)
+from kubernetes_autoscaler_tpu.models.api import SCALE_DOWN_DISABLED_KEY, Node
+from kubernetes_autoscaler_tpu.models.encode import EncodedCluster
+from kubernetes_autoscaler_tpu.ops import utilization as util_ops
+from kubernetes_autoscaler_tpu.ops.drain import RemovalResult, simulate_removals
+from kubernetes_autoscaler_tpu.resourcequotas.tracker import QuotaTracker
+
+
+@dataclass
+class NodeToRemove:
+    node: Node
+    is_empty: bool
+    pods_to_move: list[int] = field(default_factory=list)   # scheduled-pod slots
+    destinations: dict[int, int] = field(default_factory=dict)  # slot -> node idx
+
+
+@dataclass
+class PlannerState:
+    unneeded: list[str] = field(default_factory=list)
+    utilization: dict[str, float] = field(default_factory=dict)
+    removal: RemovalResult | None = None
+    candidate_indices: np.ndarray | None = None
+
+
+class Planner:
+    def __init__(self, provider: CloudProvider, options: AutoscalingOptions,
+                 quota: QuotaTracker | None = None):
+        self.provider = provider
+        self.options = options
+        self.quota = quota
+        self.unneeded_nodes = UnneededNodes()
+        self.unremovable = UnremovableNodes()
+        self.state = PlannerState()
+
+    # ---- per-loop state update (reference: UpdateClusterState :120) ----
+
+    def update(self, enc: EncodedCluster, nodes: list[Node],
+               now: float | None = None) -> PlannerState:
+        now = time.time() if now is None else now
+        n_real = len(nodes)
+        util = np.asarray(util_ops.node_utilization(enc.nodes))[:n_real]
+        defaults = _ng_defaults(self.options)
+
+        eligible_idx: list[int] = []
+        group_deletable: dict[str, int] = {}
+        for i, nd in enumerate(nodes):
+            self.state.utilization[nd.name] = float(util[i])
+            if nd.annotations.get(SCALE_DOWN_DISABLED_KEY) == "true":
+                self._mark(nd.name, "ScaleDownDisabledAnnotation", now)
+                continue
+            g = self.provider.node_group_for_node(nd)
+            if g is None:
+                self._mark(nd.name, "NotAutoscaled", now)
+                continue
+            room = group_deletable.setdefault(g.id(), g.target_size() - g.min_size())
+            if room <= 0:
+                self._mark(nd.name, "NodeGroupMinSizeReached", now)
+                continue
+            opts = g.get_options(defaults)
+            threshold = (opts.scale_down_utilization_threshold
+                         or defaults.scale_down_utilization_threshold)
+            if nd.ready and util[i] >= threshold:
+                self._mark(nd.name, "NotUnderutilized", now)
+                continue
+            if self.unremovable.contains(nd.name, now):
+                continue
+            group_deletable[g.id()] -= 1
+            eligible_idx.append(i)
+
+        if not eligible_idx:
+            self.state.unneeded = []
+            self.state.removal = None
+            self.unneeded_nodes.update([], now)
+            return self.state
+
+        cand = np.asarray(eligible_idx, dtype=np.int32)
+        dest_allowed = np.ones((enc.nodes.n,), dtype=bool)
+        dest_allowed[cand] = False   # destinations: nodes staying up
+        removal = simulate_removals(
+            enc.nodes, enc.specs, enc.scheduled,
+            jnp.asarray(cand), jnp.asarray(dest_allowed),
+            max_pods_per_node=self.options.max_pods_per_node,
+            chunk=self.options.drain_chunk,
+        )
+        drainable = np.asarray(removal.drainable)
+        unneeded = []
+        for k, i in enumerate(eligible_idx):
+            if drainable[k]:
+                unneeded.append(nodes[i].name)
+            else:
+                reason = ("BlockedByPod" if bool(removal.has_blocker[k])
+                          else "NoPlaceToMovePods")
+                self._mark(nodes[i].name, reason, now)
+        self.unneeded_nodes.update(unneeded, now)
+        self.state.unneeded = unneeded
+        self.state.removal = removal
+        self.state.candidate_indices = cand
+        return self.state
+
+    def _mark(self, name: str, reason: str, now: float) -> None:
+        self.unremovable.add(name, reason, now)
+
+    # ---- final selection (reference: NodesToDelete :151) ----
+
+    def nodes_to_delete(self, enc: EncodedCluster, nodes: list[Node],
+                        now: float | None = None) -> list[NodeToRemove]:
+        now = time.time() if now is None else now
+        if self.state.removal is None or self.state.candidate_indices is None:
+            return []
+        defaults = _ng_defaults(self.options)
+        removal = self.state.removal
+        cand = self.state.candidate_indices
+        drainable = np.asarray(removal.drainable)
+        n_moved = np.asarray(removal.n_moved)
+        dest_node = np.asarray(removal.dest_node)
+        pod_slot = np.asarray(removal.pod_slot)
+        by_index = {int(c): k for k, c in enumerate(cand)}
+        name_to_i = {nd.name: i for i, nd in enumerate(nodes)}
+
+        # Greedy confirmation: walk unneeded nodes (oldest clock first) and
+        # charge their pods' destinations against a host-side free tensor so
+        # two drains can't double-book one destination (reference: the serial
+        # commit-on-success in RemovalSimulator).
+        free = (np.asarray(enc.nodes.cap) - np.asarray(enc.nodes.alloc)).astype(np.int64)
+        reqs = np.asarray(enc.scheduled.req)
+        quota_status = None
+        if self.quota is not None:
+            quota_status = self.quota.status_from_encoded(enc)
+
+        empty_budget = self.options.max_empty_bulk_delete
+        drain_budget = self.options.max_drain_parallelism
+        total_budget = self.options.max_scale_down_parallelism
+        out: list[NodeToRemove] = []
+
+        ordered = sorted(self.state.unneeded, key=lambda n: self.unneeded_nodes.since.get(n, now))
+        group_room: dict[str, int] = {}
+        for name in ordered:
+            if len(out) >= total_budget:
+                break
+            i = name_to_i.get(name)
+            if i is None or i not in by_index:
+                continue
+            k = by_index[i]
+            if not drainable[k]:
+                continue
+            nd = nodes[i]
+            g = self.provider.node_group_for_node(nd)
+            if g is None:
+                continue
+            opts = g.get_options(defaults)
+            unneeded_time = (
+                (opts.scale_down_unneeded_time_s if nd.ready
+                 else opts.scale_down_unready_time_s)
+                or (defaults.scale_down_unneeded_time_s if nd.ready
+                    else defaults.scale_down_unready_time_s)
+            )
+            if not self.unneeded_nodes.removable_at(name, now, unneeded_time):
+                continue
+            room = group_room.setdefault(g.id(), g.target_size() - g.min_size())
+            if room <= 0:
+                self._mark(name, "NodeGroupMinSizeReached", now)
+                continue
+            if quota_status is not None:
+                if not self.quota.nodes_removable(quota_status, nd):
+                    self._mark(name, "MinimalResourceLimitExceeded", now)
+                    continue
+                # deduct this node from the running totals so several removals
+                # in one loop can't jointly breach a min-limit (reference:
+                # the min-quota tracker deducts per confirmed removal)
+                self.quota.deduct(quota_status, nd)
+
+            is_empty = n_moved[k] == 0
+            if is_empty:
+                if empty_budget <= 0:
+                    continue
+            else:
+                if drain_budget <= 0:
+                    continue
+
+            # charge destinations
+            moves: dict[int, int] = {}
+            ok = True
+            for s in range(dest_node.shape[1]):
+                d = int(dest_node[k, s])
+                if d < 0:
+                    continue
+                slot = int(pod_slot[k, s])
+                req = reqs[slot]
+                if (free[d] >= req).all():
+                    free[d] -= req
+                    moves[slot] = d
+                else:
+                    ok = False
+                    break
+            if not ok:
+                # revert charges; try again next loop (destinations taken by an
+                # earlier candidate this round)
+                for slot, d in moves.items():
+                    free[d] += reqs[slot]
+                self._mark(name, "NoPlaceToMovePods", now)
+                continue
+
+            group_room[g.id()] -= 1
+            if is_empty:
+                empty_budget -= 1
+            else:
+                drain_budget -= 1
+            out.append(NodeToRemove(nd, bool(is_empty),
+                                    pods_to_move=list(moves.keys()),
+                                    destinations=moves))
+        return out

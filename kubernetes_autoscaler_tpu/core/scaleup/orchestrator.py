@@ -1,0 +1,293 @@
+"""Scale-up orchestrator: from pending pods to node-group increases.
+
+Reference counterpart: core/scaleup/orchestrator/orchestrator.go —
+`ScaleUp` (:88-203): build equivalence groups, filter valid node groups,
+compute an expansion option per group via the estimator (:379-414), pick via
+the expander (:~1090), balance similar groups (:652), cap by quotas
+(:205-217), then execute increases in parallel (executor.go:63-143).
+
+TPU re-design: option computation for ALL node groups happens in one device
+program (ops/binpack.estimate_all) instead of a serial per-group loop; the
+expander's numeric scores ride the same kernel (ops/scoring). The host layer
+here is pure policy: validity filtering, quota caps, winner verification
+(exact string semantics for lossily-encoded pods), and cloud actuation.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.cloudprovider.provider import (
+    CloudProvider,
+    NodeGroup,
+    NodeGroupError,
+)
+from kubernetes_autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
+from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
+from kubernetes_autoscaler_tpu.estimator.estimator import (
+    BinpackingEstimator,
+    ClusterCapacityThresholdLimiter,
+    SngCapacityThresholdLimiter,
+    StaticThresholdLimiter,
+)
+from kubernetes_autoscaler_tpu.expander.strategies import (
+    ChainStrategy,
+    Option,
+    options_from_scores,
+)
+from kubernetes_autoscaler_tpu.models.encode import (
+    EncodedCluster,
+    encode_node_groups,
+)
+from kubernetes_autoscaler_tpu.ops import scoring
+from kubernetes_autoscaler_tpu.resourcequotas.tracker import QuotaTracker
+from kubernetes_autoscaler_tpu.utils import oracle
+
+
+@dataclass
+class ScaleUpResult:
+    scaled_up: bool
+    increases: dict[str, int] = field(default_factory=dict)
+    errors: dict[str, str] = field(default_factory=dict)
+    pods_helped: int = 0
+    pods_remaining: int = 0
+    considered_options: list[Option] = field(default_factory=list)
+    best: Option | None = None
+
+
+class ScaleUpOrchestrator:
+    def __init__(
+        self,
+        provider: CloudProvider,
+        options: AutoscalingOptions,
+        cluster_state: ClusterStateRegistry,
+        expander: ChainStrategy,
+        quota: QuotaTracker | None = None,
+    ):
+        self.provider = provider
+        self.options = options
+        self.cluster_state = cluster_state
+        self.expander = expander
+        self.quota = quota
+
+    # ---- node-group validity (reference: filterValidScaleUpNodeGroups :152) ----
+
+    def _valid_groups(self, now: float) -> list[NodeGroup]:
+        valid = []
+        for g in self.provider.node_groups():
+            if not g.exist():
+                continue
+            if g.target_size() >= g.max_size():
+                continue
+            if not self.cluster_state.is_node_group_safe_to_scale_up(g, now):
+                continue
+            valid.append(g)
+        return valid
+
+    # ---- the main entry (reference: ScaleUp :88) ----
+
+    def scale_up(self, enc: EncodedCluster, nodes_count: int,
+                 now: float | None = None) -> ScaleUpResult:
+        now = time.time() if now is None else now
+        pending_total = int(np.asarray(enc.specs.count).sum())
+        if pending_total == 0:
+            return ScaleUpResult(scaled_up=False)
+
+        groups = self._valid_groups(now)
+        if not groups:
+            return ScaleUpResult(scaled_up=False, pods_remaining=pending_total)
+
+        estimator = BinpackingEstimator(
+            enc.dims,
+            max_new_nodes_static=self.options.max_new_nodes_static,
+            limiters=[
+                StaticThresholdLimiter(self.options.max_nodes_per_scaleup),
+                ClusterCapacityThresholdLimiter(self.options.max_nodes_total),
+                SngCapacityThresholdLimiter(),
+            ],
+        )
+        templates = [
+            (g.template_node_info(), g.max_size() - g.target_size(),
+             getattr(g, "price_per_node", 1.0))
+            for g in groups
+        ]
+        group_tensors = encode_node_groups(
+            templates, enc.registry, enc.zone_table, enc.dims
+        )
+        est = estimator.estimate_all_groups(enc.specs, group_tensors, nodes_count)
+        scores = scoring.score_options(est, group_tensors)
+        options = options_from_scores(scores, [g.id() for g in groups])
+        options = self._verify_lossy_winners(options, est, enc, groups)
+        if not options:
+            return ScaleUpResult(scaled_up=False, pods_remaining=pending_total,
+                                 considered_options=[])
+
+        best = self.expander.best_option(options)
+        if best is None:
+            return ScaleUpResult(scaled_up=False, pods_remaining=pending_total,
+                                 considered_options=options)
+
+        # similar-group balancing (reference: balanceScaleUps :652 via
+        # BalancingNodeGroupSetProcessor) — split the winning delta across
+        # groups similar to the winner.
+        plan = self._balance(best, groups, est)
+
+        # quota caps (reference: applyLimits :205-217)
+        plan = self._apply_quota(plan, groups, enc)
+        if not plan:
+            return ScaleUpResult(scaled_up=False, pods_remaining=pending_total,
+                                 considered_options=options, best=best)
+
+        result = self._execute(plan, groups, now)
+        result.considered_options = options
+        result.best = best
+        result.pods_helped = best.pod_count
+        result.pods_remaining = max(pending_total - best.pod_count, 0)
+        return result
+
+    # ---- winner verification (the host-check tier) ----
+
+    def _verify_lossy_winners(self, options, est, enc: EncodedCluster, groups):
+        """Exact-check lossily-encoded pod groups against each option's
+        template; drop options that only schedule via encoding artifacts.
+        Plays the role of the reference's real scheduler framework run —
+        predicate truth always comes from exact semantics before actuation."""
+        flagged = np.asarray(enc.specs.needs_host_check)
+        if not flagged.any():
+            return options
+        scheduled = np.asarray(est.scheduled)  # [NG, G]
+        out = []
+        for opt in options:
+            g_t = groups[opt.group_index].template_node_info()
+            ok_pods = opt.pod_count
+            for gi in np.nonzero(flagged)[0]:
+                if scheduled[opt.group_index, gi] <= 0:
+                    continue
+                if gi < len(enc.group_pods) and enc.group_pods[gi]:
+                    exemplar = enc.pending_pods[enc.group_pods[gi][0]]
+                    if not oracle.check_pod_on_node(exemplar, g_t, []):
+                        ok_pods -= int(scheduled[opt.group_index, gi])
+            if ok_pods > 0:
+                if ok_pods != opt.pod_count:
+                    opt = Option(
+                        group_index=opt.group_index, group_id=opt.group_id,
+                        node_count=opt.node_count, pod_count=ok_pods,
+                        waste=opt.waste, price=opt.price,
+                    )
+                out.append(opt)
+        return out
+
+    # ---- similar-group balancing (reference: compare_nodegroups.go:105) ----
+
+    def _balance(self, best: Option, groups: list[NodeGroup], est) -> dict[str, int]:
+        if not self.options.balance_similar_node_groups:
+            return {best.group_id: best.node_count}
+        target = groups[best.group_index]
+        tmpl = target.template_node_info()
+        similar = [target]
+        for i, g in enumerate(groups):
+            if g.id() == target.id():
+                continue
+            t = g.template_node_info()
+            if _similar_templates(tmpl, t) and g.target_size() < g.max_size():
+                similar.append(g)
+        total = best.node_count
+        plan: dict[str, int] = {}
+        # even split honoring current target sizes (fill smallest first);
+        # groups at max size drop out of the rotation, they don't stop it
+        sizes = {g.id(): g.target_size() for g in similar}
+        caps = {g.id(): g.max_size() for g in similar}
+        for _ in range(total):
+            open_groups = {k: v for k, v in sizes.items() if v < caps[k]}
+            if not open_groups:
+                break
+            gid = min(open_groups, key=lambda k: open_groups[k])
+            sizes[gid] += 1
+            plan[gid] = plan.get(gid, 0) + 1
+        return plan or {best.group_id: best.node_count}
+
+    # ---- quota caps ----
+
+    def _apply_quota(self, plan: dict[str, int], groups: list[NodeGroup],
+                     enc: EncodedCluster) -> dict[str, int]:
+        capped = dict(plan)
+        if self.quota is not None:
+            status = self.quota.status_from_encoded(enc)
+            for gid in list(capped):
+                g = next(gr for gr in groups if gr.id() == gid)
+                allowed = self.quota.max_nodes_addable(
+                    status, g.template_node_info(), capped[gid]
+                )
+                if allowed <= 0:
+                    del capped[gid]
+                elif allowed < capped[gid]:
+                    capped[gid] = allowed
+        return capped
+
+    # ---- execution (reference: executor.go:96-143, parallel per group) ----
+
+    def _execute(self, plan: dict[str, int], groups: list[NodeGroup],
+                 now: float) -> ScaleUpResult:
+        by_id = {g.id(): g for g in groups}
+        result = ScaleUpResult(scaled_up=False)
+
+        def one(gid: str, delta: int):
+            by_id[gid].increase_size(delta)
+            return gid, delta
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+            futures = {ex.submit(one, gid, d): gid for gid, d in plan.items()}
+            for fut in concurrent.futures.as_completed(futures):
+                gid = futures[fut]
+                try:
+                    _, delta = fut.result()
+                    result.increases[gid] = delta
+                    self.cluster_state.register_scale_up(by_id[gid], delta, now)
+                    result.scaled_up = True
+                except NodeGroupError as e:
+                    result.errors[gid] = str(e)
+                    self.cluster_state.register_failed_scale_up(by_id[gid], now)
+        return result
+
+    # ---- min-size enforcement (reference: ScaleUpToNodeGroupMinSize :223) ----
+
+    def scale_up_to_min_sizes(self, now: float | None = None) -> ScaleUpResult:
+        now = time.time() if now is None else now
+        result = ScaleUpResult(scaled_up=False)
+        for g in self._valid_groups(now):
+            delta = g.min_size() - g.target_size()
+            if delta > 0:
+                try:
+                    g.increase_size(delta)
+                    self.cluster_state.register_scale_up(g, delta, now)
+                    result.increases[g.id()] = delta
+                    result.scaled_up = True
+                except NodeGroupError as e:
+                    result.errors[g.id()] = str(e)
+                    self.cluster_state.register_failed_scale_up(g, now)
+        return result
+
+
+def _similar_templates(a, b) -> bool:
+    """Reference similarity: capacity within 5%, same labels ignoring
+    zone/hostname (processors/nodegroupset/compare_nodegroups.go:105)."""
+    IGNORE = {"kubernetes.io/hostname", "topology.kubernetes.io/zone",
+              "failure-domain.beta.kubernetes.io/zone"}
+
+    def caps(n):
+        return {k: float(v) for k, v in n.alloc_or_cap().items()}
+
+    ca, cb = caps(a), caps(b)
+    if set(ca) != set(cb):
+        return False
+    for k in ca:
+        hi = max(ca[k], cb[k])
+        if hi > 0 and abs(ca[k] - cb[k]) / hi > 0.05:
+            return False
+    la = {k: v for k, v in a.labels.items() if k not in IGNORE}
+    lb = {k: v for k, v in b.labels.items() if k not in IGNORE}
+    return la == lb

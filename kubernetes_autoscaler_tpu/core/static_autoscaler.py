@@ -1,0 +1,252 @@
+"""StaticAutoscaler: the control-loop body — one RunOnce per tick.
+
+Reference counterpart: core/static_autoscaler.go:296-624 RunOnce:
+state refresh → snapshot build → health gating → unregistered-node cleanup →
+upcoming-node injection (:499) → pod-list processing (:530, filter-out-
+schedulable) → scale-up dispatch (:589) → scale-down dispatch (:604,:749) →
+status reporting.
+
+TPU re-design: the snapshot build lowers the cluster to tensors once
+(models/encode); filter-out-schedulable, option estimation and the drain sweep
+are device programs; everything else here is thin host policy glue. The
+ClusterDataSource seam abstracts the kube API (informers/listers in the
+reference; a fake cluster in tests; the gRPC sidecar feed in deployment).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Protocol
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.cloudprovider.provider import CloudProvider
+from kubernetes_autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
+from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
+from kubernetes_autoscaler_tpu.core.scaledown.actuator import Actuator
+from kubernetes_autoscaler_tpu.core.scaledown.planner import Planner
+from kubernetes_autoscaler_tpu.core.scaleup.orchestrator import (
+    ScaleUpOrchestrator,
+    ScaleUpResult,
+)
+from kubernetes_autoscaler_tpu.expander.strategies import build_expander
+from kubernetes_autoscaler_tpu.metrics.metrics import HealthCheck, Registry, default_registry
+from kubernetes_autoscaler_tpu.models.api import Node, Pod
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.processors.processors import (
+    AutoscalingProcessors,
+    ProcessorContext,
+)
+from kubernetes_autoscaler_tpu.resourcequotas.tracker import QuotaTracker
+from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
+    DrainOptions,
+    apply_drainability,
+)
+from kubernetes_autoscaler_tpu.simulator.snapshot import TensorClusterSnapshot
+
+
+class ClusterDataSource(Protocol):
+    """reference: utils/kubernetes listers (obtainNodeLists :331, listPods :342)."""
+
+    def list_nodes(self) -> list[Node]: ...
+
+    def list_pods(self) -> list[Pod]: ...
+
+
+@dataclass
+class RunOnceStatus:
+    ran: bool = True
+    aborted_reason: str = ""
+    scale_up: ScaleUpResult | None = None
+    scale_down_deleted: list[str] = field(default_factory=list)
+    unneeded_nodes: list[str] = field(default_factory=list)
+    pending_pods: int = 0
+
+
+class StaticAutoscaler:
+    def __init__(
+        self,
+        provider: CloudProvider,
+        source: ClusterDataSource,
+        options: AutoscalingOptions | None = None,
+        processors: AutoscalingProcessors | None = None,
+        registry: Registry | None = None,
+        eviction_sink=None,
+        expander_priorities: dict[int, list[str]] | None = None,
+    ):
+        self.options = options or AutoscalingOptions()
+        self.provider = provider
+        self.source = source
+        self.processors = processors or AutoscalingProcessors.default()
+        self.metrics = registry or default_registry
+        self.health = HealthCheck()
+        self.cluster_state = ClusterStateRegistry(provider, self.options)
+        self.quota = QuotaTracker(provider.get_resource_limiter(), None)  # registry set per loop
+        expander = build_expander(self.options.expander, expander_priorities)
+        self.scale_up_orchestrator = ScaleUpOrchestrator(
+            provider, self.options, self.cluster_state, expander, None
+        )
+        self.planner = Planner(provider, self.options, None)
+        self.actuator = Actuator(provider, self.options, eviction_sink)
+        self.last_scale_down_delete: float = 0.0
+        self.last_scale_down_fail: float = 0.0
+
+    # ---- the loop body (reference: RunOnce :296) ----
+
+    def run_once(self, now: float | None = None) -> RunOnceStatus:
+        now = time.time() if now is None else now
+        status = RunOnceStatus()
+        with self.metrics.time_function("main"):
+            self.provider.refresh()
+            nodes = self.source.list_nodes()
+            pods = self.source.list_pods()
+
+            if self.processors.actionable_cluster.should_abort(
+                nodes, self.provider.node_groups()
+            ):
+                status.ran = False
+                status.aborted_reason = "no nodes"
+                return status
+            self.processors.custom_resources.filter_ready(nodes)
+
+            self.cluster_state.update_nodes(nodes, now)
+            for cb in self.processors.on_loop_start:
+                cb(now)
+
+            # unregistered-instance reaping (reference: removeOldUnregisteredNodes :976)
+            self._clean_long_unregistered(now)
+
+            if not self.cluster_state.is_cluster_healthy():
+                status.ran = False
+                status.aborted_reason = "cluster unhealthy"
+                return status
+
+            # min-size enforcement (reference: ScaleUpToNodeGroupMinSize :223)
+            self.scale_up_orchestrator.scale_up_to_min_sizes(now)
+
+            # host-side pod pipeline
+            ctx = ProcessorContext(self.options, self.provider, now)
+            pods = self.processors.run_pod_list(pods, ctx)
+
+            # tensor snapshot
+            node_group_ids = self._node_group_index(nodes)
+            with self.metrics.time_function("snapshot_build"):
+                enc = encode_cluster(
+                    nodes, pods,
+                    node_group_ids=node_group_ids,
+                    node_bucket=self.options.node_shape_bucket,
+                    group_bucket=self.options.group_shape_bucket,
+                )
+                apply_drainability(enc, DrainOptions(
+                    skip_nodes_with_system_pods=self.options.skip_nodes_with_system_pods,
+                    skip_nodes_with_local_storage=self.options.skip_nodes_with_local_storage,
+                    skip_nodes_with_custom_controller_pods=self.options.skip_nodes_with_custom_controller_pods,
+                ), now=now)
+            self.quota.registry = enc.registry
+            self.scale_up_orchestrator.quota = self.quota
+            self.planner.quota = self.quota
+            snapshot = TensorClusterSnapshot(enc)
+
+            # upcoming nodes (reference: addUpcomingNodesToClusterSnapshot :499)
+            upcoming = self.cluster_state.upcoming_nodes()
+            for gid, count in upcoming.items():
+                g = next((x for x in self.provider.node_groups() if x.id() == gid), None)
+                if g is None:
+                    continue
+                tmpl = g.template_node_info()
+                for k in range(count):
+                    t = self.processors.template_node_info_provider.sanitize(tmpl, gid)
+                    t.name = f"upcoming-{gid}-{k}"
+                    snapshot.add_node(t, group_id=-1)
+
+            # filter-out-schedulable (reference: PodListProcessor.Process :530)
+            with self.metrics.time_function("filter_out_schedulable"):
+                packed = snapshot.schedule_pending_on_existing()
+                snapshot.apply_placement(packed.placed)
+            remaining = int(np.asarray(snapshot.state.specs.count).sum())
+            status.pending_pods = remaining
+            self.metrics.gauge("unschedulable_pods_count").set(remaining)
+            # Sync the post-placement view unconditionally: the planner must see
+            # the capacity charged to simulated placements even when every pod
+            # fit (the reference keeps placements in the snapshot for the same
+            # reason — a node about to receive pending pods is not "unneeded").
+            enc.specs = snapshot.state.specs
+            enc.nodes = snapshot.state.nodes
+
+            # scale-up (reference: runSingleScaleUp :589)
+            scaled_up = False
+            if remaining > 0:
+                with self.metrics.time_function("scale_up"):
+                    result = self.scale_up_orchestrator.scale_up(enc, len(nodes), now)
+                status.scale_up = result
+                scaled_up = result.scaled_up
+                for cb in self.processors.on_scale_up_status:
+                    cb(result)
+                if result.scaled_up:
+                    self.metrics.counter("scaled_up_nodes_total").inc(
+                        sum(result.increases.values())
+                    )
+
+            # scale-down (reference: scaleDown :749; delay gating :604)
+            if self.options.scale_down_enabled and not scaled_up \
+                    and self._scale_down_allowed(now):
+                with self.metrics.time_function("scale_down_update"):
+                    self.planner.update(enc, nodes, now)
+                status.unneeded_nodes = list(self.planner.state.unneeded)
+                self.metrics.gauge("unneeded_nodes_count").set(
+                    len(status.unneeded_nodes)
+                )
+                to_remove = self.planner.nodes_to_delete(enc, nodes, now)
+                if to_remove:
+                    pods_by_slot = {
+                        j: p for j, p in enumerate(enc.scheduled_pods)
+                    }
+                    with self.metrics.time_function("scale_down_actuate"):
+                        results = self.actuator.start_deletion(
+                            to_remove, pods_by_slot, now
+                        )
+                    for r in results:
+                        if r.ok:
+                            status.scale_down_deleted.append(r.node)
+                            self.cluster_state.register_scale_down(r.node, now)
+                            self.last_scale_down_delete = now
+                        else:
+                            self.last_scale_down_fail = now
+                    self.metrics.counter("scaled_down_nodes_total").inc(
+                        len(status.scale_down_deleted)
+                    )
+
+            self.health.mark_active(now)
+        return status
+
+    # ---- helpers ----
+
+    def _node_group_index(self, nodes: list[Node]) -> dict[str, int]:
+        group_ids = {g.id(): i for i, g in enumerate(self.provider.node_groups())}
+        out = {}
+        for nd in nodes:
+            g = self.provider.node_group_for_node(nd)
+            if g is not None:
+                out[nd.name] = group_ids.get(g.id(), -1)
+        return out
+
+    def _scale_down_allowed(self, now: float) -> bool:
+        o = self.options
+        if now - self.cluster_state.last_scale_up_time < o.scale_down_delay_after_add_s:
+            return False
+        if now - self.last_scale_down_delete < o.scale_down_delay_after_delete_s:
+            return False
+        if now - self.last_scale_down_fail < o.scale_down_delay_after_failure_s:
+            return False
+        return True
+
+    def _clean_long_unregistered(self, now: float) -> None:
+        for u in self.cluster_state.long_unregistered(now):
+            g = next((x for x in self.provider.node_groups() if x.id() == u.group_id), None)
+            if g is None:
+                continue
+            try:
+                g.delete_nodes([Node(name=u.name)])
+            except Exception:
+                pass

@@ -1,0 +1,125 @@
+"""Estimator: scale-up sizing behind the reference's EstimatorBuilder seam.
+
+Reference counterpart: estimator/estimator.go:53-75 — `Estimate(pods,
+nodeTemplate, nodeGroup) → (nodeCount, scheduledPods)`, with "binpacking" the
+only registered implementation (BinpackingNodeEstimator,
+binpacking_estimator.go:102). This module keeps that per-node-group call shape
+for drop-in parity; the orchestrator prefers the batched all-groups kernel
+(ops/binpack.estimate_all) and only falls back here when a processor injects a
+custom estimator.
+
+Threshold limiters mirror estimator/threshold_based_limiter.go and friends:
+a static cap (--max-nodes-per-scaleup), cluster-capacity and per-group caps,
+composed as min().
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Protocol
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.cluster_state import (
+    Dims,
+    NodeGroupTensors,
+    PodGroupTensors,
+)
+from kubernetes_autoscaler_tpu.ops.binpack import EstimateResult, estimate_all
+
+
+class EstimationLimiter(Protocol):
+    """reference: estimator/estimation_limiter — node-count cap per estimation."""
+
+    def max_nodes(self, cluster_size: int, group_max_new: int) -> int: ...
+
+
+@dataclass
+class StaticThresholdLimiter:
+    """reference: estimator/static_threshold.go (--max-nodes-per-scaleup)."""
+
+    max_nodes_per_scaleup: int = 1000
+
+    def max_nodes(self, cluster_size: int, group_max_new: int) -> int:
+        return self.max_nodes_per_scaleup
+
+
+@dataclass
+class ClusterCapacityThresholdLimiter:
+    """reference: estimator/cluster_capacity_threshold.go (--max-nodes-total)."""
+
+    max_nodes_total: int = 0
+
+    def max_nodes(self, cluster_size: int, group_max_new: int) -> int:
+        if self.max_nodes_total <= 0:
+            return 1 << 30
+        return max(self.max_nodes_total - cluster_size, 0)
+
+
+@dataclass
+class SngCapacityThresholdLimiter:
+    """reference: estimator/sng_capacity_threshold.go (maxSize - targetSize)."""
+
+    def max_nodes(self, cluster_size: int, group_max_new: int) -> int:
+        return max(group_max_new, 0)
+
+
+def combined_limit(limiters: list[EstimationLimiter], cluster_size: int,
+                   group_max_new: int) -> int:
+    """reference: thresholdBasedEstimationLimiter composes via min."""
+    return min(l.max_nodes(cluster_size, group_max_new) for l in limiters)
+
+
+class BinpackingEstimator:
+    """Per-node-group Estimate() parity wrapper over the batched kernel."""
+
+    def __init__(self, dims: Dims, max_new_nodes_static: int = 1024,
+                 limiters: list[EstimationLimiter] | None = None):
+        self.dims = dims
+        self.max_new_nodes_static = max_new_nodes_static
+        self.limiters = limiters or [StaticThresholdLimiter()]
+
+    def estimate(
+        self,
+        specs: PodGroupTensors,
+        group_tensors: NodeGroupTensors,
+        group_index: int,
+        cluster_size: int = 0,
+    ) -> tuple[int, np.ndarray]:
+        """(node_count, scheduled[G]) for one node group — the reference
+        Estimate() signature (estimator.go:63)."""
+        limit = combined_limit(
+            self.limiters, cluster_size,
+            int(group_tensors.max_new[group_index]),
+        )
+        capped = group_tensors.replace(
+            max_new=group_tensors.max_new.at[group_index].min(limit)
+        )
+        result = estimate_all(specs, capped, self.dims, self.max_new_nodes_static)
+        return int(result.node_count[group_index]), np.asarray(result.scheduled[group_index])
+
+    def estimate_all_groups(
+        self,
+        specs: PodGroupTensors,
+        group_tensors: NodeGroupTensors,
+        cluster_size: int = 0,
+    ) -> EstimateResult:
+        """The batched path the orchestrator actually uses: every group's
+        option in one device program, with per-group caps applied."""
+        caps = [
+            combined_limit(self.limiters, cluster_size, int(m))
+            for m in np.asarray(group_tensors.max_new)
+        ]
+        capped = group_tensors.replace(
+            max_new=jnp.minimum(group_tensors.max_new, jnp.asarray(caps, jnp.int32))
+        )
+        return estimate_all(specs, capped, self.dims, self.max_new_nodes_static)
+
+
+def build_estimator(name: str, dims: Dims, **kw) -> BinpackingEstimator:
+    """reference: estimator.NewEstimatorBuilder (estimator.go:75)."""
+    if name != "binpacking":
+        raise ValueError(f"unknown estimator {name!r} (only 'binpacking' exists, "
+                         "mirroring the reference)")
+    return BinpackingEstimator(dims, **kw)

@@ -1,0 +1,185 @@
+"""Expander strategies: choosing among expansion options.
+
+Reference counterpart: expander/expander.go:55 (Strategy.BestOption) with the
+strategy zoo under expander/{random,mostpods,waste,leastnodes,price,priority,
+grpcplugin}, composed as a filter chain (factory/chain.go: each Filter narrows
+the option list; a final Random picks among survivors).
+
+The numeric scores come precomputed from the device (ops/scoring.py — all
+strategies' reductions are evaluated in the same kernel pass); this module is
+the policy layer: chain composition, priority-config regexes, randomness, and
+the out-of-process gRPC hook.
+"""
+
+from __future__ import annotations
+
+import random as _random
+import re
+from dataclasses import dataclass, field
+from typing import Protocol
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.ops.scoring import OptionScores
+
+
+@dataclass
+class Option:
+    """One expansion option (reference: expander.Option)."""
+
+    group_index: int
+    group_id: str
+    node_count: int
+    pod_count: int
+    waste: float
+    price: float
+
+
+def options_from_scores(scores: OptionScores, group_ids: list[str]) -> list[Option]:
+    valid = np.asarray(scores.valid)
+    return [
+        Option(
+            group_index=i,
+            group_id=group_ids[i] if i < len(group_ids) else str(i),
+            node_count=int(scores.nodes[i]),
+            pod_count=int(scores.pods[i]),
+            waste=float(scores.waste[i]),
+            price=float(scores.price[i]),
+        )
+        for i in range(valid.shape[0])
+        if valid[i]
+    ]
+
+
+class Filter(Protocol):
+    """reference: expander.Filter — narrows options; chain composes filters."""
+
+    def best_options(self, options: list[Option]) -> list[Option]: ...
+
+
+class MostPodsFilter:
+    def best_options(self, options: list[Option]) -> list[Option]:
+        if not options:
+            return []
+        best = max(o.pod_count for o in options)
+        return [o for o in options if o.pod_count == best]
+
+
+class LeastWasteFilter:
+    def best_options(self, options: list[Option]) -> list[Option]:
+        if not options:
+            return []
+        best = min(o.waste for o in options)
+        return [o for o in options if abs(o.waste - best) < 1e-9]
+
+
+class LeastNodesFilter:
+    def best_options(self, options: list[Option]) -> list[Option]:
+        if not options:
+            return []
+        best = min(o.node_count for o in options)
+        return [o for o in options if o.node_count == best]
+
+
+class PriceFilter:
+    """reference: expander/price — min total cost (pricing model × node count)."""
+
+    def best_options(self, options: list[Option]) -> list[Option]:
+        if not options:
+            return []
+        best = min(o.price for o in options)
+        return [o for o in options if abs(o.price - best) < 1e-9]
+
+
+@dataclass
+class PriorityFilter:
+    """reference: expander/priority — user config of priority→regex lists (the
+    cluster-autoscaler-priority-expander ConfigMap); highest priority whose
+    regex matches the group id wins."""
+
+    priorities: dict[int, list[str]] = field(default_factory=dict)
+
+    def best_options(self, options: list[Option]) -> list[Option]:
+        for prio in sorted(self.priorities, reverse=True):
+            pats = [re.compile(p) for p in self.priorities[prio]]
+            hits = [o for o in options if any(p.search(o.group_id) for p in pats)]
+            if hits:
+                return hits
+        return list(options)
+
+
+@dataclass
+class RandomFilter:
+    """Terminal picker (reference: expander/random, always the chain tail)."""
+
+    seed: int | None = None
+
+    def best_options(self, options: list[Option]) -> list[Option]:
+        if not options:
+            return []
+        rng = _random.Random(self.seed)
+        return [rng.choice(options)]
+
+
+@dataclass
+class GrpcFilter:
+    """reference: expander/grpcplugin — out-of-process `rpc BestOptions`
+    (protos/expander.proto:25-28). Takes a callable so transports (grpcio
+    channel, in-process plugin) are injectable; falls back to pass-through on
+    error, mirroring the reference's fail-open logging."""
+
+    call: "callable[[list[Option]], list[Option]] | None" = None
+
+    def best_options(self, options: list[Option]) -> list[Option]:
+        if self.call is None:
+            return list(options)
+        try:
+            narrowed = self.call(options)
+            return narrowed or list(options)
+        except Exception:
+            return list(options)
+
+
+_REGISTRY = {
+    "most-pods": MostPodsFilter,
+    "least-waste": LeastWasteFilter,
+    "least-nodes": LeastNodesFilter,
+    "price": PriceFilter,
+    "random": RandomFilter,
+}
+
+
+@dataclass
+class ChainStrategy:
+    """reference: expander/factory/chain.go — apply filters in order, then the
+    terminal random picker over whatever survives."""
+
+    filters: list
+    tail: RandomFilter = field(default_factory=RandomFilter)
+
+    def best_option(self, options: list[Option]) -> Option | None:
+        remaining = list(options)
+        for f in self.filters:
+            remaining = f.best_options(remaining)
+            if len(remaining) == 1:
+                return remaining[0]
+        picked = self.tail.best_options(remaining)
+        return picked[0] if picked else None
+
+
+def build_expander(spec: str, priorities: dict[int, list[str]] | None = None,
+                   grpc_call=None, seed: int | None = 0) -> ChainStrategy:
+    """reference: factory/expander_factory.go:55-82 — comma-separated names
+    compose into a chain. Deterministic seed by default (testability)."""
+    filters = []
+    for name in [s for s in spec.split(",") if s]:
+        if name == "priority":
+            filters.append(PriorityFilter(priorities or {}))
+        elif name == "grpc":
+            filters.append(GrpcFilter(grpc_call))
+        elif name in _REGISTRY:
+            f = _REGISTRY[name]
+            filters.append(f(seed) if f is RandomFilter else f())
+        else:
+            raise ValueError(f"unknown expander {name!r}")
+    return ChainStrategy(filters=filters, tail=RandomFilter(seed))

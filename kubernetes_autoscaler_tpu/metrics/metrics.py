@@ -1,0 +1,150 @@
+"""Metrics registry: counters/gauges/histograms + prometheus text exposition.
+
+Reference counterpart: metrics/metrics.go — ~45 series under
+`cluster_autoscaler_*`, notably the per-phase `function_duration_seconds`
+histogram (metrics.go:324) updated around every RunOnce stage, plus the
+liveness HealthCheck keyed on loop activity (liveness.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str = ""
+    _values: dict[tuple, float] = field(default_factory=dict)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str = ""
+    _values: dict[tuple, float] = field(default_factory=dict)
+
+    def set(self, v: float, **labels) -> None:
+        self._values[tuple(sorted(labels.items()))] = v
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+
+@dataclass
+class Histogram:
+    name: str
+    help: str = ""
+    buckets: tuple = _DEFAULT_BUCKETS
+    _counts: dict[tuple, list] = field(default_factory=dict)
+    _sums: dict[tuple, float] = field(default_factory=dict)
+
+    def observe(self, v: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+        self._sums[key] = self._sums.get(key, 0.0) + v
+
+    def count(self, **labels) -> int:
+        return sum(self._counts.get(tuple(sorted(labels.items())), []))
+
+
+class Registry:
+    def __init__(self, prefix: str = "cluster_autoscaler"):
+        self.prefix = prefix
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help))
+
+    def histogram(self, name: str, help: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._get(name, lambda: Histogram(name, help, buckets))
+
+    def _get(self, name: str, make):
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = make()
+            return self._metrics[name]
+
+    @contextmanager
+    def time_function(self, label: str):
+        """reference: function_duration_seconds histogram per FunctionLabel."""
+        h = self.histogram("function_duration_seconds")
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            h.observe(time.perf_counter() - t0, function=label)
+
+    def expose_text(self) -> str:
+        """Prometheus exposition format (consumed by the /metrics endpoint)."""
+        lines = []
+        for name, m in sorted(self._metrics.items()):
+            full = f"{self.prefix}_{name}"
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {full} counter")
+                for key, v in m._values.items():
+                    lines.append(f"{full}{_fmt(key)} {v}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {full} gauge")
+                for key, v in m._values.items():
+                    lines.append(f"{full}{_fmt(key)} {v}")
+            elif isinstance(m, Histogram):
+                lines.append(f"# TYPE {full} histogram")
+                for key, counts in m._counts.items():
+                    cum = 0
+                    for i, b in enumerate(m.buckets):
+                        cum += counts[i]
+                        lines.append(f'{full}_bucket{_fmt(key, le=str(b))} {cum}')
+                    cum += counts[-1]
+                    lines.append(f'{full}_bucket{_fmt(key, le="+Inf")} {cum}')
+                    lines.append(f"{full}_sum{_fmt(key)} {m._sums.get(key, 0.0)}")
+                    lines.append(f"{full}_count{_fmt(key)} {cum}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(key: tuple, **extra) -> str:
+    items = list(key) + sorted(extra.items())
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + inner + "}"
+
+
+@dataclass
+class HealthCheck:
+    """reference: metrics/liveness.go — fails liveness when the loop stalls."""
+
+    max_inactivity_s: float = 600.0
+    last_activity: float = field(default_factory=time.time)
+
+    def mark_active(self, now: float | None = None) -> None:
+        self.last_activity = now if now is not None else time.time()
+
+    def healthy(self, now: float | None = None) -> bool:
+        now = time.time() if now is None else now
+        return now - self.last_activity <= self.max_inactivity_s
+
+
+default_registry = Registry()

@@ -242,6 +242,52 @@ def equivalence_key(pod: Pod) -> int:
     return fold32("|".join(parts))
 
 
+def encode_node_row(
+    nd: Node,
+    registry: res.ExtendedResourceRegistry,
+    zone_table: ZoneTable,
+    dims: Dims,
+) -> dict[str, np.ndarray | int | bool]:
+    """Encode one node into its tensor row pieces (shared by encode_cluster and
+    the snapshot's incremental add-node path, simulator/snapshot.py)."""
+    label_hash = np.zeros((dims.max_labels,), np.int32)
+    taint_exact = np.zeros((dims.max_taints,), np.int32)
+    taint_key = np.zeros((dims.max_taints,), np.int32)
+    if not _fill(label_hash, _label_items(nd.labels)):
+        # Losing label hashes would create false "does not match" — the one
+        # direction the encoding contract forbids. Fail fast; the caller
+        # re-encodes with a larger Dims.max_labels.
+        raise ValueError(
+            f"node {nd.name!r}: {len(nd.labels)} labels overflow "
+            f"Dims.max_labels={dims.max_labels} (2 slots per label)"
+        )
+    tx, tk = [], []
+    blocked = False
+    for t in nd.taints:
+        if t.effect not in (NO_SCHEDULE, NO_EXECUTE):
+            continue  # PreferNoSchedule: score-only, never filters
+        if t.key == TO_BE_DELETED_TAINT:
+            blocked = True
+        e, k = _taint_hashes(t.key, t.value, t.effect)
+        tx.append(e)
+        tk.append(k)
+    if not (_fill(taint_exact, tx) and _fill(taint_key, tk)):
+        # Losing a taint would silently ADMIT intolerant pods — fail fast.
+        raise ValueError(
+            f"node {nd.name!r}: {len(tx)} filterable taints overflow "
+            f"Dims.max_taints={dims.max_taints}"
+        )
+    return {
+        "cap": node_capacity_vector(nd, registry),
+        "label_hash": label_hash,
+        "taint_exact": taint_exact,
+        "taint_key": taint_key,
+        "zone_id": zone_table.id_for(nd.zone()),
+        "ready": nd.ready,
+        "schedulable": not nd.unschedulable and not blocked,
+    }
+
+
 @dataclass
 class EncodedCluster:
     """Host handle for one encoded snapshot: tensors + name/index maps."""
@@ -302,35 +348,15 @@ def encode_cluster(
     valid = np.zeros((n_pad,), bool)
 
     for i, nd in enumerate(nodes):
-        cap[i] = node_capacity_vector(nd, registry)
-        if not _fill(label_hash[i], _label_items(nd.labels)):
-            # Losing label hashes would create false "does not match" — the one
-            # direction the encoding contract forbids. Fail fast; the caller
-            # re-encodes with a larger Dims.max_labels.
-            raise ValueError(
-                f"node {nd.name!r}: {len(nd.labels)} labels overflow "
-                f"Dims.max_labels={dims.max_labels} (2 slots per label)"
-            )
-        tx, tk = [], []
-        blocked = False
-        for t in nd.taints:
-            if t.effect not in (NO_SCHEDULE, NO_EXECUTE):
-                continue  # PreferNoSchedule: score-only, never filters
-            if t.key == TO_BE_DELETED_TAINT:
-                blocked = True
-            e, k = _taint_hashes(t.key, t.value, t.effect)
-            tx.append(e)
-            tk.append(k)
-        if not (_fill(taint_exact[i], tx) and _fill(taint_key[i], tk)):
-            # Losing a taint would silently ADMIT intolerant pods — fail fast.
-            raise ValueError(
-                f"node {nd.name!r}: {len(tx)} filterable taints overflow "
-                f"Dims.max_taints={dims.max_taints}"
-            )
-        zone_id[i] = zone_table.id_for(nd.zone())
+        row = encode_node_row(nd, registry, zone_table, dims)
+        cap[i] = row["cap"]
+        label_hash[i] = row["label_hash"]
+        taint_exact[i] = row["taint_exact"]
+        taint_key[i] = row["taint_key"]
+        zone_id[i] = row["zone_id"]
         group_id[i] = node_group_ids.get(nd.name, -1)
-        ready[i] = nd.ready
-        schedulable[i] = not nd.unschedulable and not blocked
+        ready[i] = row["ready"]
+        schedulable[i] = row["schedulable"]
         valid[i] = True
 
     # ---- resident pods: charge alloc + ports; collect spec rows ----

@@ -1,0 +1,217 @@
+"""TensorClusterSnapshot: the ClusterSnapshot contract on immutable pytrees.
+
+Reference counterpart: simulator/clustersnapshot/clustersnapshot.go:43-105 —
+the five mutating/query verbs plus Fork/Commit/Revert — implemented there by
+the DeltaSnapshotStore's layered deltas (store/delta.go:33-54, an O(1)-fork
+design motivated by Go pointer graphs). Here the whole cluster is one
+immutable pytree, so:
+
+  Fork   = push a reference onto a stack        (O(1), no copy)
+  Revert = pop                                   (O(1))
+  Commit = collapse the top into its parent      (O(1) pointer swap)
+
+The entire delta-store complexity disappears by construction (SURVEY.md §7
+step 3). Mutation verbs return *new* pytrees via `.at[...]` updates; XLA turns
+these into in-place buffer donation where safe.
+
+Verbs are batch-first (whole equivalence groups / candidate sets per call) —
+the serial per-pod verbs exist for parity and for the sidecar wire protocol,
+implemented as batch calls of size 1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.api import Node, Pod
+from kubernetes_autoscaler_tpu.models.cluster_state import (
+    NodeTensors,
+    PodGroupTensors,
+    ScheduledPodTensors,
+)
+from kubernetes_autoscaler_tpu.models.encode import (
+    EncodedCluster,
+    encode_cluster,
+    encode_node_row,
+)
+
+
+@dataclass
+class _State:
+    nodes: NodeTensors
+    specs: PodGroupTensors
+    scheduled: ScheduledPodTensors
+    node_names: list[str]
+    node_index: dict[str, int]
+    n_valid: int
+
+
+class SnapshotError(Exception):
+    pass
+
+
+class TensorClusterSnapshot:
+    """Forkable cluster snapshot over device tensors."""
+
+    def __init__(self, enc: EncodedCluster):
+        self.enc = enc
+        self._stack: list[_State] = [
+            _State(
+                nodes=enc.nodes,
+                specs=enc.specs,
+                scheduled=enc.scheduled,
+                node_names=list(enc.node_names),
+                node_index=dict(enc.node_index),
+                n_valid=len(enc.node_names),
+            )
+        ]
+
+    # ---- construction ----
+
+    @classmethod
+    def from_objects(cls, nodes: list[Node], pods: list[Pod], **encode_kw):
+        return cls(encode_cluster(nodes, pods, **encode_kw))
+
+    # ---- fork/commit/revert (reference clustersnapshot.go:43-105) ----
+
+    @property
+    def state(self) -> _State:
+        return self._stack[-1]
+
+    def fork(self) -> None:
+        s = self.state
+        self._stack.append(
+            _State(s.nodes, s.specs, s.scheduled, list(s.node_names),
+                   dict(s.node_index), s.n_valid)
+        )
+
+    def revert(self) -> None:
+        if len(self._stack) == 1:
+            raise SnapshotError("revert without fork")
+        self._stack.pop()
+
+    def commit(self) -> None:
+        if len(self._stack) == 1:
+            raise SnapshotError("commit without fork")
+        top = self._stack.pop()
+        self._stack[-1] = top
+
+    def with_forked(self, fn):
+        """reference: WithForkedSnapshot (clustersnapshot.go:135) — run fn on a
+        fork; commit when it returns True, revert otherwise or on error."""
+        self.fork()
+        try:
+            keep = fn()
+        except Exception:
+            self.revert()
+            raise
+        if keep:
+            self.commit()
+        else:
+            self.revert()
+        return keep
+
+    # ---- node mutation (reference AddNodeInfo/RemoveNodeInfo) ----
+
+    def add_node(self, node: Node, group_id: int = -1) -> int:
+        """Add a (template-instantiated) node; grows padded space if needed.
+        Reference analog: estimator adding template nodes
+        (binpacking_estimator.go:330 via SanitizedNodeInfo)."""
+        s = self.state
+        if node.name in s.node_index:
+            raise SnapshotError(f"node {node.name} already in snapshot")
+        i = s.n_valid
+        if i >= s.nodes.n:
+            s.nodes = _grow_nodes(s.nodes)
+        row = encode_node_row(node, self.enc.registry, self.enc.zone_table, self.enc.dims)
+        nt = s.nodes
+        s.nodes = nt.replace(
+            cap=nt.cap.at[i].set(jnp.asarray(row["cap"])),
+            alloc=nt.alloc.at[i].set(0),
+            label_hash=nt.label_hash.at[i].set(jnp.asarray(row["label_hash"])),
+            taint_exact=nt.taint_exact.at[i].set(jnp.asarray(row["taint_exact"])),
+            taint_key=nt.taint_key.at[i].set(jnp.asarray(row["taint_key"])),
+            used_ports=nt.used_ports.at[i].set(0),
+            zone_id=nt.zone_id.at[i].set(row["zone_id"]),
+            group_id=nt.group_id.at[i].set(group_id),
+            ready=nt.ready.at[i].set(bool(row["ready"])),
+            schedulable=nt.schedulable.at[i].set(bool(row["schedulable"])),
+            valid=nt.valid.at[i].set(True),
+        )
+        s.node_names.append(node.name)
+        s.node_index[node.name] = i
+        s.n_valid += 1
+        return i
+
+    def remove_node(self, name: str) -> None:
+        s = self.state
+        if name not in s.node_index:
+            raise SnapshotError(f"node {name} not in snapshot")
+        i = s.node_index[name]
+        s.nodes = s.nodes.replace(valid=s.nodes.valid.at[i].set(False))
+        # names keep their slots; index drops the mapping (ghost row)
+        del s.node_index[name]
+
+    def set_unschedulable(self, name: str, unschedulable: bool = True) -> None:
+        s = self.state
+        i = s.node_index[name]
+        s.nodes = s.nodes.replace(
+            schedulable=s.nodes.schedulable.at[i].set(not unschedulable)
+        )
+
+    # ---- batch verbs (delegate to ops/) ----
+
+    def schedule_pending_on_existing(self):
+        from kubernetes_autoscaler_tpu.ops.schedule import schedule_pending_on_existing
+
+        s = self.state
+        return schedule_pending_on_existing(s.nodes, s.specs, s.scheduled)
+
+    def apply_placement(self, placed: jnp.ndarray) -> None:
+        """Charge a PackResult.placed (i32[G, N]) onto node allocations and
+        decrement pending counts — the batch SchedulePod."""
+        s = self.state
+        add = jnp.einsum("gn,gr->nr", placed.astype(jnp.int32), s.specs.req)
+        new_count = jnp.maximum(s.specs.count - placed.sum(axis=1), 0)
+        s.nodes = s.nodes.replace(alloc=s.nodes.alloc + add)
+        s.specs = s.specs.replace(count=new_count)
+
+    def check_predicates(self):
+        from kubernetes_autoscaler_tpu.ops.predicates import feasibility_mask
+
+        s = self.state
+        return feasibility_mask(s.nodes, s.specs)
+
+    def simulate_removals(self, candidate_indices, dest_allowed=None,
+                          max_pods_per_node: int = 128, chunk: int = 32):
+        from kubernetes_autoscaler_tpu.ops.drain import simulate_removals
+
+        s = self.state
+        if dest_allowed is None:
+            dest_allowed = jnp.ones((s.nodes.n,), bool)
+        return simulate_removals(
+            s.nodes, s.specs, s.scheduled,
+            jnp.asarray(candidate_indices, jnp.int32), dest_allowed,
+            max_pods_per_node=max_pods_per_node, chunk=chunk,
+        )
+
+
+def _grow_nodes(nt: NodeTensors) -> NodeTensors:
+    """Double the padded node capacity (rare; keeps shape buckets coarse)."""
+    n = nt.n
+
+    def pad(x):
+        pad_width = [(0, n)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, pad_width)
+
+    grown = NodeTensors(
+        cap=pad(nt.cap), alloc=pad(nt.alloc), label_hash=pad(nt.label_hash),
+        taint_exact=pad(nt.taint_exact), taint_key=pad(nt.taint_key),
+        used_ports=pad(nt.used_ports), zone_id=pad(nt.zone_id),
+        group_id=jnp.pad(nt.group_id, (0, n), constant_values=-1),
+        ready=pad(nt.ready), schedulable=pad(nt.schedulable), valid=pad(nt.valid),
+    )
+    return grown

@@ -1,0 +1,41 @@
+"""Exponential per-node-group backoff after failed scale-ups.
+
+Reference counterpart: utils/backoff/exponential_backoff.go (174 LoC) —
+duration doubles per failure up to a cap, resets after a quiet period.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Entry:
+    duration: float
+    backoff_until: float
+    last_failure: float
+
+
+@dataclass
+class ExponentialBackoff:
+    initial_s: float = 300.0
+    max_s: float = 1800.0
+    reset_timeout_s: float = 3 * 3600.0
+    _entries: dict[str, _Entry] = field(default_factory=dict)
+
+    def backoff(self, group_id: str, now: float) -> float:
+        """Record a failure; returns the until-timestamp."""
+        e = self._entries.get(group_id)
+        if e is not None and now - e.last_failure < self.reset_timeout_s:
+            duration = min(e.duration * 2, self.max_s)
+        else:
+            duration = self.initial_s
+        self._entries[group_id] = _Entry(duration, now + duration, now)
+        return now + duration
+
+    def is_backed_off(self, group_id: str, now: float) -> bool:
+        e = self._entries.get(group_id)
+        return e is not None and now < e.backoff_until
+
+    def remove_backoff(self, group_id: str) -> None:
+        self._entries.pop(group_id, None)

@@ -1,0 +1,123 @@
+"""FakeCluster: in-memory kube-world for integration tests.
+
+Reference counterpart: test/integration/utils.go:58-88 FakeSet — bundles a
+fake clientset, fake cloud provider and pod observer so a whole
+StaticAutoscaler.RunOnce runs against memory. Here the fake wires the
+TestCloudProvider callbacks to node lifecycle: increase_size materializes
+ready nodes from the group template after `provision_delay_s`; delete removes
+them; evictions unbind pods.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+
+from kubernetes_autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from kubernetes_autoscaler_tpu.models.api import Node, Pod
+
+
+@dataclass
+class _PendingProvision:
+    group_id: str
+    count: int
+    at: float
+
+
+class FakeCluster:
+    """ClusterDataSource + EvictionSink + cloud-side node lifecycle."""
+
+    def __init__(self, provision_delay_s: float = 0.0):
+        self.provider = TestCloudProvider(
+            on_scale_up=self._on_scale_up,
+            on_scale_down=self._on_scale_down,
+        )
+        self.nodes: dict[str, Node] = {}
+        self.pods: dict[str, Pod] = {}
+        self.provision_delay_s = provision_delay_s
+        self.evicted: list[str] = []
+        self._pending: list[_PendingProvision] = []
+        self._seq = itertools.count()
+        self._now = 0.0
+
+    # ---- time control ----
+
+    def advance_to(self, now: float) -> None:
+        self._now = now
+        still = []
+        for p in self._pending:
+            if now >= p.at:
+                self._materialize(p.group_id, p.count)
+            else:
+                still.append(p)
+        self._pending = still
+
+    # ---- cloud callbacks ----
+
+    def _on_scale_up(self, gid: str, delta: int) -> None:
+        if self.provision_delay_s <= 0:
+            self._materialize(gid, delta)
+        else:
+            self._pending.append(
+                _PendingProvision(gid, delta, self._now + self.provision_delay_s)
+            )
+
+    def _materialize(self, gid: str, count: int) -> None:
+        g = next(x for x in self.provider.node_groups() if x.id() == gid)
+        for _ in range(count):
+            t = g.template_node_info()
+            name = f"{gid}-node-{next(self._seq)}"
+            nd = Node(
+                name=name,
+                labels={**t.labels, "kubernetes.io/hostname": name},
+                capacity=dict(t.capacity),
+                allocatable=dict(t.allocatable),
+                taints=list(t.taints),
+                ready=True,
+            )
+            self.nodes[name] = nd
+            self.provider.add_node(gid, nd)
+
+    def _on_scale_down(self, gid: str, node_name: str) -> None:
+        self.nodes.pop(node_name, None)
+        for p in self.pods.values():
+            if p.node_name == node_name:
+                p.node_name = ""
+                p.phase = "Pending"
+
+    # ---- ClusterDataSource ----
+
+    def list_nodes(self) -> list[Node]:
+        return list(self.nodes.values())
+
+    def list_pods(self) -> list[Pod]:
+        return list(self.pods.values())
+
+    # ---- EvictionSink ----
+
+    def evict(self, pod: Pod, node: Node) -> None:
+        self.evicted.append(pod.name)
+        live = self.pods.get(f"{pod.namespace}/{pod.name}")
+        if live is not None:
+            live.node_name = ""
+            live.phase = "Pending"
+
+    # ---- fixture helpers ----
+
+    def add_node_group(self, gid: str, template: Node, **kw):
+        return self.provider.add_node_group(gid, template, **kw)
+
+    def add_existing_node(self, gid: str, node: Node) -> None:
+        self.nodes[node.name] = node
+        self.provider.add_node(gid, node)
+        g = next(x for x in self.provider.node_groups() if x.id() == gid)
+        g._target = max(g._target, len(self.provider.nodes_of(gid)))
+
+    def add_pod(self, pod: Pod) -> None:
+        self.pods[f"{pod.namespace}/{pod.name}"] = pod
+
+    def bind(self, pod_name: str, node_name: str, namespace: str = "default") -> None:
+        p = self.pods[f"{namespace}/{pod_name}"]
+        p.node_name = node_name
+        p.phase = "Running"

@@ -1,0 +1,61 @@
+"""VPA admission logic: patch pod requests to the current recommendation.
+
+Reference counterpart: vertical-pod-autoscaler/pkg/admission-controller/ —
+a mutating webhook (logic/server.go) that, on pod create, applies the matching
+VPA's recommendation to container requests (resource/pod/patch) and proportionally
+adjusts limits. The webhook transport (TLS server) is deployment plumbing; the
+patch computation here is the product logic, exposed as a pure function plus an
+optional HTTP server in sidecar/http.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from kubernetes_autoscaler_tpu.vpa.model import UpdateMode, VerticalPodAutoscaler
+
+
+@dataclass
+class PatchOp:
+    container: str
+    resource: str
+    value: float
+
+
+def patch_for_pod(
+    namespace: str,
+    owner_name: str,
+    containers: dict[str, dict[str, float]],     # container -> current requests
+    limits: dict[str, dict[str, float]] | None,
+    vpas: list[VerticalPodAutoscaler],
+) -> list[PatchOp]:
+    """Compute request patches for a pod being admitted."""
+    vpa = next(
+        (v for v in vpas
+         if v.namespace == namespace and v.target_name == owner_name
+         and v.update_mode is not UpdateMode.OFF),
+        None,
+    )
+    if vpa is None or not vpa.recommendation:
+        return []
+    ops: list[PatchOp] = []
+    for rec in vpa.recommendation:
+        cur = containers.get(rec.container_name)
+        if cur is None:
+            continue
+        policy = vpa.policy_for(rec.container_name)
+        if policy.mode == "Off":
+            continue
+        for res, target in rec.target.items():
+            current = cur.get(res, 0.0)
+            if abs(current - target) < 1e-12:
+                continue
+            ops.append(PatchOp(rec.container_name, res, target))
+            # proportional limit scaling (reference:
+            # resource/pod/recommendation/...limit proportion logic)
+            if limits and policy.controlled_values == "RequestsAndLimits":
+                lim = limits.get(rec.container_name, {}).get(res)
+                if lim is not None and current > 0:
+                    ops.append(PatchOp(rec.container_name, f"limit:{res}",
+                                       lim * target / current))
+    return ops

@@ -1,0 +1,49 @@
+"""VPA checkpointing: persist/restore histogram state.
+
+Reference counterpart: recommender/checkpoint/checkpoint_writer.go +
+VerticalPodAutoscalerCheckpoint CRD — serialized bucket weights per
+(VPA, container), maintained periodically (routines/recommender.go:154
+MaintainCheckpoints) so a recommender restart keeps its history.
+
+Serialization: one npz per recommender (bucket weights + totals + key index) —
+the CRD-per-aggregate layout of the reference collapses into two dense arrays.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.vpa.recommender import Recommender
+
+
+def save_checkpoint(rec: Recommender, path: str, now: float) -> None:
+    keys = [list(k) for k, _ in sorted(rec._index.items(), key=lambda kv: kv[1])]
+    np.savez_compressed(
+        path,
+        cpu_weights=np.asarray(rec.cpu.weights),
+        cpu_total=np.asarray(rec.cpu.total),
+        mem_weights=np.asarray(rec.memory.weights),
+        mem_total=np.asarray(rec.memory.total),
+        ref_time=np.asarray([now]),
+        keys=json.dumps(keys),
+    )
+
+
+def load_checkpoint(path: str) -> Recommender | None:
+    if not os.path.exists(path):
+        return None
+    import jax.numpy as jnp
+
+    data = np.load(path, allow_pickle=False)
+    keys = json.loads(str(data["keys"]))
+    rec = Recommender(initial_aggregates=int(data["cpu_weights"].shape[0]))
+    rec._index = {tuple(k): i for i, k in enumerate(keys)}
+    rec.cpu.weights = jnp.asarray(data["cpu_weights"])
+    rec.cpu.total = jnp.asarray(data["cpu_total"])
+    rec.memory.weights = jnp.asarray(data["mem_weights"])
+    rec.memory.total = jnp.asarray(data["mem_total"])
+    rec.cpu.ref_time = rec.memory.ref_time = float(data["ref_time"][0])
+    return rec

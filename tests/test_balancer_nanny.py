@@ -1,0 +1,74 @@
+"""Balancer distribution policies + addon-resizer formula."""
+
+from kubernetes_autoscaler_tpu.balancer.balancer import (
+    BalancerSpec,
+    TargetSpec,
+    distribute,
+)
+from kubernetes_autoscaler_tpu.nanny.nanny import (
+    Nanny,
+    ResourceEstimatorSpec,
+    estimate,
+    needs_update,
+)
+
+
+def test_proportional_split():
+    spec = BalancerSpec(name="b", replicas=10, targets=[
+        TargetSpec("a", proportion=3), TargetSpec("b", proportion=1)])
+    out = distribute(spec)
+    assert out == {"a": 8, "b": 2}  # 7.5 rounds via largest remainder
+
+
+def test_proportional_respects_max():
+    spec = BalancerSpec(name="b", replicas=10, targets=[
+        TargetSpec("a", proportion=3, max_replicas=4),
+        TargetSpec("b", proportion=1)])
+    out = distribute(spec)
+    assert out["a"] == 4 and out["b"] == 6
+
+
+def test_priority_fills_in_order():
+    spec = BalancerSpec(name="b", replicas=7, policy="priority", targets=[
+        TargetSpec("cheap", priority=10, max_replicas=5),
+        TargetSpec("fallback", priority=1)])
+    out = distribute(spec)
+    assert out == {"cheap": 5, "fallback": 2}
+
+
+def test_min_replicas_honored():
+    spec = BalancerSpec(name="b", replicas=6, targets=[
+        TargetSpec("a", min_replicas=2, proportion=1),
+        TargetSpec("b", min_replicas=1, proportion=1)])
+    out = distribute(spec)
+    assert out["a"] >= 2 and out["b"] >= 1 and sum(out.values()) == 6
+
+
+def test_fallback_avoids_problem_domain():
+    spec = BalancerSpec(name="b", replicas=4, targets=[
+        TargetSpec("bad", proportion=1), TargetSpec("good", proportion=1)])
+    out = distribute(spec, problem_domains={"bad"})
+    assert out == {"good": 4}
+
+
+def test_nanny_formula_and_threshold():
+    spec = ResourceEstimatorSpec(
+        base={"cpu": 0.1, "memory": 200e6},
+        extra_per_node={"cpu": 0.001, "memory": 2e6},
+    )
+    want = estimate(spec, 1000)
+    assert abs(want["cpu"] - 1.1) < 1e-9
+    assert abs(want["memory"] - 2.2e9) < 1e-3
+    # within 10%: no update
+    assert not needs_update(spec, {"cpu": 1.05, "memory": 2.1e9}, 1000)
+    assert needs_update(spec, {"cpu": 0.5, "memory": 2.1e9}, 1000)
+
+
+def test_nanny_patches_when_drifted():
+    patched = []
+    n = Nanny(ResourceEstimatorSpec(base={"cpu": 0.1},
+                                    extra_per_node={"cpu": 0.001}),
+              patch_resources=patched.append)
+    assert n.poll_once(2000, {"cpu": 0.5})
+    assert abs(patched[0]["cpu"] - 2.1) < 1e-9
+    assert not n.poll_once(2000, patched[0])

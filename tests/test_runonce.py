@@ -1,0 +1,262 @@
+"""RunOnce integration: whole-loop scenarios against the in-memory fake cluster.
+
+Reference analog: test/integration/inmemory/staticautoscaler_test.go and the
+core/static_autoscaler_test.go scenario suite.
+"""
+
+import pytest
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def make_options(**kw):
+    defaults = kw.pop("node_group_defaults", NodeGroupDefaults(
+        scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0,
+    ))
+    base = dict(
+        scan_interval_s=1.0,
+        scale_down_delay_after_add_s=0.0,
+        scale_down_delay_after_failure_s=0.0,
+        node_shape_bucket=16,
+        group_shape_bucket=16,
+        max_new_nodes_static=32,
+        max_pods_per_node=32,
+        drain_chunk=8,
+        node_group_defaults=defaults,
+    )
+    base.update(kw)
+    return AutoscalingOptions(**base)
+
+
+def autoscaler_for(fake, **opts):
+    return StaticAutoscaler(
+        fake.provider, fake, options=make_options(**opts), eviction_sink=fake
+    )
+
+
+def test_scale_up_from_pending_pods():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("ng1-seed", cpu_milli=4000, mem_mib=8192))
+    for i in range(8):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1500, mem_mib=512,
+                                    owner_name="rs"))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    # 8 pods x 1500m; seed node holds 2; 6 remain -> 2 per 4-CPU node -> 3 new
+    assert status.scale_up.increases == {"ng1": 3}
+    assert len(fake.nodes) == 4
+
+
+def test_no_scale_up_when_pods_fit():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("n1", cpu_milli=4000, mem_mib=8192))
+    fake.add_pod(build_test_pod("p0", cpu_milli=500, mem_mib=256, owner_name="rs"))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.pending_pods == 0
+    assert status.scale_up is None
+    assert len(fake.nodes) == 1
+
+
+def test_scale_up_respects_max_size():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=1000, mem_mib=2048)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=2)
+    for i in range(10):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=800, mem_mib=128,
+                                    owner_name="rs"))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up.increases == {"ng1": 2}
+
+
+def test_selector_picks_matching_group():
+    fake = FakeCluster()
+    plain = build_test_node("plain", cpu_milli=8000, mem_mib=16384)
+    special = build_test_node("special", cpu_milli=8000, mem_mib=16384,
+                              labels={"pool": "gpu"})
+    fake.add_node_group("ng-plain", plain, max_size=10)
+    fake.add_node_group("ng-special", special, max_size=10)
+    for i in range(4):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=2000, mem_mib=512,
+                                    owner_name="rs", node_selector={"pool": "gpu"}))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up.increases == {"ng-special": 1}
+
+
+def test_scale_down_idle_node():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("busy", cpu_milli=4000, mem_mib=8192))
+    fake.add_existing_node("ng1", build_test_node("idle", cpu_milli=4000, mem_mib=8192))
+    for i in range(3):
+        fake.add_pod(build_test_pod(f"b{i}", cpu_milli=1000, mem_mib=512,
+                                    owner_name="rs", node_name="busy"))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_down_deleted == ["idle"]
+    assert "idle" not in fake.nodes
+
+
+def test_scale_down_moves_pods():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("a", cpu_milli=4000, mem_mib=8192))
+    fake.add_existing_node("ng1", build_test_node("b", cpu_milli=4000, mem_mib=8192))
+    # a: busy (75%); b: one small movable pod (12.5%)
+    for i in range(3):
+        fake.add_pod(build_test_pod(f"a{i}", cpu_milli=1000, mem_mib=512,
+                                    owner_name="rs-a", node_name="a"))
+    fake.add_pod(build_test_pod("small", cpu_milli=500, mem_mib=256,
+                                owner_name="rs-b", node_name="b"))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_down_deleted == ["b"]
+    assert fake.evicted == ["small"]
+    # the evicted pod went Pending again (rebinds next loop via kube scheduler)
+    assert fake.pods["default/small"].node_name == ""
+
+
+def test_scale_down_blocked_by_naked_pod():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("a", cpu_milli=4000, mem_mib=8192))
+    fake.add_existing_node("ng1", build_test_node("b", cpu_milli=4000, mem_mib=8192))
+    fake.add_pod(build_test_pod("naked", cpu_milli=100, mem_mib=64,
+                                owner_kind="", node_name="b"))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    # node a is empty -> deleted; node b blocked by the naked pod
+    assert status.scale_down_deleted == ["a"]
+    assert "b" in fake.nodes
+
+
+def test_scale_down_respects_min_size():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=2, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("n1", cpu_milli=4000, mem_mib=8192))
+    fake.add_existing_node("ng1", build_test_node("n2", cpu_milli=4000, mem_mib=8192))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_down_deleted == []
+    assert len(fake.nodes) == 2
+
+
+def test_unneeded_time_gates_deletion():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("idle", cpu_milli=4000, mem_mib=8192))
+    a = autoscaler_for(fake, node_group_defaults=NodeGroupDefaults(
+        scale_down_unneeded_time_s=600.0,
+    ))
+    s1 = a.run_once(now=1000.0)
+    assert s1.unneeded_nodes == ["idle"] and s1.scale_down_deleted == []
+    s2 = a.run_once(now=1300.0)
+    assert s2.scale_down_deleted == []          # clock not elapsed
+    s3 = a.run_once(now=1700.0)
+    assert s3.scale_down_deleted == ["idle"]    # 700s > 600s
+
+
+def test_scale_up_then_down_full_cycle():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    for i in range(4):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1500, mem_mib=512,
+                                    owner_name="rs"))
+    a = autoscaler_for(fake)
+    s1 = a.run_once(now=1000.0)
+    assert s1.scale_up.scaled_up and len(fake.nodes) == 2
+    # pods get bound by the (simulated) scheduler
+    names = list(fake.nodes)
+    fake.bind("p0", names[0]); fake.bind("p1", names[0])
+    fake.bind("p2", names[1]); fake.bind("p3", names[1])
+    s2 = a.run_once(now=2000.0)
+    assert s2.scale_down_deleted == []          # both nodes ~75% utilized
+    # pods finish: nodes empty out
+    for i in range(4):
+        fake.pods[f"default/p{i}"].phase = "Succeeded"
+    s3 = a.run_once(now=3000.0)
+    assert len(s3.scale_down_deleted) == 10 or len(fake.nodes) == 0 or \
+        len(s3.scale_down_deleted) >= 1
+
+
+def test_backoff_after_failed_scale_up():
+    from kubernetes_autoscaler_tpu.cloudprovider.provider import NodeGroupError
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=1000, mem_mib=2048)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=5)
+
+    calls = []
+
+    def boom(gid, delta):
+        calls.append((gid, delta))
+        raise NodeGroupError("cloud says no")
+
+    fake.provider.on_scale_up = boom
+    fake.add_pod(build_test_pod("p0", cpu_milli=800, mem_mib=128, owner_name="rs"))
+    a = autoscaler_for(fake)
+    s1 = a.run_once(now=1000.0)
+    assert not s1.scale_up.scaled_up and "ng1" in s1.scale_up.errors
+    assert len(calls) == 1
+    # group is backed off: next loop must not retry the cloud call
+    s2 = a.run_once(now=1010.0)
+    assert len(calls) == 1
+    assert s2.scale_up is None or not s2.scale_up.scaled_up
+    # after the backoff window the group is retried
+    s3 = a.run_once(now=1000.0 + 400.0)
+    assert len(calls) == 2
+
+
+def test_no_scale_down_of_node_needed_by_pending_pods():
+    # Regression (review finding): pods that fit existing capacity charge the
+    # snapshot, so the target node must not be reported unneeded and deleted.
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("idle", cpu_milli=4000, mem_mib=8192))
+    for i in range(3):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1200, mem_mib=512,
+                                    owner_name="rs"))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.pending_pods == 0          # all fit the idle node
+    assert status.scale_up is None
+    assert status.scale_down_deleted == []   # ...so it is NOT unneeded
+    assert "idle" in fake.nodes
+
+
+def test_quota_min_not_jointly_breached():
+    # Regression (review finding): two individually-removable nodes must not
+    # jointly breach the min-cores quota in one loop.
+    from kubernetes_autoscaler_tpu.cloudprovider.provider import ResourceLimiter
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    for n in ("a", "b", "c"):
+        fake.add_existing_node("ng1", build_test_node(n, cpu_milli=4000, mem_mib=8192))
+    fake.provider.resource_limiter = ResourceLimiter(min_limits={"cpu": 8})
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    # 12 cores total, min 8 -> at most one 4-core node may go
+    assert len(status.scale_down_deleted) == 1
+    assert len(fake.nodes) == 2

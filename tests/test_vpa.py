@@ -1,0 +1,162 @@
+"""VPA: histogram bank, recommender percentiles, updater decisions, admission.
+
+Reference analog: vertical-pod-autoscaler unit suites (util/histogram_test.go,
+logic/recommender_test.go, updater/priority tests).
+"""
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.vpa.admission import patch_for_pod
+from kubernetes_autoscaler_tpu.vpa.histogram import (
+    CPU_SCHEME,
+    BucketScheme,
+    HistogramBank,
+)
+from kubernetes_autoscaler_tpu.vpa.model import (
+    ContainerResourcePolicy,
+    ContainerUsageSample,
+    UpdateMode,
+    VerticalPodAutoscaler,
+)
+from kubernetes_autoscaler_tpu.vpa.recommender import Recommender
+from kubernetes_autoscaler_tpu.vpa.updater import PodView, Updater
+
+
+def test_bucket_scheme_roundtrip():
+    s = BucketScheme(start=0.01, ratio=1.05, n_buckets=176)
+    idx = int(s.bucket_of(np.asarray([1.0]))[0])
+    lo = 0.01 * 1.05**idx
+    hi = 0.01 * 1.05 ** (idx + 1)
+    assert lo <= 1.0 < hi
+
+
+def test_histogram_percentile():
+    bank = HistogramBank(2, CPU_SCHEME, half_life_s=3600.0)
+    # aggregate 0: 100 samples at ~0.5 cores; aggregate 1: empty
+    bank.add_samples(np.zeros(100, np.int32), np.full(100, 0.5))
+    p50 = bank.percentile(0.5)
+    assert 0.45 < p50[0] < 0.60
+    assert p50[1] == 0.0
+
+
+def test_histogram_decay_shifts_weight():
+    bank = HistogramBank(1, CPU_SCHEME, half_life_s=100.0)
+    bank.add_samples(np.zeros(10, np.int32), np.full(10, 2.0))
+    bank.decay_to(1000.0)  # 10 half-lives: old samples nearly vanish
+    bank.add_samples(np.zeros(10, np.int32), np.full(10, 0.1))
+    p50 = bank.percentile(0.5)
+    assert p50[0] < 0.2  # dominated by fresh small samples
+
+
+def test_recommender_end_to_end():
+    r = Recommender()
+    samples = []
+    for i in range(200):
+        samples.append(ContainerUsageSample(
+            namespace="default", pod_name=f"p{i%5}", container_name="app",
+            owner_name="web", cpu_cores=0.30 + 0.01 * (i % 10),
+            memory_bytes=400e6, timestamp=float(i)))
+    r.feed(samples, now=300.0)
+    vpa = VerticalPodAutoscaler(name="web-vpa", target_name="web")
+    r.recommend([vpa], {"web": ["app"]})
+    assert len(vpa.recommendation) == 1
+    rec = vpa.recommendation[0]
+    # p90 cpu ~0.39 ×1.15 margin ≈ 0.45
+    assert 0.3 < rec.target["cpu"] < 0.7
+    assert rec.lower_bound["cpu"] <= rec.target["cpu"] <= rec.upper_bound["cpu"]
+    assert rec.target["memory"] >= 400e6  # margin + min floor
+
+
+def test_recommender_respects_policy_caps():
+    r = Recommender()
+    r.feed([ContainerUsageSample("default", "p", "app", "web",
+                                 cpu_cores=4.0, memory_bytes=8e9)] * 50, now=10.0)
+    vpa = VerticalPodAutoscaler(
+        name="v", target_name="web",
+        resource_policies=[ContainerResourcePolicy(
+            container_name="app", max_allowed={"cpu": 2.0, "memory": 4e9})],
+    )
+    r.recommend([vpa], {"web": ["app"]})
+    rec = vpa.recommendation[0]
+    assert rec.target["cpu"] == 2.0
+    assert rec.target["memory"] == 4e9
+    assert rec.uncapped_target["cpu"] > 2.0
+
+
+def test_updater_evicts_out_of_band_pod():
+    evicted = []
+    u = Updater(evict=lambda p: evicted.append(p.name))
+    vpa = VerticalPodAutoscaler(name="v", target_name="web", min_replicas=1)
+    from kubernetes_autoscaler_tpu.vpa.model import RecommendedContainerResources
+
+    vpa.recommendation = [RecommendedContainerResources(
+        container_name="app",
+        target={"cpu": 1.0, "memory": 2e9},
+        lower_bound={"cpu": 0.8, "memory": 1.5e9},
+        upper_bound={"cpu": 1.5, "memory": 3e9},
+    )]
+    low = PodView("under", "default", "web", {"app": {"cpu": 0.2, "memory": 2e9}},
+                  replicas_of_owner=3)
+    fine = PodView("fine", "default", "web", {"app": {"cpu": 1.0, "memory": 2e9}},
+                   replicas_of_owner=3)
+    acted = u.run_once([vpa], [low, fine], now=1e6)
+    assert [d.pod.name for d in acted] == ["under"]
+    assert evicted == ["under"]
+
+
+def test_updater_respects_min_replicas():
+    evicted = []
+    u = Updater(evict=lambda p: evicted.append(p.name))
+    vpa = VerticalPodAutoscaler(name="v", target_name="web", min_replicas=2)
+    from kubernetes_autoscaler_tpu.vpa.model import RecommendedContainerResources
+
+    vpa.recommendation = [RecommendedContainerResources(
+        container_name="app", target={"cpu": 1.0},
+        lower_bound={"cpu": 0.8}, upper_bound={"cpu": 1.5})]
+    lone = PodView("lone", "default", "web", {"app": {"cpu": 0.1}},
+                   replicas_of_owner=1)
+    assert u.run_once([vpa], [lone], now=1e6) == []
+    assert evicted == []
+
+
+def test_admission_patches_requests_and_limits():
+    from kubernetes_autoscaler_tpu.vpa.model import RecommendedContainerResources
+
+    vpa = VerticalPodAutoscaler(name="v", target_name="web")
+    vpa.recommendation = [RecommendedContainerResources(
+        container_name="app", target={"cpu": 2.0, "memory": 4e9})]
+    ops = patch_for_pod(
+        "default", "web",
+        containers={"app": {"cpu": 1.0, "memory": 2e9}},
+        limits={"app": {"cpu": 2.0}},
+        vpas=[vpa],
+    )
+    by = {(o.container, o.resource): o.value for o in ops}
+    assert by[("app", "cpu")] == 2.0
+    assert by[("app", "memory")] == 4e9
+    assert by[("app", "limit:cpu")] == 4.0  # limit scaled proportionally
+
+
+def test_admission_off_mode_no_patch():
+    vpa = VerticalPodAutoscaler(name="v", target_name="web",
+                                update_mode=UpdateMode.OFF)
+    assert patch_for_pod("default", "web", {"app": {"cpu": 1.0}}, None, [vpa]) == []
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import os
+
+    from kubernetes_autoscaler_tpu.vpa.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    r = Recommender()
+    r.feed([ContainerUsageSample("d", "p", "app", "web",
+                                 cpu_cores=0.5, memory_bytes=1e9)] * 30, now=100.0)
+    p = os.path.join(tmp_path, "ckpt.npz")
+    save_checkpoint(r, p, 100.0)
+    r2 = load_checkpoint(p)
+    assert abs(r.cpu.percentile(0.5)[0] - r2.cpu.percentile(0.5)[0]) < 1e-6
+    assert r._index == r2._index
+    assert load_checkpoint(os.path.join(tmp_path, "missing.npz")) is None
