@@ -36,12 +36,18 @@ def distribute(spec: BalancerSpec,
                problem_domains: set[str] = frozenset()) -> dict[str, int]:
     """Compute per-target replica counts (reference: policy.BalancePlacement)."""
     targets = spec.targets
+    excluded: list[str] = []
     if spec.fallback_on_problem and problem_domains:
         healthy = [t for t in targets if t.name not in problem_domains]
         if healthy:
+            excluded = [t.name for t in targets if t.name in problem_domains]
             targets = healthy
 
-    alloc = {t.name: t.min_replicas for t in targets}
+    # Excluded domains are explicitly zeroed (not dropped) so reconcile()
+    # scales the unhealthy domain DOWN instead of leaving stale replicas
+    # running alongside the rebalanced ones.
+    alloc = {name: 0 for name in excluded}
+    alloc.update({t.name: t.min_replicas for t in targets})
     remaining = spec.replicas - sum(alloc.values())
     if remaining < 0:
         # mins exceed replicas: trim from lowest-priority / lowest-weight tail

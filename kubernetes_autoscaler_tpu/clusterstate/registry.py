@@ -61,6 +61,7 @@ class ClusterStateRegistry:
         )
         self.scale_up_requests: dict[str, ScaleUpRequest] = {}
         self.scale_down_in_flight: dict[str, float] = {}   # node name -> since
+        self.scale_down_group: dict[str, str] = {}         # node name -> group id
         self.readiness: dict[str, Readiness] = {}
         self.acceptable_ranges: dict[str, AcceptableRange] = {}
         self.unregistered: list[UnregisteredNode] = []
@@ -89,8 +90,10 @@ class ClusterStateRegistry:
         self.backoff.backoff(group.id(), now)
         self.scale_up_requests.pop(group.id(), None)
 
-    def register_scale_down(self, node_name: str, now: float) -> None:
+    def register_scale_down(self, node_name: str, now: float,
+                            group_id: str = "") -> None:
         self.scale_down_in_flight[node_name] = now
+        self.scale_down_group[node_name] = group_id
         self.last_scale_down_time = max(self.last_scale_down_time, now)
 
     def _max_provision_time(self, group: NodeGroup) -> float:
@@ -108,19 +111,26 @@ class ClusterStateRegistry:
             n: t for n, t in self.scale_down_in_flight.items() if n in registered
         }
         by_group: dict[str, Readiness] = {}
-        self.unregistered = [u for u in self.unregistered if u.name not in registered]
-        known_unreg = {u.name for u in self.unregistered}
+        known_unreg = {u.name: u for u in self.unregistered}
         total = Readiness()
 
+        # Rebuild the unregistered list from what the cloud currently reports:
+        # an instance that registered OR vanished from the provider drops out
+        # (prevents re-reaping a long-gone instance every loop).
+        still_unregistered: list[UnregisteredNode] = []
         for g in self.provider.node_groups():
             r = Readiness()
             for inst in g.nodes():
                 if inst.name in registered:
                     continue
                 r.not_started += 1
-                if inst.name not in known_unreg:
-                    self.unregistered.append(UnregisteredNode(inst.name, g.id(), now))
+                prev = known_unreg.get(inst.name)
+                still_unregistered.append(
+                    prev if prev is not None
+                    else UnregisteredNode(inst.name, g.id(), now)
+                )
             by_group[g.id()] = r
+        self.unregistered = still_unregistered
 
         for nd in nodes:
             g = self.provider.node_group_for_node(nd)
@@ -156,12 +166,29 @@ class ClusterStateRegistry:
         self._update_acceptable_ranges()
 
     def _update_acceptable_ranges(self) -> None:
+        """reference: updateAcceptableRanges (clusterstate.go) — per group,
+        registered counts between target-minus-pending-adds and
+        target-plus-in-flight-deletes are not 'incorrect size'."""
+        sd_group = self.scale_down_group
+        deleting_per_group: dict[str, int] = {}
+        for node, _ in self.scale_down_in_flight.items():
+            gid = sd_group.get(node, "")
+            deleting_per_group[gid] = deleting_per_group.get(gid, 0) + 1
         for g in self.provider.node_groups():
             target = g.target_size()
             req = self.scale_up_requests.get(g.id())
             lo = target - (req.increase if req else 0)
-            hi = target + len([n for n in self.scale_down_in_flight])
+            hi = target + deleting_per_group.get(g.id(), 0)
             self.acceptable_ranges[g.id()] = AcceptableRange(lo, hi, target)
+
+    def has_incorrect_size(self, group_id: str) -> bool:
+        """Registered count outside the acceptable range (consumed by
+        fixNodeGroupSize-style reconciliation)."""
+        rng = self.acceptable_ranges.get(group_id)
+        r = self.readiness.get(group_id)
+        if rng is None or r is None:
+            return False
+        return not (rng.min_nodes <= r.registered <= rng.max_nodes)
 
     # ---- health queries (reference: IsClusterHealthy :493) ----
 

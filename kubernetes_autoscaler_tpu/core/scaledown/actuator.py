@@ -127,17 +127,21 @@ class Actuator:
             by_group.setdefault(g.id(), []).append(r)
         for gid, rs in by_group.items():
             g = next(x for x in self.provider.node_groups() if x.id() == gid)
-            batch = rs[: self.options.max_empty_bulk_delete]
-            try:
-                g.delete_nodes([r.node for r in batch])
-                for r in batch:
-                    self.tracker.finish(r.node.name, True)
-                    results.append(DeletionResult(r.node.name, True))
-            except NodeGroupError as e:
-                for r in batch:
-                    self.untaint(r.node, TO_BE_DELETED_TAINT)
-                    self.tracker.finish(r.node.name, False, str(e))
-                    results.append(DeletionResult(r.node.name, False, str(e)))
+            # chunked so one cloud call never exceeds the bulk limit, but every
+            # tainted node gets a terminal result (no tainted zombies)
+            step = max(self.options.max_empty_bulk_delete, 1)
+            for start in range(0, len(rs), step):
+                batch = rs[start:start + step]
+                try:
+                    g.delete_nodes([r.node for r in batch])
+                    for r in batch:
+                        self.tracker.finish(r.node.name, True)
+                        results.append(DeletionResult(r.node.name, True))
+                except NodeGroupError as e:
+                    for r in batch:
+                        self.untaint(r.node, TO_BE_DELETED_TAINT)
+                        self.tracker.finish(r.node.name, False, str(e))
+                        results.append(DeletionResult(r.node.name, False, str(e)))
 
         # drain nodes: parallel per node under the drain budget
         def drain_one(r: NodeToRemove) -> DeletionResult:

@@ -91,9 +91,10 @@ class Planner:
             threshold = (opts.scale_down_utilization_threshold
                          or defaults.scale_down_utilization_threshold)
             if nd.ready and util[i] >= threshold:
-                self._mark(nd.name, "NotUnderutilized", now)
-                continue
-            if self.unremovable.contains(nd.name, now):
+                # screening reasons are re-evaluated every loop (NOT cached in
+                # the TTL registry — a node must become a candidate the moment
+                # it idles; the reference's recheck timeout applies only to
+                # simulation failures)
                 continue
             group_deletable[g.id()] -= 1
             eligible_idx.append(i)
@@ -191,14 +192,11 @@ class Planner:
             if room <= 0:
                 self._mark(name, "NodeGroupMinSizeReached", now)
                 continue
-            if quota_status is not None:
-                if not self.quota.nodes_removable(quota_status, nd):
-                    self._mark(name, "MinimalResourceLimitExceeded", now)
-                    continue
-                # deduct this node from the running totals so several removals
-                # in one loop can't jointly breach a min-limit (reference:
-                # the min-quota tracker deducts per confirmed removal)
-                self.quota.deduct(quota_status, nd)
+            if quota_status is not None and not self.quota.nodes_removable(
+                quota_status, nd
+            ):
+                self._mark(name, "MinimalResourceLimitExceeded", now)
+                continue
 
             is_empty = n_moved[k] == 0
             if is_empty:
@@ -231,6 +229,11 @@ class Planner:
                 self._mark(name, "NoPlaceToMovePods", now)
                 continue
 
+            # FINAL acceptance: only now deduct from the quota running totals
+            # so skipped candidates never consume headroom (reference: the
+            # min-quota tracker deducts per confirmed removal)
+            if quota_status is not None:
+                self.quota.deduct(quota_status, nd)
             group_room[g.id()] -= 1
             if is_empty:
                 empty_budget -= 1

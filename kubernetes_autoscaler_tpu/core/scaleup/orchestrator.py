@@ -121,7 +121,9 @@ class ScaleUpOrchestrator:
         est = estimator.estimate_all_groups(enc.specs, group_tensors, nodes_count)
         scores = scoring.score_options(est, group_tensors)
         options = options_from_scores(scores, [g.id() for g in groups])
-        options = self._verify_lossy_winners(options, est, enc, groups)
+        options = self._verify_lossy_winners(
+            options, est, enc, groups, estimator, group_tensors, nodes_count
+        )
         if not options:
             return ScaleUpResult(scaled_up=False, pods_remaining=pending_total,
                                  considered_options=[])
@@ -151,11 +153,16 @@ class ScaleUpOrchestrator:
 
     # ---- winner verification (the host-check tier) ----
 
-    def _verify_lossy_winners(self, options, est, enc: EncodedCluster, groups):
+    def _verify_lossy_winners(self, options, est, enc: EncodedCluster, groups,
+                              estimator, group_tensors, nodes_count: int):
         """Exact-check lossily-encoded pod groups against each option's
-        template; drop options that only schedule via encoding artifacts.
-        Plays the role of the reference's real scheduler framework run —
-        predicate truth always comes from exact semantics before actuation."""
+        template. Options relying on refuted pods are RE-ESTIMATED with those
+        pods masked out so node_count/waste/price reflect only pods that will
+        actually schedule. Plays the role of the reference's real scheduler
+        framework run — predicate truth always comes from exact semantics
+        before actuation."""
+        import jax.numpy as jnp
+
         flagged = np.asarray(enc.specs.needs_host_check)
         if not flagged.any():
             return options
@@ -163,22 +170,30 @@ class ScaleUpOrchestrator:
         out = []
         for opt in options:
             g_t = groups[opt.group_index].template_node_info()
-            ok_pods = opt.pod_count
+            refuted: list[int] = []
             for gi in np.nonzero(flagged)[0]:
                 if scheduled[opt.group_index, gi] <= 0:
                     continue
                 if gi < len(enc.group_pods) and enc.group_pods[gi]:
                     exemplar = enc.pending_pods[enc.group_pods[gi][0]]
                     if not oracle.check_pod_on_node(exemplar, g_t, []):
-                        ok_pods -= int(scheduled[opt.group_index, gi])
-            if ok_pods > 0:
-                if ok_pods != opt.pod_count:
-                    opt = Option(
-                        group_index=opt.group_index, group_id=opt.group_id,
-                        node_count=opt.node_count, pod_count=ok_pods,
-                        waste=opt.waste, price=opt.price,
-                    )
+                        refuted.append(int(gi))
+            if not refuted:
                 out.append(opt)
+                continue
+            # re-estimate this one node group with the refuted pods removed
+            count = np.asarray(enc.specs.count).copy()
+            count[refuted] = 0
+            masked = enc.specs.replace(count=jnp.asarray(count))
+            redo = estimator.estimate_all_groups(masked, group_tensors, nodes_count)
+            sc = scoring.score_options(redo, group_tensors)
+            i = opt.group_index
+            if bool(sc.valid[i]):
+                out.append(Option(
+                    group_index=i, group_id=opt.group_id,
+                    node_count=int(sc.nodes[i]), pod_count=int(sc.pods[i]),
+                    waste=float(sc.waste[i]), price=float(sc.price[i]),
+                ))
         return out
 
     # ---- similar-group balancing (reference: compare_nodegroups.go:105) ----

@@ -202,6 +202,11 @@ class StaticAutoscaler:
                     pods_by_slot = {
                         j: p for j, p in enumerate(enc.scheduled_pods)
                     }
+                    # group membership resolved BEFORE deletion unmaps the node
+                    group_of = {}
+                    for r in to_remove:
+                        g = self.provider.node_group_for_node(r.node)
+                        group_of[r.node.name] = g.id() if g else ""
                     with self.metrics.time_function("scale_down_actuate"):
                         results = self.actuator.start_deletion(
                             to_remove, pods_by_slot, now
@@ -209,7 +214,9 @@ class StaticAutoscaler:
                     for r in results:
                         if r.ok:
                             status.scale_down_deleted.append(r.node)
-                            self.cluster_state.register_scale_down(r.node, now)
+                            self.cluster_state.register_scale_down(
+                                r.node, now, group_of.get(r.node, "")
+                            )
                             self.last_scale_down_delete = now
                         else:
                             self.last_scale_down_fail = now

@@ -1,0 +1,239 @@
+"""ctypes bindings for the native snapshot-delta codec (libkacodec.so).
+
+Builds lazily via `make` on first use if the shared library is missing
+(g++ is part of the baked toolchain; no pip deps). Falls back to raising a
+clear error when no compiler exists — callers gate on `available()`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models import resources as res
+from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS, Dims
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libkacodec.so")
+_lib = None
+
+
+def _build() -> None:
+    subprocess.run(["make", "-C", _DIR, "-s"], check=True)
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        _build()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.ka_state_new.restype = ctypes.c_void_p
+    lib.ka_state_new.argtypes = [ctypes.c_int] * 8
+    lib.ka_state_free.argtypes = [ctypes.c_void_p]
+    lib.ka_last_error.restype = ctypes.c_char_p
+    lib.ka_last_error.argtypes = [ctypes.c_void_p]
+    lib.ka_apply_delta.restype = ctypes.c_int
+    lib.ka_apply_delta.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64]
+    lib.ka_version.restype = ctypes.c_uint64
+    lib.ka_version.argtypes = [ctypes.c_void_p]
+    for f in (lib.ka_num_nodes, lib.ka_num_pods, lib.ka_num_groups):
+        f.restype = ctypes.c_int
+        f.argtypes = [ctypes.c_void_p]
+    lib.ka_export_nodes.restype = ctypes.c_int
+    lib.ka_export_groups.restype = ctypes.c_int
+    lib.ka_export_pods.restype = ctypes.c_int
+    lib.ka_fold32_batch.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64), ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int32),
+    ]
+    lib.ka_fnv64_batch.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64), ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int64),
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except Exception:
+        return False
+
+
+def fold32_batch(strings: list[bytes]) -> np.ndarray:
+    """Native batch hashing (hot path of models/encode for big clusters)."""
+    lib = load()
+    data = b"".join(strings)
+    offsets = np.zeros(len(strings) + 1, np.int64)
+    np.cumsum([len(s) for s in strings], out=offsets[1:])
+    out = np.zeros(len(strings), np.int32)
+    lib.ka_fold32_batch(data, offsets, len(strings), out)
+    return out
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class NativeSnapshotState:
+    """Server-side incremental cluster state (the sidecar's resident model)."""
+
+    def __init__(self, dims: Dims = DEFAULT_DIMS):
+        self.lib = load()
+        self.dims = dims
+        self.handle = ctypes.c_void_p(self.lib.ka_state_new(
+            dims.max_labels, dims.max_taints, dims.max_tolerations,
+            dims.max_sel_terms, dims.max_sel_alts, dims.max_neg_terms,
+            dims.max_pod_ports, dims.max_node_ports,
+        ))
+
+    def __del__(self):
+        if getattr(self, "handle", None):
+            self.lib.ka_state_free(self.handle)
+            self.handle = None
+
+    def apply_delta(self, payload: bytes) -> None:
+        rc = self.lib.ka_apply_delta(self.handle, payload, len(payload))
+        if rc != 0:
+            err = self.lib.ka_last_error(self.handle).decode()
+            raise ValueError(f"apply_delta failed rc={rc}: {err}")
+
+    @property
+    def version(self) -> int:
+        return int(self.lib.ka_version(self.handle))
+
+    def counts(self) -> tuple[int, int, int]:
+        return (self.lib.ka_num_nodes(self.handle),
+                self.lib.ka_num_pods(self.handle),
+                self.lib.ka_num_groups(self.handle))
+
+    def export(self, node_bucket: int = 64, group_bucket: int = 64,
+               pod_bucket: int = 256):
+        """Materialize tensors (numpy; caller ships to device). Mirrors the
+        EncodedCluster tensor layout exactly."""
+        from kubernetes_autoscaler_tpu.models.cluster_state import pad_to
+
+        d = self.dims
+        n, p, g = self.counts()
+        n_pad = pad_to(n, node_bucket)
+        g_pad = pad_to(max(g, 1), group_bucket)
+        p_pad = pad_to(p, pod_bucket)
+        r = res.NUM_RESOURCES
+
+        nodes = {
+            "cap": np.zeros((n_pad, r), np.int32),
+            "alloc": np.zeros((n_pad, r), np.int32),
+            "label_hash": np.zeros((n_pad, d.max_labels), np.int32),
+            "taint_exact": np.zeros((n_pad, d.max_taints), np.int32),
+            "taint_key": np.zeros((n_pad, d.max_taints), np.int32),
+            "used_ports": np.zeros((n_pad, d.max_node_ports), np.int32),
+            "zone_id": np.zeros((n_pad,), np.int32),
+            "group_id": np.full((n_pad,), -1, np.int32),
+            "ready": np.zeros((n_pad,), np.uint8),
+            "schedulable": np.zeros((n_pad,), np.uint8),
+            "valid": np.zeros((n_pad,), np.uint8),
+        }
+        rc = self.lib.ka_export_nodes(
+            self.handle, n_pad, _ptr(nodes["cap"]), _ptr(nodes["alloc"]),
+            _ptr(nodes["label_hash"]), _ptr(nodes["taint_exact"]),
+            _ptr(nodes["taint_key"]), _ptr(nodes["used_ports"]),
+            _ptr(nodes["zone_id"]), _ptr(nodes["group_id"]),
+            _ptr(nodes["ready"]), _ptr(nodes["schedulable"]),
+            _ptr(nodes["valid"]))
+        if rc < 0:
+            raise ValueError(f"export_nodes failed rc={rc}")
+
+        groups = {
+            "req": np.zeros((g_pad, r), np.int32),
+            "count": np.zeros((g_pad,), np.int32),
+            "sel_req": np.zeros((g_pad, d.max_sel_terms, d.max_sel_alts), np.int32),
+            "sel_neg": np.zeros((g_pad, d.max_neg_terms), np.int32),
+            "tol_exact": np.zeros((g_pad, d.max_tolerations), np.int32),
+            "tol_key": np.zeros((g_pad, d.max_tolerations), np.int32),
+            "tolerate_all": np.zeros((g_pad,), np.uint8),
+            "port_hash": np.zeros((g_pad, d.max_pod_ports), np.int32),
+            "anti_self": np.zeros((g_pad,), np.uint8),
+            "valid": np.zeros((g_pad,), np.uint8),
+            "lossy": np.zeros((g_pad,), np.uint8),
+        }
+        rc = self.lib.ka_export_groups(
+            self.handle, g_pad, _ptr(groups["req"]), _ptr(groups["count"]),
+            _ptr(groups["sel_req"]), _ptr(groups["sel_neg"]),
+            _ptr(groups["tol_exact"]), _ptr(groups["tol_key"]),
+            _ptr(groups["tolerate_all"]), _ptr(groups["port_hash"]),
+            _ptr(groups["anti_self"]), _ptr(groups["valid"]),
+            _ptr(groups["lossy"]))
+        if rc < 0:
+            raise ValueError(f"export_groups failed rc={rc}")
+
+        pods = {
+            "req": np.zeros((p_pad, r), np.int32),
+            "node_idx": np.full((p_pad,), -1, np.int32),
+            "group_ref": np.zeros((p_pad,), np.int32),
+            "movable": np.zeros((p_pad,), np.uint8),
+            "blocks": np.zeros((p_pad,), np.uint8),
+            "valid": np.zeros((p_pad,), np.uint8),
+        }
+        rc = self.lib.ka_export_pods(
+            self.handle, p_pad, _ptr(pods["req"]), _ptr(pods["node_idx"]),
+            _ptr(pods["group_ref"]), _ptr(pods["movable"]),
+            _ptr(pods["blocks"]), _ptr(pods["valid"]))
+        if rc < 0:
+            raise ValueError(f"export_pods failed rc={rc}")
+        return nodes, groups, pods
+
+    def to_tensors(self, node_bucket: int = 64, group_bucket: int = 64,
+                   pod_bucket: int = 256):
+        """Export as device-resident NodeTensors/PodGroupTensors/ScheduledPodTensors."""
+        import jax.numpy as jnp
+
+        from kubernetes_autoscaler_tpu.models.cluster_state import (
+            NodeTensors,
+            PodGroupTensors,
+            ScheduledPodTensors,
+        )
+
+        nodes, groups, pods = self.export(node_bucket, group_bucket, pod_bucket)
+        nt = NodeTensors(
+            cap=jnp.asarray(nodes["cap"]), alloc=jnp.asarray(nodes["alloc"]),
+            label_hash=jnp.asarray(nodes["label_hash"]),
+            taint_exact=jnp.asarray(nodes["taint_exact"]),
+            taint_key=jnp.asarray(nodes["taint_key"]),
+            used_ports=jnp.asarray(nodes["used_ports"]),
+            zone_id=jnp.asarray(nodes["zone_id"]),
+            group_id=jnp.asarray(nodes["group_id"]),
+            ready=jnp.asarray(nodes["ready"].astype(bool)),
+            schedulable=jnp.asarray(nodes["schedulable"].astype(bool)),
+            valid=jnp.asarray(nodes["valid"].astype(bool)),
+        )
+        gt = PodGroupTensors(
+            req=jnp.asarray(groups["req"]), count=jnp.asarray(groups["count"]),
+            sel_req=jnp.asarray(groups["sel_req"]),
+            sel_neg=jnp.asarray(groups["sel_neg"]),
+            tol_exact=jnp.asarray(groups["tol_exact"]),
+            tol_key=jnp.asarray(groups["tol_key"]),
+            tolerate_all=jnp.asarray(groups["tolerate_all"].astype(bool)),
+            port_hash=jnp.asarray(groups["port_hash"]),
+            anti_affinity_self=jnp.asarray(groups["anti_self"].astype(bool)),
+            valid=jnp.asarray(groups["valid"].astype(bool)),
+            needs_host_check=jnp.asarray(groups["lossy"].astype(bool)),
+        )
+        pt = ScheduledPodTensors(
+            req=jnp.asarray(pods["req"]),
+            node_idx=jnp.asarray(pods["node_idx"]),
+            group_ref=jnp.asarray(pods["group_ref"]),
+            movable=jnp.asarray(pods["movable"].astype(bool)),
+            blocks=jnp.asarray(pods["blocks"].astype(bool)),
+            valid=jnp.asarray(pods["valid"].astype(bool)),
+        )
+        return nt, gt, pt

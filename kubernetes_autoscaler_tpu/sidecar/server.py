@@ -1,0 +1,206 @@
+"""The TPU simulation sidecar: gRPC service over the native snapshot state.
+
+Deployment shape (SURVEY.md north star): the Go Cluster Autoscaler keeps its
+control loop and cloud actuation; behind the estimator/expander/processor
+seams it dials this sidecar — pushing KAD1 snapshot deltas (decoded by the C++
+codec into pinned buffers) and asking for scale-up/scale-down simulations,
+which run as the fused device kernels (ops/autoscale_step).
+
+Transport: grpcio generic handlers speaking the rpc shape documented in
+protos/simulator.proto (bytes payloads; no codegen dependency). The same
+Service object also backs in-process use (tests, the Python control plane).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS, Dims
+from kubernetes_autoscaler_tpu.sidecar.native_api import NativeSnapshotState
+from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+
+_SERVICE = "katpu.simulator.v1.TpuSimulator"
+
+
+@dataclass
+class SimParams:
+    max_new_nodes: int = 256
+    strategy: str = "least-waste"
+    threshold: float = 0.5
+    node_groups: list | None = None
+
+
+class SimulatorService:
+    """Transport-independent service core."""
+
+    def __init__(self, dims: Dims = DEFAULT_DIMS,
+                 node_bucket: int = 256, group_bucket: int = 64):
+        self.dims = dims
+        self.state = NativeSnapshotState(dims)
+        self.node_bucket = node_bucket
+        self.group_bucket = group_bucket
+        self._lock = threading.Lock()
+        self._group_tensors = None
+        self._zone_seed: dict[str, int] = {}
+
+    # ---- rpc: ApplyDelta ----
+
+    def apply_delta(self, payload: bytes) -> dict:
+        with self._lock:
+            try:
+                self.state.apply_delta(payload)
+                return {"version": self.state.version, "error": ""}
+            except ValueError as e:
+                return {"version": self.state.version, "error": str(e)}
+
+    # ---- rpc: ScaleUpSim ----
+
+    def scale_up_sim(self, params: SimParams) -> dict:
+        from kubernetes_autoscaler_tpu.models.api import Node, Taint
+        from kubernetes_autoscaler_tpu.models.encode import (
+            ZoneTable,
+            encode_node_groups,
+        )
+        from kubernetes_autoscaler_tpu.models.resources import (
+            ExtendedResourceRegistry,
+        )
+        from kubernetes_autoscaler_tpu.ops.autoscale_step import scale_up_sim
+
+        with self._lock:
+            nt, gt, pt = self.state.to_tensors(self.node_bucket, self.group_bucket)
+        templates = []
+        ids = []
+        for g in params.node_groups or []:
+            t = g["template"]
+            node = Node(
+                name=t.get("name", g["id"]),
+                labels=t.get("labels", {}),
+                capacity=t.get("capacity", {}),
+                allocatable=t.get("allocatable", t.get("capacity", {})),
+                taints=[Taint(**x) for x in t.get("taints", [])],
+            )
+            templates.append((node, g.get("max_new", 1000), g.get("price", 1.0)))
+            ids.append(g["id"])
+        groups = encode_node_groups(
+            templates, ExtendedResourceRegistry(), ZoneTable(), self.dims
+        )
+        out = scale_up_sim(nt, gt, pt, groups, self.dims,
+                           params.max_new_nodes, params.strategy)
+        best = int(out.best)
+        return {
+            "best": ids[best] if 0 <= best < len(ids) else "",
+            "options": [
+                {
+                    "id": ids[i],
+                    "node_count": int(out.estimate.node_count[i]),
+                    "pods": int(out.scores.pods[i]),
+                    "waste": float(out.scores.waste[i]),
+                    "price": float(out.scores.price[i]),
+                    "valid": bool(out.scores.valid[i]),
+                }
+                for i in range(len(ids))
+            ],
+            "fits_existing": int(np.asarray(out.fits_existing).sum()),
+            "remaining": int(np.asarray(out.remaining).sum()),
+        }
+
+    # ---- rpc: ScaleDownSim ----
+
+    def scale_down_sim(self, params: SimParams) -> dict:
+        from kubernetes_autoscaler_tpu.ops.autoscale_step import scale_down_sim
+
+        with self._lock:
+            nt, gt, pt = self.state.to_tensors(self.node_bucket, self.group_bucket)
+        out = scale_down_sim(nt, gt, pt, params.threshold)
+        valid = np.asarray(nt.valid)
+        return {
+            "eligible": np.nonzero(np.asarray(out.eligible) & valid)[0].tolist(),
+            "drainable": np.nonzero(
+                np.asarray(out.removal.drainable) & valid)[0].tolist(),
+            "utilization": [round(float(u), 4)
+                            for u in np.asarray(out.utilization)[valid]],
+        }
+
+    def health(self) -> dict:
+        return {"version": self.state.version, "error": ""}
+
+
+def make_grpc_server(service: SimulatorService, port: int = 50151):
+    """Wire the service into a grpc.Server with generic bytes handlers."""
+    import grpc
+
+    def _json_method(fn, parse_params: bool):
+        def handler(request: bytes, context):
+            try:
+                if parse_params:
+                    raw = json.loads(request.decode() or "{}")
+                    params = SimParams(
+                        max_new_nodes=raw.get("max_new_nodes", 256),
+                        strategy=raw.get("strategy", "least-waste"),
+                        threshold=raw.get("threshold", 0.5),
+                        node_groups=raw.get("node_groups"),
+                    )
+                    return json.dumps(fn(params)).encode()
+                return json.dumps(fn(request)).encode()
+            except Exception as e:  # fail-closed with the error on the wire
+                return json.dumps({"error": str(e)}).encode()
+
+        return handler
+
+    ident = lambda b: b
+
+    method_handlers = {
+        "ApplyDelta": grpc.unary_unary_rpc_method_handler(
+            _json_method(service.apply_delta, False),
+            request_deserializer=ident, response_serializer=ident),
+        "ScaleUpSim": grpc.unary_unary_rpc_method_handler(
+            _json_method(service.scale_up_sim, True),
+            request_deserializer=ident, response_serializer=ident),
+        "ScaleDownSim": grpc.unary_unary_rpc_method_handler(
+            _json_method(service.scale_down_sim, True),
+            request_deserializer=ident, response_serializer=ident),
+        "Health": grpc.unary_unary_rpc_method_handler(
+            _json_method(lambda _b: service.health(), False),
+            request_deserializer=ident, response_serializer=ident),
+    }
+    from concurrent.futures import ThreadPoolExecutor
+
+    server = grpc.server(ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_SERVICE, method_handlers),)
+    )
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    return server, bound
+
+
+class SimulatorClient:
+    """Thin client mirroring the Go side's calls (tests + examples)."""
+
+    def __init__(self, port: int):
+        import grpc
+
+        self.channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+
+    def _call(self, method: str, payload: bytes) -> bytes:
+        rpc = self.channel.unary_unary(
+            f"/{_SERVICE}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        return rpc(payload)
+
+    def apply_delta(self, writer: DeltaWriter) -> dict:
+        return json.loads(self._call("ApplyDelta", writer.payload()))
+
+    def scale_up_sim(self, **params) -> dict:
+        return json.loads(self._call("ScaleUpSim", json.dumps(params).encode()))
+
+    def scale_down_sim(self, **params) -> dict:
+        return json.loads(self._call("ScaleDownSim", json.dumps(params).encode()))
+
+    def health(self) -> dict:
+        return json.loads(self._call("Health", b""))

@@ -1,0 +1,128 @@
+"""KAD1 wire serialization: api objects → snapshot-delta bytes.
+
+The client half of the sidecar boundary (a Go control plane implements the
+same trivial format; see native/kacodec.cc header for the byte layout). This
+is the versioned snapshot-diff protocol SURVEY.md §7 calls for — per loop the
+control plane sends only changed nodes/pods instead of re-uploading the world
+(the reference's DeltaSnapshotStore idea, delta.go:33-54, moved to the wire).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from kubernetes_autoscaler_tpu.models import resources as res
+from kubernetes_autoscaler_tpu.models.api import (
+    NO_EXECUTE,
+    NO_SCHEDULE,
+    Node,
+    Pod,
+)
+from kubernetes_autoscaler_tpu.models.encode import (
+    equivalence_key,
+    node_capacity_vector,
+    pod_request_vector,
+)
+
+MAGIC = b"KAD1"
+
+UPSERT_NODE, DELETE_NODE, UPSERT_POD, DELETE_POD = 1, 2, 3, 4
+
+_EFFECTS = {NO_SCHEDULE: 0, NO_EXECUTE: 1}
+
+
+def _s(out: bytearray, s: str) -> None:
+    b = s.encode("utf-8")
+    out += struct.pack("<H", len(b))
+    out += b
+
+
+class DeltaWriter:
+    def __init__(self, registry: res.ExtendedResourceRegistry | None = None):
+        self.registry = registry or res.ExtendedResourceRegistry()
+        self._body = bytearray()
+        self._count = 0
+
+    def upsert_node(self, node: Node, group_id: int = -1) -> "DeltaWriter":
+        b = self._body
+        b.append(UPSERT_NODE)
+        _s(b, node.name)
+        b += struct.pack("<H", len(node.labels))
+        for k, v in node.labels.items():
+            _s(b, k)
+            _s(b, v)
+        taints = node.taints
+        b.append(len(taints))
+        for t in taints:
+            _s(b, t.key)
+            _s(b, t.value)
+            b.append(_EFFECTS.get(t.effect, 2))
+        cap = node_capacity_vector(node, self.registry)
+        b += struct.pack(f"<{res.NUM_RESOURCES}i", *cap.tolist())
+        b.append((1 if node.ready else 0) | (2 if node.unschedulable else 0))
+        b += struct.pack("<i", group_id)
+        _s(b, node.zone())
+        self._count += 1
+        return self
+
+    def delete_node(self, name: str) -> "DeltaWriter":
+        self._body.append(DELETE_NODE)
+        _s(self._body, name)
+        self._count += 1
+        return self
+
+    def upsert_pod(self, pod: Pod, movable: bool = False,
+                   blocks: bool = False) -> "DeltaWriter":
+        b = self._body
+        b.append(UPSERT_POD)
+        _s(b, pod.uid or f"{pod.namespace}/{pod.name}")
+        _s(b, pod.node_name)
+        req, req_lossy = pod_request_vector(pod, self.registry)
+        b += struct.pack(f"<{res.NUM_RESOURCES}i", *req.tolist())
+        sel = sorted(pod.node_selector.items())
+        b += struct.pack("<H", len(sel))
+        for k, v in sel:
+            _s(b, k)
+            _s(b, v)
+        b.append(len(pod.tolerations))
+        for t in pod.tolerations:
+            _s(b, t.key)
+            b.append(1 if t.operator == "Exists" else 0)
+            _s(b, t.value)
+            b.append(_EFFECTS.get(t.effect, 2) if t.effect else 2)
+        b.append(len(pod.host_ports))
+        for port, proto in pod.host_ports:
+            b += struct.pack("<H", port)
+            b.append(1 if proto == "UDP" else 0)
+        anti_self = any(
+            term.topology_key == "kubernetes.io/hostname"
+            and term.match_labels
+            and all(pod.labels.get(k) == v for k, v in term.match_labels.items())
+            for term in pod.anti_affinity
+        )
+        # lossy mirrors _encode_pod_spec: shapes the dense wire can't express
+        lossy = bool(
+            req_lossy
+            or pod.required_node_affinity
+            or pod.pod_affinity
+            or pod.topology_spread_max_skew
+            or any(not (t.topology_key == "kubernetes.io/hostname"
+                        and t.match_labels
+                        and all(pod.labels.get(k) == v
+                                for k, v in t.match_labels.items()))
+                   for t in pod.anti_affinity)
+        )
+        b.append((1 if movable else 0) | (2 if blocks else 0)
+                 | (4 if anti_self else 0) | (8 if lossy else 0))
+        _s(b, str(equivalence_key(pod)))
+        self._count += 1
+        return self
+
+    def delete_pod(self, uid: str) -> "DeltaWriter":
+        self._body.append(DELETE_POD)
+        _s(self._body, uid)
+        self._count += 1
+        return self
+
+    def payload(self) -> bytes:
+        return MAGIC + struct.pack("<I", self._count) + bytes(self._body)

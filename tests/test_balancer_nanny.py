@@ -48,7 +48,7 @@ def test_fallback_avoids_problem_domain():
     spec = BalancerSpec(name="b", replicas=4, targets=[
         TargetSpec("bad", proportion=1), TargetSpec("good", proportion=1)])
     out = distribute(spec, problem_domains={"bad"})
-    assert out == {"good": 4}
+    assert out == {"bad": 0, "good": 4}  # unhealthy domain scaled to zero
 
 
 def test_nanny_formula_and_threshold():

@@ -1,0 +1,152 @@
+"""Native codec: C++ delta decode must match the Python encoder bit-for-bit."""
+
+import numpy as np
+import pytest
+
+from kubernetes_autoscaler_tpu.models.api import Taint, Toleration
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.sidecar import native_api
+from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+from kubernetes_autoscaler_tpu.utils.hashing import fold32
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+pytestmark = pytest.mark.skipif(
+    not native_api.available(), reason="native codec not buildable"
+)
+
+
+def world():
+    nodes = [
+        build_test_node("n1", cpu_milli=4000, mem_mib=8192,
+                        labels={"disk": "ssd"}, zone="za"),
+        build_test_node("n2", cpu_milli=2000, mem_mib=4096,
+                        taints=[Taint("dedicated", "infra", "NoSchedule")],
+                        zone="zb"),
+    ]
+    pods = [
+        build_test_pod("r1", cpu_milli=500, mem_mib=256, node_name="n1",
+                       owner_name="resA", host_port=8080),
+        build_test_pod("p1", cpu_milli=1000, mem_mib=512, owner_name="rsB",
+                       node_selector={"disk": "ssd"}),
+        build_test_pod("p2", cpu_milli=1000, mem_mib=512, owner_name="rsB",
+                       node_selector={"disk": "ssd"}),
+        build_test_pod("p3", cpu_milli=250, mem_mib=128, owner_name="rsC",
+                       tolerations=[Toleration(key="dedicated",
+                                               operator="Exists")]),
+    ]
+    return nodes, pods
+
+
+def native_state(nodes, pods):
+    from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
+        Verdict,
+        classify_pod,
+    )
+
+    st = native_api.NativeSnapshotState()
+    w = DeltaWriter()
+    for nd in nodes:
+        w.upsert_node(nd)
+    for p in pods:
+        v = classify_pod(p)
+        w.upsert_pod(p, movable=v is Verdict.DRAIN, blocks=v is Verdict.BLOCK)
+    st.apply_delta(w.payload())
+    return st
+
+
+def test_fold32_batch_matches_python():
+    strings = [b"disk=ssd", b"a\x01", b"dedicated\x00infra\x00NoSchedule", b""]
+    out = native_api.fold32_batch(strings)
+    for s, h in zip(strings, out):
+        assert int(h) == fold32(s)
+
+
+def test_delta_roundtrip_matches_python_encoder():
+    nodes, pods = world()
+    st = native_state(nodes, pods)
+    assert st.version == 1
+    nt, gt, pt = st.to_tensors()
+
+    enc = encode_cluster(nodes, pods)
+    from kubernetes_autoscaler_tpu.simulator.drainability.rules import apply_drainability
+
+    apply_drainability(enc)
+
+    # node tables: row order identical (insertion order)
+    for field in ("cap", "label_hash", "taint_exact", "taint_key", "zone_id",
+                  "alloc", "used_ports"):
+        a = np.asarray(getattr(enc.nodes, field))
+        b = np.asarray(getattr(nt, field))
+        np.testing.assert_array_equal(a[:2], b[:2], err_msg=field)
+
+    # group rows: match by request vector + hashes, order-independent
+    def rows(t):
+        out = set()
+        for i in range(np.asarray(t.valid).shape[0]):
+            if np.asarray(t.valid)[i]:
+                out.add((
+                    tuple(np.asarray(t.req)[i].tolist()),
+                    tuple(np.asarray(t.sel_req)[i].ravel().tolist()),
+                    tuple(np.asarray(t.tol_key)[i].tolist()),
+                    int(np.asarray(t.count)[i]),
+                ))
+        return out
+
+    assert rows(enc.specs) == rows(gt)
+
+    # scheduled pods
+    assert int(np.asarray(pt.valid).sum()) == 1
+    j = int(np.argmax(np.asarray(pt.valid)))
+    k = int(np.argmax(np.asarray(enc.scheduled.valid)))
+    np.testing.assert_array_equal(np.asarray(pt.req)[j],
+                                  np.asarray(enc.scheduled.req)[k])
+    assert bool(pt.movable[j]) == bool(enc.scheduled.movable[k])
+
+
+def test_incremental_delete_and_update():
+    nodes, pods = world()
+    st = native_state(nodes, pods)
+    n0, p0, g0 = st.counts()
+    st.apply_delta(DeltaWriter().delete_pod("uid-default/p3").payload())
+    nt, gt, pt = st.to_tensors()
+    # p3 pending pod removed -> its group count drops to 0
+    counts = np.asarray(gt.count)[np.asarray(gt.valid).astype(bool)]
+    assert int(counts.sum()) == 2  # p1, p2 remain
+    st.apply_delta(DeltaWriter().delete_node("n2").payload())
+    nt, _, _ = st.to_tensors()
+    assert int(np.asarray(nt.valid).sum()) == 1
+    assert st.version == 3
+
+
+def test_slot_reuse_after_delete():
+    nodes, pods = world()
+    st = native_state(nodes, pods)
+    st.apply_delta(DeltaWriter().delete_node("n2").payload())
+    w = DeltaWriter()
+    w.upsert_node(build_test_node("n3", cpu_milli=1000, mem_mib=1024))
+    st.apply_delta(w.payload())
+    assert st.counts()[0] == 2  # reused the freed row, no growth
+
+
+def test_kernels_run_on_native_export():
+    import jax.numpy as jnp
+
+    from kubernetes_autoscaler_tpu.ops.predicates import feasibility_mask
+    from kubernetes_autoscaler_tpu.ops.schedule import schedule_pending_on_existing
+
+    nodes, pods = world()
+    st = native_state(nodes, pods)
+    nt, gt, pt = st.to_tensors()
+    mask = np.asarray(feasibility_mask(nt, gt))
+    assert mask.shape[0] == gt.g and mask.shape[1] == nt.n
+    packed = schedule_pending_on_existing(nt, gt, pt)
+    # p1+p2 want disk=ssd -> n1 (3500m free); p3 fits either
+    assert int(np.asarray(packed.scheduled).sum()) == 3
+
+
+def test_bad_payload_rejected():
+    st = native_api.NativeSnapshotState()
+    with pytest.raises(ValueError):
+        st.apply_delta(b"XXXX\x00\x00\x00\x00")
+    with pytest.raises(ValueError):
+        st.apply_delta(b"KAD1\x05\x00\x00\x00\x01")  # truncated

@@ -1,0 +1,72 @@
+"""Sidecar gRPC service: delta upload + simulation queries over localhost."""
+
+import pytest
+
+from kubernetes_autoscaler_tpu.sidecar import native_api
+
+pytestmark = pytest.mark.skipif(
+    not native_api.available(), reason="native codec not buildable"
+)
+
+
+@pytest.fixture()
+def server_client():
+    grpc = pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimulatorClient,
+        SimulatorService,
+        make_grpc_server,
+    )
+
+    service = SimulatorService(node_bucket=16, group_bucket=16)
+    server, port = make_grpc_server(service, port=0)
+    server.start()
+    yield SimulatorClient(port)
+    server.stop(None)
+
+
+def template_json(name, cpu, mem_mib, labels=None):
+    mib = 1024 * 1024
+    return {"name": name, "labels": labels or {},
+            "capacity": {"cpu": cpu, "memory": mem_mib * mib, "pods": 110}}
+
+
+def test_sidecar_roundtrip(server_client):
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    c = server_client
+    assert c.health()["version"] == 0
+
+    w = DeltaWriter()
+    w.upsert_node(build_test_node("n1", cpu_milli=2000, mem_mib=4096))
+    for i in range(5):
+        w.upsert_pod(build_test_pod(f"p{i}", cpu_milli=900, mem_mib=256,
+                                    owner_name="rs"))
+    ack = c.apply_delta(w)
+    assert ack["error"] == "" and ack["version"] == 1
+
+    up = c.scale_up_sim(
+        max_new_nodes=16,
+        strategy="least-waste",
+        node_groups=[{"id": "ng-big", "template": template_json("t", 4.0, 8192),
+                      "max_new": 10, "price": 1.0}],
+    )
+    # 5 pods x 900m; existing node absorbs 2; 3 remain -> 4-CPU node holds 4
+    assert up["best"] == "ng-big"
+    assert up["fits_existing"] == 2
+    assert up["options"][0]["node_count"] == 1
+
+    down = c.scale_down_sim(threshold=0.5)
+    assert down["eligible"] == [0]  # idle-ish node below threshold
+
+
+def test_sidecar_surfaces_errors(server_client):
+    import json
+
+    c = server_client
+    bad = c._call("ApplyDelta", b"not-a-delta")
+    assert json.loads(bad)["error"] != ""
