@@ -47,6 +47,15 @@ class DeletionResult:
     reason: str = ""
 
 
+def priority_eviction_order(pods: list[Pod]) -> list[Pod]:
+    """reference: actuation/priority.go priority evictor — evict in ascending
+    pod-priority tiers so high-priority pods keep running until lower tiers
+    have been asked to leave (the reference additionally waits between tiers;
+    here tier completion is the sink's concern — evictions are issued in tier
+    order)."""
+    return sorted(pods, key=lambda p: p.priority)
+
+
 @dataclass
 class NodeDeletionTracker:
     """reference: deletiontracker/nodedeletiontracker.go — in-flight registry."""
@@ -72,12 +81,16 @@ class Actuator:
         options: AutoscalingOptions,
         eviction_sink: EvictionSink | None = None,
         on_taint: Callable[[Node, str], None] | None = None,
+        pdb_tracker=None,
+        latency_tracker=None,
     ):
         self.provider = provider
         self.options = options
         self.eviction_sink = eviction_sink
         self.on_taint = on_taint
         self.tracker = NodeDeletionTracker()
+        self.pdb_tracker = pdb_tracker          # core/scaledown/pdb.RemainingPdbTracker
+        self.latency_tracker = latency_tracker  # core/scaledown/latencytracker
 
     # ---- taints (reference: utils/taints/taints.go) ----
 
@@ -136,6 +149,8 @@ class Actuator:
                     g.delete_nodes([r.node for r in batch])
                     for r in batch:
                         self.tracker.finish(r.node.name, True)
+                        if self.latency_tracker is not None:
+                            self.latency_tracker.observe_deletion(r.node.name, now)
                         results.append(DeletionResult(r.node.name, True))
                 except NodeGroupError as e:
                     for r in batch:
@@ -147,15 +162,23 @@ class Actuator:
         def drain_one(r: NodeToRemove) -> DeletionResult:
             try:
                 if self.eviction_sink and pods_by_slot:
-                    for slot in r.pods_to_move:
-                        pod = pods_by_slot.get(slot)
-                        if pod is not None:
-                            self.eviction_sink.evict(pod, r.node)
+                    victims = [pods_by_slot[s] for s in r.pods_to_move
+                               if s in pods_by_slot]
+                    if self.pdb_tracker is not None:
+                        # last-moment atomic PDB gate (reference: drain.go
+                        # re-checks budgets at eviction time, not just plan
+                        # time); atomic because drains run in worker threads
+                        if not self.pdb_tracker.try_remove_pods(victims):
+                            raise NodeGroupError("PDB budget exhausted")
+                    for pod in priority_eviction_order(victims):
+                        self.eviction_sink.evict(pod, r.node)
                 g = self.provider.node_group_for_node(r.node)
                 if g is None:
                     raise NodeGroupError("no node group")
                 g.delete_nodes([r.node])
                 self.tracker.finish(r.node.name, True)
+                if self.latency_tracker is not None:
+                    self.latency_tracker.observe_deletion(r.node.name, now)
                 return DeletionResult(r.node.name, True)
             except NodeGroupError as e:
                 self.untaint(r.node, TO_BE_DELETED_TAINT)

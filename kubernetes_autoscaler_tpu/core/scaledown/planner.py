@@ -55,13 +55,16 @@ class PlannerState:
 
 class Planner:
     def __init__(self, provider: CloudProvider, options: AutoscalingOptions,
-                 quota: QuotaTracker | None = None):
+                 quota: QuotaTracker | None = None,
+                 pdb_tracker=None, latency_tracker=None):
         self.provider = provider
         self.options = options
         self.quota = quota
         self.unneeded_nodes = UnneededNodes()
         self.unremovable = UnremovableNodes()
         self.state = PlannerState()
+        self.pdb_tracker = pdb_tracker          # shared with the actuator
+        self.latency_tracker = latency_tracker
 
     # ---- per-loop state update (reference: UpdateClusterState :120) ----
 
@@ -103,6 +106,10 @@ class Planner:
             self.state.unneeded = []
             self.state.removal = None
             self.unneeded_nodes.update([], now)
+            if self.latency_tracker is not None:
+                # clear candidate clocks — otherwise a node that idles again
+                # much later would resume a stale clock
+                self.latency_tracker.observe_candidates([], now)
             return self.state
 
         cand = np.asarray(eligible_idx, dtype=np.int32)
@@ -124,6 +131,8 @@ class Planner:
                           else "NoPlaceToMovePods")
                 self._mark(nodes[i].name, reason, now)
         self.unneeded_nodes.update(unneeded, now)
+        if self.latency_tracker is not None:
+            self.latency_tracker.observe_candidates(unneeded, now)
         self.state.unneeded = unneeded
         self.state.removal = removal
         self.state.candidate_indices = cand
@@ -166,6 +175,7 @@ class Planner:
 
         ordered = sorted(self.state.unneeded, key=lambda n: self.unneeded_nodes.since.get(n, now))
         group_room: dict[str, int] = {}
+        pdb_reserved: dict[int, int] = {}  # budget consumed by candidates confirmed THIS pass
         for name in ordered:
             if len(out) >= total_budget:
                 break
@@ -206,6 +216,23 @@ class Planner:
                 if drain_budget <= 0:
                     continue
 
+            # PDB gate (reference: planner consults the shared
+            # RemainingPdbTracker before confirming a drain; the actuator
+            # deducts at eviction time). Need is accumulated across the
+            # candidates confirmed in THIS pass so two drains can't jointly
+            # overdraw one budget.
+            pdb_need: dict[int, int] = {}
+            if not is_empty and self.pdb_tracker is not None:
+                victims = [
+                    enc.scheduled_pods[int(pod_slot[k, s])]
+                    for s in range(dest_node.shape[1])
+                    if int(dest_node[k, s]) >= 0
+                ]
+                if not self.pdb_tracker.can_remove_pods(victims, pdb_reserved):
+                    self._mark(name, "NotEnoughPdb", now)
+                    continue
+                pdb_need = self.pdb_tracker.reservation(victims)
+
             # charge destinations
             moves: dict[int, int] = {}
             ok = True
@@ -234,6 +261,8 @@ class Planner:
             # min-quota tracker deducts per confirmed removal)
             if quota_status is not None:
                 self.quota.deduct(quota_status, nd)
+            for i_pdb, n_pdb in pdb_need.items():
+                pdb_reserved[i_pdb] = pdb_reserved.get(i_pdb, 0) + n_pdb
             group_room[g.id()] -= 1
             if is_empty:
                 empty_budget -= 1

@@ -25,6 +25,8 @@ from kubernetes_autoscaler_tpu.cloudprovider.provider import CloudProvider
 from kubernetes_autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
 from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
 from kubernetes_autoscaler_tpu.core.scaledown.actuator import Actuator
+from kubernetes_autoscaler_tpu.core.scaledown.latencytracker import NodeLatencyTracker
+from kubernetes_autoscaler_tpu.core.scaledown.pdb import RemainingPdbTracker
 from kubernetes_autoscaler_tpu.core.scaledown.planner import Planner
 from kubernetes_autoscaler_tpu.core.scaleup.orchestrator import (
     ScaleUpOrchestrator,
@@ -87,8 +89,16 @@ class StaticAutoscaler:
         self.scale_up_orchestrator = ScaleUpOrchestrator(
             provider, self.options, self.cluster_state, expander, None
         )
-        self.planner = Planner(provider, self.options, None)
-        self.actuator = Actuator(provider, self.options, eviction_sink)
+        # shared scale-down trackers (reference: planner & actuator share one
+        # RemainingPdbTracker; latency spans plan→delete)
+        self.pdb_tracker = RemainingPdbTracker()
+        self.latency_tracker = NodeLatencyTracker()
+        self.planner = Planner(provider, self.options, None,
+                               pdb_tracker=self.pdb_tracker,
+                               latency_tracker=self.latency_tracker)
+        self.actuator = Actuator(provider, self.options, eviction_sink,
+                                 pdb_tracker=self.pdb_tracker,
+                                 latency_tracker=self.latency_tracker)
         self.last_scale_down_delete: float = 0.0
         self.last_scale_down_fail: float = 0.0
 
@@ -129,6 +139,11 @@ class StaticAutoscaler:
             ctx = ProcessorContext(self.options, self.provider, now)
             pods = self.processors.run_pod_list(pods, ctx)
 
+            # PDB refresh (reference: planner.go builds the RemainingPdbTracker
+            # from the PDB lister each loop)
+            list_pdbs = getattr(self.source, "list_pdbs", None)
+            self.pdb_tracker.set_pdbs(list_pdbs() if list_pdbs else [])
+
             # tensor snapshot
             node_group_ids = self._node_group_index(nodes)
             with self.metrics.time_function("snapshot_build"):
@@ -142,7 +157,10 @@ class StaticAutoscaler:
                     skip_nodes_with_system_pods=self.options.skip_nodes_with_system_pods,
                     skip_nodes_with_local_storage=self.options.skip_nodes_with_local_storage,
                     skip_nodes_with_custom_controller_pods=self.options.skip_nodes_with_custom_controller_pods,
-                ), now=now)
+                ), now=now,
+                    pdb_namespaced_names=self.pdb_tracker.namespaced_names_with_pdb(
+                        [p for p in pods if p.node_name]
+                    ))
             self.quota.registry = enc.registry
             self.scale_up_orchestrator.quota = self.quota
             self.planner.quota = self.quota

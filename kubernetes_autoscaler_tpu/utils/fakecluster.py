@@ -35,6 +35,7 @@ class FakeCluster:
         )
         self.nodes: dict[str, Node] = {}
         self.pods: dict[str, Pod] = {}
+        self.pdbs: list = []
         self.provision_delay_s = provision_delay_s
         self.evicted: list[str] = []
         self._pending: list[_PendingProvision] = []
@@ -93,6 +94,27 @@ class FakeCluster:
 
     def list_pods(self) -> list[Pod]:
         return list(self.pods.values())
+
+    def list_pdbs(self) -> list:
+        """Effective budgets, the way the API server maintains
+        status.disruptionsAllowed: the configured allowance minus matching
+        pods currently disrupted (evicted and not yet Running again)."""
+        from dataclasses import replace
+
+        out = []
+        for pdb in self.pdbs:
+            disrupted = sum(
+                1 for p in self.pods.values()
+                if pdb.matches(p) and p.phase != "Running"
+            )
+            out.append(replace(
+                pdb,
+                disruptions_allowed=max(pdb.disruptions_allowed - disrupted, 0),
+            ))
+        return out
+
+    def add_pdb(self, pdb) -> None:
+        self.pdbs.append(pdb)
 
     # ---- EvictionSink ----
 

@@ -1,0 +1,182 @@
+"""Scale-down auxiliary trackers: PDB budgets, removal latency, priority evictor.
+
+Reference analogs: core/scaledown/pdb (RemainingPdbTracker tests),
+core/scaledown/latencytracker, actuation/priority.go.
+"""
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.scaledown.actuator import (
+    priority_eviction_order,
+)
+from kubernetes_autoscaler_tpu.core.scaledown.latencytracker import (
+    NodeLatencyTracker,
+)
+from kubernetes_autoscaler_tpu.core.scaledown.pdb import (
+    PodDisruptionBudget,
+    RemainingPdbTracker,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def test_pdb_tracker_budget_accounting():
+    t = RemainingPdbTracker([
+        PodDisruptionBudget("web-pdb", match_labels={"app": "web"},
+                            disruptions_allowed=1),
+    ])
+    p1 = build_test_pod("w1", labels={"app": "web"})
+    p2 = build_test_pod("w2", labels={"app": "web"})
+    other = build_test_pod("x", labels={"app": "other"})
+    assert t.can_remove_pods([p1])
+    assert t.can_remove_pods([other])
+    assert not t.can_remove_pods([p1, p2])          # 2 > allowed 1
+    assert t.first_blocker([p1, p2]) is p2
+    t.remove_pods([p1])
+    assert t.remaining("web-pdb") == 0
+    assert not t.can_remove_pods([p2])              # budget spent
+    assert t.can_remove_pods([other])               # unmatched pods unaffected
+
+
+def test_pdb_tracker_namespace_scoping():
+    t = RemainingPdbTracker([
+        PodDisruptionBudget("pdb", namespace="prod", match_labels={"app": "db"},
+                            disruptions_allowed=0),
+    ])
+    prod = build_test_pod("db1", namespace="prod", labels={"app": "db"})
+    dev = build_test_pod("db2", namespace="dev", labels={"app": "db"})
+    assert not t.can_remove_pods([prod])
+    assert t.can_remove_pods([dev])
+    assert t.namespaced_names_with_pdb([prod, dev]) == frozenset({"prod/db1"})
+
+
+def test_latency_tracker_spans_candidate_to_deletion():
+    lt = NodeLatencyTracker()
+    lt.observe_candidates(["n1", "n2"], now=100.0)
+    lt.observe_candidates(["n1"], now=110.0)        # n2 became needed again
+    assert "n2" not in lt.started
+    assert lt.observe_deletion("n1", now=130.0) == 30.0
+    assert lt.observe_deletion("n1", now=131.0) is None  # already observed
+    lt.observe_candidates(["n2"], now=140.0)        # fresh clock after reset
+    assert lt.started["n2"] == 140.0
+
+
+def test_priority_eviction_order_ascending():
+    pods = [build_test_pod(f"p{i}") for i in range(3)]
+    pods[0].priority = 100
+    pods[1].priority = -5
+    pods[2].priority = 0
+    assert [p.name for p in priority_eviction_order(pods)] == ["p1", "p2", "p0"]
+
+
+def _scale_down_world(pdbs):
+    """One idle drainable node (n2) whose pod is covered by `pdbs`."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    for name in ("n1", "n2"):
+        fake.add_existing_node(
+            "ng1", build_test_node(name, cpu_milli=4000, mem_mib=8192)
+        )
+    # n1 busy (utilization above threshold), n2 idle but for one movable pod
+    fake.add_pod(build_test_pod("busy", cpu_milli=3000, mem_mib=4096,
+                                owner_name="rs", node_name="n1"))
+    fake.add_pod(build_test_pod("victim", cpu_milli=100, mem_mib=128,
+                                owner_name="rs", labels={"app": "web"},
+                                node_name="n2"))
+    for pdb in pdbs:
+        fake.add_pdb(pdb)
+    opts = AutoscalingOptions(
+        scale_down_delay_after_add_s=0.0,
+        scale_down_delay_after_failure_s=0.0,
+        node_shape_bucket=16, group_shape_bucket=16,
+        max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0,
+        ),
+    )
+    return fake, StaticAutoscaler(fake.provider, fake, options=opts,
+                                  eviction_sink=fake)
+
+
+def test_runonce_pdb_blocks_drain():
+    fake, a = _scale_down_world([
+        PodDisruptionBudget("web-pdb", match_labels={"app": "web"},
+                            disruptions_allowed=0),
+    ])
+    status = a.run_once(now=1000.0)
+    assert status.scale_down_deleted == []
+    assert "n2" in fake.nodes
+    assert fake.evicted == []
+    assert a.planner.unremovable.reason("n2") == "NotEnoughPdb"
+
+
+def test_try_remove_pods_atomic():
+    t = RemainingPdbTracker([
+        PodDisruptionBudget("pdb", match_labels={"app": "web"},
+                            disruptions_allowed=1),
+    ])
+    p1 = build_test_pod("w1", labels={"app": "web"})
+    p2 = build_test_pod("w2", labels={"app": "web"})
+    assert t.try_remove_pods([p1])
+    assert not t.try_remove_pods([p2])   # budget spent; deducts nothing
+    assert t.remaining("pdb") == 0
+
+
+def test_planner_accumulates_pdb_need_across_candidates():
+    """Two drainable nodes whose pods share one PDB (allowed=1): only ONE may
+    be confirmed per pass — the second must not jointly overdraw the budget."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    for name in ("n1", "n2", "n3"):
+        fake.add_existing_node(
+            "ng1", build_test_node(name, cpu_milli=4000, mem_mib=8192)
+        )
+    fake.add_pod(build_test_pod("busy", cpu_milli=3000, mem_mib=4096,
+                                owner_name="rs", node_name="n1"))
+    for i, node in enumerate(("n2", "n3")):
+        fake.add_pod(build_test_pod(f"victim{i}", cpu_milli=100, mem_mib=128,
+                                    owner_name="rs", labels={"app": "web"},
+                                    node_name=node))
+    fake.add_pdb(PodDisruptionBudget("web-pdb", match_labels={"app": "web"},
+                                     disruptions_allowed=1))
+    opts = AutoscalingOptions(
+        scale_down_delay_after_add_s=0.0,
+        scale_down_delay_after_failure_s=0.0,
+        scale_down_delay_after_delete_s=0.0,
+        max_drain_parallelism=2,  # so the PDB gate, not the drain budget, decides
+        node_shape_bucket=16, group_shape_bucket=16,
+        max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0,
+        ),
+    )
+    a = StaticAutoscaler(fake.provider, fake, options=opts, eviction_sink=fake)
+    status = a.run_once(now=1000.0)
+    assert len(status.scale_down_deleted) == 1
+    assert len(fake.evicted) == 1
+    # marked, not silently dropped
+    blocked = [n for n in ("n2", "n3") if n in fake.nodes]
+    assert a.planner.unremovable.reason(blocked[0]) == "NotEnoughPdb"
+    # next loop: the evicted victim is still Pending (disrupted), so the
+    # effective budget stays 0 and the second node stays up
+    status2 = a.run_once(now=1001.0)
+    assert status2.scale_down_deleted == []
+
+
+def test_runonce_pdb_allows_drain_within_budget():
+    fake, a = _scale_down_world([
+        PodDisruptionBudget("web-pdb", match_labels={"app": "web"},
+                            disruptions_allowed=1),
+    ])
+    status = a.run_once(now=1000.0)
+    assert status.scale_down_deleted == ["n2"]
+    assert fake.evicted == ["victim"]
+    # actuator deducted the eviction from the shared tracker
+    assert a.pdb_tracker.remaining("web-pdb") == 0
+    # latency tracker observed the removal
+    assert [n for n, _ in a.latency_tracker.observed] == ["n2"]
