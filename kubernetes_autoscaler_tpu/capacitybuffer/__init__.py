@@ -1,0 +1,13 @@
+from kubernetes_autoscaler_tpu.capacitybuffer.api import (
+    BufferStatus,
+    CapacityBuffer,
+)
+from kubernetes_autoscaler_tpu.capacitybuffer.controller import BufferController
+from kubernetes_autoscaler_tpu.capacitybuffer.translators import translate_buffer
+
+__all__ = [
+    "BufferController",
+    "BufferStatus",
+    "CapacityBuffer",
+    "translate_buffer",
+]

@@ -1,0 +1,61 @@
+"""Buffer controller: reconcile buffers and feed injection.
+
+Reference counterpart: capacitybuffer/controller (wired by
+InitializeAndRunDefaultBufferController, builder/autoscaler.go:209) — each
+reconcile pass runs filters (strategy gate) → translators (resolve status) →
+status updater. The autoscaler side then injects `pending_pods()` into every
+loop's unschedulable list via BufferPodListProcessor.
+"""
+
+from __future__ import annotations
+
+from kubernetes_autoscaler_tpu.capacitybuffer.api import (
+    ACTIVE_PROVISIONING_STRATEGY,
+    READY_FOR_PROVISIONING,
+    CapacityBuffer,
+)
+from kubernetes_autoscaler_tpu.capacitybuffer.translators import (
+    fake_pods_for,
+    translate_buffer,
+)
+from kubernetes_autoscaler_tpu.models.api import Pod
+
+
+class BufferController:
+    def __init__(self, buffers: list[CapacityBuffer] | None = None):
+        self.buffers: list[CapacityBuffer] = list(buffers or [])
+
+    def reconcile(self) -> list[CapacityBuffer]:
+        """Filter + translate every buffer; returns the active set
+        (reference: controller loop over filters/translators/updater)."""
+        active = []
+        for buf in self.buffers:
+            # strategy filter (reference: capacitybuffer/filters) — foreign
+            # strategies are parked, not provisioned
+            if buf.provisioning_strategy != ACTIVE_PROVISIONING_STRATEGY:
+                buf.status.conditions[READY_FOR_PROVISIONING] = "False"
+                buf.status.conditions["reason"] = "UnsupportedProvisioningStrategy"
+                continue
+            translate_buffer(buf)
+            if buf.status.ready():
+                active.append(buf)
+        return active
+
+    def pending_pods(self) -> list[Pod]:
+        """Fake pending pods for all active buffers — injected each loop."""
+        out: list[Pod] = []
+        for buf in self.reconcile():
+            out.extend(fake_pods_for(buf))
+        return out
+
+
+class BufferPodListProcessor:
+    """PodListProcessor injecting buffer headroom pods into the pending list
+    (reference: the capacity-buffer injection step of the default pod-list
+    chain; SURVEY.md §2.7 capacitybuffer row)."""
+
+    def __init__(self, controller: BufferController):
+        self.controller = controller
+
+    def process(self, pods: list[Pod], ctx) -> list[Pod]:
+        return pods + self.controller.pending_pods()

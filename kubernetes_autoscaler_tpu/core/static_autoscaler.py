@@ -136,7 +136,10 @@ class StaticAutoscaler:
             self.scale_up_orchestrator.scale_up_to_min_sizes(now)
 
             # host-side pod pipeline
-            ctx = ProcessorContext(self.options, self.provider, now)
+            ctx = ProcessorContext(
+                self.options, self.provider, now,
+                list_workloads=getattr(self.source, "list_workloads", None),
+            )
             pods = self.processors.run_pod_list(pods, ctx)
 
             # PDB refresh (reference: planner.go builds the RemainingPdbTracker

@@ -104,6 +104,22 @@ class Pod:
 
 
 @dataclass
+class Workload:
+    """A replica-controller-shaped object (Deployment/ReplicaSet/Job/...).
+
+    Consumed by processors/podinjection (reference: processors/podinjection
+    reads Deployments/Jobs/ReplicaSets via listers) and by capacity-buffer
+    scalable references (reference: capacitybuffer scalableRef translators)."""
+
+    kind: str
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    replicas: int = 0
+    template: Optional[Pod] = None
+
+
+@dataclass
 class Node:
     name: str
     labels: dict[str, str] = field(default_factory=dict)

@@ -33,6 +33,9 @@ class ProcessorContext:
     options: AutoscalingOptions
     provider: CloudProvider
     now: float = field(default_factory=time.time)
+    # workload lister for pod injection (reference: podinjection reads
+    # Deployments/Jobs/ReplicaSets via listers); None = feature off
+    list_workloads: Callable[[], list] | None = None
 
 
 class ClearTpuRequestsProcessor:

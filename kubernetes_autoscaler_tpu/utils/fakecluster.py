@@ -36,6 +36,7 @@ class FakeCluster:
         self.nodes: dict[str, Node] = {}
         self.pods: dict[str, Pod] = {}
         self.pdbs: list = []
+        self.workloads: list = []
         self.provision_delay_s = provision_delay_s
         self.evicted: list[str] = []
         self._pending: list[_PendingProvision] = []
@@ -115,6 +116,12 @@ class FakeCluster:
 
     def add_pdb(self, pdb) -> None:
         self.pdbs.append(pdb)
+
+    def list_workloads(self) -> list:
+        return list(self.workloads)
+
+    def add_workload(self, workload) -> None:
+        self.workloads.append(workload)
 
     # ---- EvictionSink ----
 

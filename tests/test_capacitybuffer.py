@@ -1,0 +1,155 @@
+"""Capacity buffers + pod injection.
+
+Reference analogs: capacitybuffer/ controller+translator tests and
+processors/podinjection tests (SURVEY.md §2.6, §2.7).
+"""
+
+from kubernetes_autoscaler_tpu.capacitybuffer.api import (
+    ACTIVE_PROVISIONING_STRATEGY,
+    CapacityBuffer,
+)
+from kubernetes_autoscaler_tpu.capacitybuffer.controller import (
+    BufferController,
+    BufferPodListProcessor,
+)
+from kubernetes_autoscaler_tpu.capacitybuffer.translators import (
+    fake_pods_for,
+    is_buffer_pod,
+    translate_buffer,
+)
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.models.api import Workload
+from kubernetes_autoscaler_tpu.processors.podinjection import (
+    PodInjectionProcessor,
+    injected_pods_for,
+)
+from kubernetes_autoscaler_tpu.processors.processors import AutoscalingProcessors
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def test_translate_pod_template_buffer():
+    buf = CapacityBuffer("b1", pod_template=build_test_pod("tmpl", cpu_milli=500),
+                         replicas=3)
+    translate_buffer(buf)
+    assert buf.status.ready()
+    pods = fake_pods_for(buf)
+    assert len(pods) == 3
+    assert all(is_buffer_pod(p) for p in pods)
+    assert all(p.phase == "Pending" and not p.node_name for p in pods)
+    assert pods[0].name == "capacity-buffer-b1-0"
+    assert pods[0].owner.kind == "CapacityBuffer"
+
+
+def test_translate_percentage_of_scalable():
+    w = Workload("Deployment", "web", replicas=10,
+                 template=build_test_pod("tmpl", cpu_milli=250))
+    buf = CapacityBuffer("b2", scalable_ref=w, percentage=25.0)
+    translate_buffer(buf)
+    assert buf.status.replicas == 3          # ceil(10 * 0.25)
+    buf2 = CapacityBuffer("b3", scalable_ref=w, percentage=1.0,
+                          limits_min_replicas=2)
+    translate_buffer(buf2)
+    assert buf2.status.replicas == 2         # min-replicas floor
+
+
+def test_translate_rejects_bad_specs():
+    buf = CapacityBuffer("bad")
+    translate_buffer(buf)
+    assert not buf.status.ready()
+    assert buf.status.conditions["reason"] == "NoTemplateOrScalableRef"
+
+    w = Workload("Deployment", "web", replicas=10)   # no template
+    buf2 = CapacityBuffer("bad2", scalable_ref=w, percentage=50.0)
+    translate_buffer(buf2)
+    assert not buf2.status.ready()
+
+
+def test_controller_strategy_filter():
+    good = CapacityBuffer("a", pod_template=build_test_pod("t"), replicas=1)
+    foreign = CapacityBuffer("b", pod_template=build_test_pod("t"), replicas=1,
+                             provisioning_strategy="someone-elses-strategy")
+    c = BufferController([good, foreign])
+    pods = c.pending_pods()
+    assert len(pods) == 1
+    assert foreign.status.conditions["reason"] == "UnsupportedProvisioningStrategy"
+    assert good.status.conditions["Provisioning"] == "True"
+
+
+def test_injected_pods_fill_replica_gap():
+    w = Workload("Job", "batch", uid="u1", replicas=5,
+                 template=build_test_pod("tmpl", cpu_milli=100))
+    existing = [
+        build_test_pod("p0", owner_name="batch", owner_kind="Job"),
+        build_test_pod("p1", owner_name="batch", owner_kind="Job"),
+    ]
+    fakes = injected_pods_for(w, existing)
+    assert len(fakes) == 3
+    assert fakes[0].owner.uid == "u1"
+    # terminal pods don't count toward the existing total
+    existing[0].phase = "Succeeded"
+    assert len(injected_pods_for(w, existing)) == 4
+    # no gap -> no injection
+    w.replicas = 2
+    existing[0].phase = "Running"
+    assert injected_pods_for(w, existing) == []
+
+
+def _opts(**kw):
+    base = dict(
+        scale_down_delay_after_add_s=0.0,
+        node_shape_bucket=16, group_shape_bucket=16,
+        max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    base.update(kw)
+    return AutoscalingOptions(**base)
+
+
+def test_runonce_buffer_provisions_headroom():
+    """A buffer alone (zero real pending pods) must trigger scale-up."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("n1", cpu_milli=4000, mem_mib=8192))
+    # headroom: 4 pods x 1500m won't fit the one existing (empty) node
+    controller = BufferController([
+        CapacityBuffer("head", pod_template=build_test_pod(
+            "t", cpu_milli=1500, mem_mib=512), replicas=4),
+    ])
+    procs = AutoscalingProcessors.default()
+    procs.pod_list_processors.append(BufferPodListProcessor(controller))
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
+                         processors=procs, eviction_sink=fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    # 4x1500m: 2 fit the existing node, 2 need one more 4-CPU node
+    assert status.scale_up.increases == {"ng1": 1}
+
+
+def test_runonce_pod_injection_prescales():
+    """A Job with replicas=6 but only 1 created pod injects 5 fakes."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("n1", cpu_milli=4000, mem_mib=8192))
+    fake.add_pod(build_test_pod("real-0", cpu_milli=1800, mem_mib=256,
+                                owner_name="batch", owner_kind="Job"))
+    fake.add_workload(Workload(
+        "Job", "batch", uid="u1", replicas=6,
+        template=build_test_pod("tmpl-pod", cpu_milli=1800, mem_mib=256,
+                                owner_name="batch", owner_kind="Job"),
+    ))
+    procs = AutoscalingProcessors.default()
+    procs.pod_list_processors.append(PodInjectionProcessor())
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
+                         processors=procs, eviction_sink=fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    # 6 pods x 1800m, 2 per 4-CPU node -> 3 nodes total, 1 exists -> +2
+    assert status.scale_up.increases == {"ng1": 2}
