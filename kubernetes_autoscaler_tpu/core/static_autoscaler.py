@@ -102,6 +102,29 @@ class StaticAutoscaler:
         self.last_scale_down_delete: float = 0.0
         self.last_scale_down_fail: float = 0.0
 
+        # ProvisioningRequest wiring (reference: builder/autoscaler.go wraps
+        # the scale-up orchestrator when ProvReq support is on) — active when
+        # the data source exposes requests
+        self.provreq_wrapper = None
+        list_provreqs = getattr(source, "list_provisioning_requests", None)
+        if list_provreqs is not None:
+            from kubernetes_autoscaler_tpu.provisioningrequest.orchestrator import (
+                ProvReqOrchestrator,
+                ProvReqPodListProcessor,
+                WrapperOrchestrator,
+            )
+
+            orch = ProvReqOrchestrator(
+                provider,
+                node_bucket=self.options.node_shape_bucket,
+                group_bucket=self.options.group_shape_bucket,
+                max_new_nodes_static=self.options.max_new_nodes_static,
+            )
+            self.provreq_wrapper = WrapperOrchestrator(orch, list_provreqs)
+            self.processors.pod_list_processors.append(
+                ProvReqPodListProcessor(list_provreqs)
+            )
+
     # ---- the loop body (reference: RunOnce :296) ----
 
     def run_once(self, now: float | None = None) -> RunOnceStatus:
@@ -134,6 +157,13 @@ class StaticAutoscaler:
 
             # min-size enforcement (reference: ScaleUpToNodeGroupMinSize :223)
             self.scale_up_orchestrator.scale_up_to_min_sizes(now)
+
+            # ProvisioningRequests on alternating turns (reference:
+            # WrapperOrchestrator, provisioningrequest/orchestrator/)
+            if self.provreq_wrapper is not None:
+                self.provreq_wrapper.maybe_run(
+                    nodes, [p for p in pods if p.node_name], now
+                )
 
             # host-side pod pipeline
             ctx = ProcessorContext(

@@ -1,0 +1,19 @@
+from kubernetes_autoscaler_tpu.provisioningrequest.api import (
+    BEST_EFFORT_ATOMIC_CLASS,
+    CHECK_CAPACITY_CLASS,
+    PodSet,
+    ProvisioningRequest,
+)
+from kubernetes_autoscaler_tpu.provisioningrequest.orchestrator import (
+    ProvReqOrchestrator,
+    WrapperOrchestrator,
+)
+
+__all__ = [
+    "BEST_EFFORT_ATOMIC_CLASS",
+    "CHECK_CAPACITY_CLASS",
+    "PodSet",
+    "ProvisioningRequest",
+    "ProvReqOrchestrator",
+    "WrapperOrchestrator",
+]

@@ -37,6 +37,7 @@ class FakeCluster:
         self.pods: dict[str, Pod] = {}
         self.pdbs: list = []
         self.workloads: list = []
+        self.provreqs: list = []
         self.provision_delay_s = provision_delay_s
         self.evicted: list[str] = []
         self._pending: list[_PendingProvision] = []
@@ -122,6 +123,12 @@ class FakeCluster:
 
     def add_workload(self, workload) -> None:
         self.workloads.append(workload)
+
+    def list_provisioning_requests(self) -> list:
+        return list(self.provreqs)
+
+    def add_provisioning_request(self, pr) -> None:
+        self.provreqs.append(pr)
 
     # ---- EvictionSink ----
 

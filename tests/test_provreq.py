@@ -1,0 +1,134 @@
+"""ProvisioningRequest: check-capacity, best-effort-atomic, booking lifecycle.
+
+Reference analogs: provisioningrequest/checkcapacity and besteffortatomic
+orchestrator tests, wrapper_orchestrator_test.go.
+"""
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.provisioningrequest.api import (
+    BEST_EFFORT_ATOMIC_CLASS,
+    BOOKING_EXPIRED,
+    CHECK_CAPACITY_CLASS,
+    FAILED,
+    PROVISIONED,
+    PodSet,
+    ProvisioningRequest,
+)
+from kubernetes_autoscaler_tpu.provisioningrequest.orchestrator import (
+    ProvReqOrchestrator,
+)
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def test_provreq_pods_and_booking_lifecycle():
+    pr = ProvisioningRequest(
+        "r1", pod_sets=[PodSet(build_test_pod("t", cpu_milli=500), 3)],
+        booking_ttl_s=60.0,
+    )
+    pods = pr.pods()
+    assert len(pods) == 3 and pods[0].name == "provreq-r1-0-0"
+    assert not pr.booked(now=0.0)
+    pr.set_condition(PROVISIONED, True, "ok", now=100.0)
+    assert pr.booked(now=100.0) and pr.booked(now=159.0)
+    assert not pr.booked(now=161.0)
+    assert pr.expire_booking(now=161.0)
+    assert pr.has(BOOKING_EXPIRED) and pr.terminal()
+
+
+def _world(node_cpu=4000, n_nodes=1, max_size=10):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=node_cpu, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=max_size)
+    for i in range(n_nodes):
+        fake.add_existing_node(
+            "ng1", build_test_node(f"n{i}", cpu_milli=node_cpu, mem_mib=8192)
+        )
+    return fake
+
+
+def test_check_capacity_success_and_failure():
+    fake = _world(node_cpu=4000, n_nodes=2)
+    orch = ProvReqOrchestrator(fake.provider, node_bucket=16, group_bucket=16)
+    fits = ProvisioningRequest(
+        "fits", class_name=CHECK_CAPACITY_CLASS,
+        pod_sets=[PodSet(build_test_pod("t", cpu_milli=1000, mem_mib=256), 6)],
+    )
+    orch.run([fits], fake.list_nodes(), [], now=10.0)
+    assert fits.has(PROVISIONED) and fits.booked(11.0)
+
+    too_big = ProvisioningRequest(
+        "toobig", class_name=CHECK_CAPACITY_CLASS,
+        pod_sets=[PodSet(build_test_pod("t", cpu_milli=3000, mem_mib=256), 5)],
+    )
+    orch.run([too_big], fake.list_nodes(), [], now=10.0)
+    assert too_big.has(FAILED) and not too_big.has(PROVISIONED)
+    # no cloud calls for check-capacity
+    assert len(fake.nodes) == 2
+
+
+def test_best_effort_atomic_scales_all_or_nothing():
+    fake = _world(node_cpu=4000, n_nodes=1, max_size=5)
+    orch = ProvReqOrchestrator(fake.provider, node_bucket=16, group_bucket=16,
+                               max_new_nodes_static=16)
+    pr = ProvisioningRequest(
+        "atomic", class_name=BEST_EFFORT_ATOMIC_CLASS,
+        pod_sets=[PodSet(build_test_pod("t", cpu_milli=1800, mem_mib=256), 8)],
+    )
+    orch.run([pr], fake.list_nodes(), [], now=10.0)
+    assert pr.has(PROVISIONED)
+    # 8 pods x 1800m, 2/node -> 4 nodes; 1 existing empty node absorbs 2 pods
+    # but atomic estimation packs NEW nodes for the whole request -> +4
+    assert len(fake.nodes) == 5
+
+
+def test_best_effort_atomic_too_large_retries_not_failed():
+    fake = _world(node_cpu=4000, n_nodes=1, max_size=2)   # headroom: 1 node
+    orch = ProvReqOrchestrator(fake.provider, node_bucket=16, group_bucket=16,
+                               max_new_nodes_static=16)
+    pr = ProvisioningRequest(
+        "huge", class_name=BEST_EFFORT_ATOMIC_CLASS,
+        pod_sets=[PodSet(build_test_pod("t", cpu_milli=3000, mem_mib=256), 10)],
+    )
+    orch.run([pr], fake.list_nodes(), [], now=10.0)
+    assert not pr.has(PROVISIONED)
+    assert not pr.has(FAILED)           # retried next loop
+    assert len(fake.nodes) == 1         # nothing partial happened
+
+
+def test_runonce_booked_provreq_holds_capacity():
+    """A booked check-capacity request injects its pods, so the otherwise-idle
+    second node is not scaled down while the booking lasts."""
+    fake = _world(node_cpu=4000, n_nodes=2)
+    fake.add_pod(build_test_pod("busy", cpu_milli=3000, mem_mib=4096,
+                                owner_name="rs", node_name="n0"))
+    pr = ProvisioningRequest(
+        "book", class_name=CHECK_CAPACITY_CLASS,
+        pod_sets=[PodSet(build_test_pod("t", cpu_milli=3000, mem_mib=1024), 1)],
+        booking_ttl_s=600.0,
+    )
+    fake.add_provisioning_request(pr)
+    opts = AutoscalingOptions(
+        scale_down_delay_after_add_s=0.0,
+        scale_down_delay_after_failure_s=0.0,
+        node_shape_bucket=16, group_shape_bucket=16,
+        max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    a = StaticAutoscaler(fake.provider, fake, options=opts, eviction_sink=fake)
+    status1 = a.run_once(now=1000.0)    # provreq turn: books capacity
+    assert pr.has(PROVISIONED)
+    assert status1.scale_down_deleted == []
+    status2 = a.run_once(now=1001.0)    # injected pods keep n1 "needed"
+    assert status2.scale_down_deleted == []
+    assert len(fake.nodes) == 2
+
+    # once the booking expires the idle node is reclaimed
+    status3 = a.run_once(now=2000.0)
+    assert pr.has(BOOKING_EXPIRED)
+    assert len(fake.nodes) == 1
