@@ -36,6 +36,9 @@ from kubernetes_autoscaler_tpu.expander.strategies import build_expander
 from kubernetes_autoscaler_tpu.metrics.metrics import HealthCheck, Registry, default_registry
 from kubernetes_autoscaler_tpu.models.api import Node, Pod
 from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.observers.nodegroupchange import (
+    NodeGroupChangeObserverList,
+)
 from kubernetes_autoscaler_tpu.processors.processors import (
     AutoscalingProcessors,
     ProcessorContext,
@@ -76,6 +79,8 @@ class StaticAutoscaler:
         registry: Registry | None = None,
         eviction_sink=None,
         expander_priorities: dict[int, list[str]] | None = None,
+        debugging_snapshotter=None,
+        status_sink=None,
     ):
         self.options = options or AutoscalingOptions()
         self.provider = provider
@@ -83,6 +88,13 @@ class StaticAutoscaler:
         self.processors = processors or AutoscalingProcessors.default()
         self.metrics = registry or default_registry
         self.health = HealthCheck()
+        # debugging /snapshotz collector (reference: debuggingsnapshot/)
+        self.debugging_snapshotter = debugging_snapshotter
+        # status-document sink (reference: WriteStatusConfigMap each loop)
+        self.status_sink = status_sink
+        self.last_status = None
+        # scale event broadcast (reference: observers/nodegroupchange)
+        self.node_group_change_observers = NodeGroupChangeObserverList()
         self.cluster_state = ClusterStateRegistry(provider, self.options)
         self.quota = QuotaTracker(provider.get_resource_limiter(), None)  # registry set per loop
         expander = build_expander(self.options.expander, expander_priorities)
@@ -211,11 +223,33 @@ class StaticAutoscaler:
                     t.name = f"upcoming-{gid}-{k}"
                     snapshot.add_node(t, group_id=-1)
 
+            # debugging snapshot collection (reference:
+            # static_autoscaler.go:299-300,404 — only when /snapshotz armed)
+            dbg = self.debugging_snapshotter
+            if dbg is not None and dbg.is_data_collection_allowed():
+                by_node: dict[str, list[Pod]] = {}
+                for p in pods:
+                    if p.node_name:
+                        by_node.setdefault(p.node_name, []).append(p)
+                dbg.set_cluster_nodes(nodes, by_node)
+                dbg.set_template_nodes({
+                    g.id(): g.template_node_info()
+                    for g in self.provider.node_groups()
+                })
+
             # filter-out-schedulable (reference: PodListProcessor.Process :530)
             with self.metrics.time_function("filter_out_schedulable"):
                 packed = snapshot.schedule_pending_on_existing()
                 snapshot.apply_placement(packed.placed)
             remaining = int(np.asarray(snapshot.state.specs.count).sum())
+            if dbg is not None and dbg.is_data_collection_allowed():
+                scheduled_counts = np.asarray(packed.scheduled)
+                fitting = [
+                    p for gi, slots in enumerate(enc.group_pods)
+                    if gi < scheduled_counts.shape[0] and scheduled_counts[gi] > 0
+                    for p in (enc.pending_pods[s] for s in slots)
+                ]
+                dbg.set_unscheduled_pods_can_be_scheduled(fitting)
             status.pending_pods = remaining
             self.metrics.gauge("unschedulable_pods_count").set(remaining)
             # Sync the post-placement view unconditionally: the planner must see
@@ -234,6 +268,14 @@ class StaticAutoscaler:
                 scaled_up = result.scaled_up
                 for cb in self.processors.on_scale_up_status:
                     cb(result)
+                for gid, delta in result.increases.items():
+                    self.node_group_change_observers.register_scale_up(
+                        gid, delta, now
+                    )
+                for gid, err in result.errors.items():
+                    self.node_group_change_observers.register_failed_scale_up(
+                        gid, err, now
+                    )
                 if result.scaled_up:
                     self.metrics.counter("scaled_up_nodes_total").inc(
                         sum(result.increases.values())
@@ -269,11 +311,34 @@ class StaticAutoscaler:
                                 r.node, now, group_of.get(r.node, "")
                             )
                             self.last_scale_down_delete = now
+                            self.node_group_change_observers.register_scale_down(
+                                group_of.get(r.node, ""), r.node, now
+                            )
                         else:
                             self.last_scale_down_fail = now
+                            self.node_group_change_observers.register_failed_scale_down(
+                                group_of.get(r.node, ""), r.node, r.reason, now
+                            )
                     self.metrics.counter("scaled_down_nodes_total").inc(
                         len(status.scale_down_deleted)
                     )
+
+            # status document (reference: WriteStatusConfigMap every loop,
+            # static_autoscaler.go:418-421)
+            from kubernetes_autoscaler_tpu.clusterstate.api import build_status
+
+            self.last_status = build_status(
+                self.cluster_state, now,
+                scale_down_candidates=status.unneeded_nodes,
+            )
+            if self.status_sink is not None:
+                try:
+                    self.status_sink(self.last_status)
+                except Exception:
+                    pass
+
+            if self.debugging_snapshotter is not None:
+                self.debugging_snapshotter.flush(now)
 
             self.health.mark_active(now)
         return status

@@ -1,0 +1,5 @@
+from kubernetes_autoscaler_tpu.debuggingsnapshot.snapshotter import (
+    DebuggingSnapshotter,
+)
+
+__all__ = ["DebuggingSnapshotter"]

@@ -1,0 +1,119 @@
+"""Debugging snapshot: on-demand JSON dump of the autoscaler's internal view.
+
+Reference counterpart: cluster-autoscaler/debuggingsnapshot/ (SURVEY.md §2.7)
+— the `/snapshotz` HTTP endpoint arms a snapshotter; during the next RunOnce
+the loop feeds it node/pod state (static_autoscaler.go:299-300, 404, 527) and
+the pending HTTP request receives the JSON once the loop completes.
+
+Same protocol here: `request_snapshot()` arms it (returns a handle to await),
+StaticAutoscaler calls the setters only when armed (`is_data_collection_
+allowed`), and `flush()` resolves the handle.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any
+
+from kubernetes_autoscaler_tpu.models.api import Node, Pod
+
+
+def _pod_view(p: Pod) -> dict[str, Any]:
+    return {
+        "name": p.name,
+        "namespace": p.namespace,
+        "requests": dict(p.requests),
+        "nodeName": p.node_name,
+        "phase": p.phase,
+        "owner": p.owner.kind if p.owner else "",
+        "priority": p.priority,
+    }
+
+
+def _node_view(n: Node, pods: list[Pod]) -> dict[str, Any]:
+    return {
+        "name": n.name,
+        "ready": n.ready,
+        "labels": dict(n.labels),
+        "allocatable": dict(n.alloc_or_cap()),
+        "taints": [vars(t) for t in n.taints],
+        "pods": [_pod_view(p) for p in pods],
+    }
+
+
+class _Handle:
+    def __init__(self):
+        self.event = threading.Event()
+        self.payload: str = ""
+
+    def wait(self, timeout: float | None = None) -> str:
+        self.event.wait(timeout)
+        return self.payload
+
+
+class DebuggingSnapshotter:
+    """Armed/disarmed snapshot collector (reference:
+    debugging_snapshotter.go DebuggingSnapshotterImpl state machine)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: _Handle | None = None
+        self._data: dict[str, Any] = {}
+
+    # ---- consumer side (the /snapshotz handler) ----
+
+    def request_snapshot(self) -> _Handle:
+        with self._lock:
+            if self._armed is None:
+                self._armed = _Handle()
+                self._data = {}
+            return self._armed
+
+    # ---- producer side (RunOnce) ----
+
+    def is_data_collection_allowed(self) -> bool:
+        with self._lock:
+            return self._armed is not None
+
+    def set_cluster_nodes(self, nodes: list[Node], pods_by_node: dict[str, list[Pod]]) -> None:
+        with self._lock:
+            if self._armed is None:
+                return
+            self._data["nodeList"] = [
+                _node_view(n, pods_by_node.get(n.name, [])) for n in nodes
+            ]
+
+    def set_unscheduled_pods_can_be_scheduled(self, pods: list[Pod]) -> None:
+        with self._lock:
+            if self._armed is None:
+                return
+            self._data["unscheduledPodsCanBeScheduled"] = [
+                _pod_view(p) for p in pods
+            ]
+
+    def set_template_nodes(self, templates: dict[str, Node]) -> None:
+        with self._lock:
+            if self._armed is None:
+                return
+            self._data["templateNodes"] = {
+                gid: _node_view(t, []) for gid, t in templates.items()
+            }
+
+    def set_errors(self, errors: list[str]) -> None:
+        with self._lock:
+            if self._armed is None:
+                return
+            self._data["errors"] = list(errors)
+
+    def flush(self, now: float | None = None) -> None:
+        """End of RunOnce: resolve the armed handle (reference: Flush)."""
+        with self._lock:
+            if self._armed is None:
+                return
+            self._data["timestamp"] = time.time() if now is None else now
+            self._armed.payload = json.dumps(self._data, indent=2, default=str)
+            self._armed.event.set()
+            self._armed = None
+            self._data = {}

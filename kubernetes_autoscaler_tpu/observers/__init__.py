@@ -1,0 +1,5 @@
+from kubernetes_autoscaler_tpu.observers.nodegroupchange import (
+    NodeGroupChangeObserverList,
+)
+
+__all__ = ["NodeGroupChangeObserverList"]

@@ -1,0 +1,148 @@
+"""Status document, debugging snapshot, node-group change observers.
+
+Reference analogs: clusterstate/api (status configmap content),
+debuggingsnapshot/debugging_snapshotter_test.go, observers/nodegroupchange.
+"""
+
+import json
+
+from kubernetes_autoscaler_tpu.clusterstate.api import (
+    BACKOFF,
+    CANDIDATES_PRESENT,
+    HEALTHY,
+    IN_PROGRESS,
+    build_status,
+)
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.debuggingsnapshot import DebuggingSnapshotter
+from kubernetes_autoscaler_tpu.observers.nodegroupchange import (
+    NodeGroupChangeObserverList,
+)
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def _opts(**kw):
+    base = dict(
+        scale_down_delay_after_add_s=0.0,
+        scale_down_delay_after_failure_s=0.0,
+        node_shape_bucket=16, group_shape_bucket=16,
+        max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    base.update(kw)
+    return AutoscalingOptions(**base)
+
+
+def _scale_up_world():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("n1", cpu_milli=4000, mem_mib=8192))
+    for i in range(4):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1500, mem_mib=512,
+                                    owner_name="rs"))
+    return fake
+
+
+def test_status_document_after_scale_up():
+    fake = _scale_up_world()
+    sunk = []
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
+                         eviction_sink=fake, status_sink=sunk.append)
+    a.run_once(now=1000.0)
+    assert len(sunk) == 1
+    st = sunk[0]
+    assert st.autoscaler_status == HEALTHY
+    ng = next(s for s in st.node_groups if s.name == "ng1")
+    assert ng.scale_up == IN_PROGRESS
+    assert ng.target_size > 1
+    doc = json.loads(st.to_json())
+    assert doc["nodeGroups"][0]["health"]["status"] == HEALTHY
+
+
+def test_status_backoff_after_failed_scale_up():
+    fake = _scale_up_world()
+    g = fake.provider.node_groups()[0]
+
+    from kubernetes_autoscaler_tpu.cloudprovider.provider import NodeGroupError
+
+    def boom(delta):
+        raise NodeGroupError("cloud says no")
+
+    g.increase_size = boom
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(), eviction_sink=fake)
+    failures = []
+
+    class Obs:
+        def register_failed_scale_up(self, gid, reason, now):
+            failures.append((gid, reason))
+
+    a.node_group_change_observers.register(Obs())
+    a.run_once(now=1000.0)
+    assert failures and failures[0][0] == "ng1"
+    st = a.last_status
+    ng = next(s for s in st.node_groups if s.name == "ng1")
+    assert ng.scale_up == BACKOFF
+
+
+def test_observer_fanout_and_isolation():
+    lst = NodeGroupChangeObserverList()
+    seen = []
+
+    class Bad:
+        def register_scale_up(self, gid, delta, now):
+            raise RuntimeError("observer bug")
+
+    class Good:
+        def register_scale_up(self, gid, delta, now):
+            seen.append((gid, delta))
+
+    lst.register(Bad())
+    lst.register(Good())
+    lst.register_scale_up("ng1", 3, 0.0)    # Bad must not block Good
+    assert seen == [("ng1", 3)]
+
+
+def test_observers_see_scale_down():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    for name in ("n1", "n2"):
+        fake.add_existing_node("ng1", build_test_node(name, cpu_milli=4000, mem_mib=8192))
+    fake.add_pod(build_test_pod("busy", cpu_milli=3000, mem_mib=4096,
+                                owner_name="rs", node_name="n1"))
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(), eviction_sink=fake)
+    downs = []
+
+    class Obs:
+        def register_scale_down(self, gid, node, now):
+            downs.append((gid, node))
+
+    a.node_group_change_observers.register(Obs())
+    a.run_once(now=1000.0)
+    assert downs == [("ng1", "n2")]
+    # status reflects the in-flight deletion
+    st = a.last_status
+    assert st.cluster_wide.scale_down == CANDIDATES_PRESENT
+
+
+def test_debugging_snapshot_roundtrip():
+    fake = _scale_up_world()
+    dbg = DebuggingSnapshotter()
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
+                         eviction_sink=fake, debugging_snapshotter=dbg)
+    # not armed: loop runs, nothing collected
+    a.run_once(now=1000.0)
+    handle = dbg.request_snapshot()
+    a.run_once(now=1010.0)
+    payload = json.loads(handle.wait(timeout=5.0))
+    assert payload["timestamp"] == 1010.0
+    names = {n["name"] for n in payload["nodeList"]}
+    assert "n1" in names and len(names) >= 1
+    assert "templateNodes" in payload and "ng1" in payload["templateNodes"]
