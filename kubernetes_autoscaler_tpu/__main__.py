@@ -1,0 +1,131 @@
+"""Process entry: flags → leader election → HTTP mux → control loop.
+
+Reference counterpart: cluster-autoscaler/main.go:200-331 — flag parsing,
+leader election, the /metrics /healthz /snapshotz HTTP mux, then the loop
+driver. Standalone mode runs against a JSON scenario file on the in-memory
+provider (the reference's equivalent harness is the kwok/test provider);
+deployment mode is driven through the sidecar gRPC service instead
+(sidecar/server.py).
+
+Scenario JSON shape:
+{
+  "node_groups": [{"id": "ng1", "min": 0, "max": 10,
+                   "template": {"cpu_milli": 4000, "mem_mib": 8192, ...}}],
+  "nodes":  [{"group": "ng1", "name": "n1", "cpu_milli": 4000, ...}],
+  "pods":   [{"name": "p1", "cpu_milli": 500, "mem_mib": 512,
+              "owner_name": "rs", "node_name": ""}]
+}
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubernetes_autoscaler_tpu.config.flags import parse_options
+from kubernetes_autoscaler_tpu.core.loop import LoopTrigger, run_loop
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.debuggingsnapshot import DebuggingSnapshotter
+from kubernetes_autoscaler_tpu.metrics.metrics import default_registry
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.leaderelection import FileLeaderElector
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def cluster_from_scenario(path: str) -> FakeCluster:
+    with open(path) as f:
+        doc = json.load(f)
+    fake = FakeCluster()
+    for g in doc.get("node_groups", []):
+        t = g.get("template", {})
+        tmpl = build_test_node(f"template-{g['id']}", **t)
+        fake.add_node_group(g["id"], tmpl, min_size=g.get("min", 0),
+                            max_size=g.get("max", 10))
+    for n in doc.get("nodes", []):
+        spec = {k: v for k, v in n.items() if k not in ("group",)}
+        fake.add_existing_node(n["group"], build_test_node(**spec))
+    for p in doc.get("pods", []):
+        fake.add_pod(build_test_pod(**p))
+    return fake
+
+
+def make_mux(autoscaler: StaticAutoscaler, snapshotter: DebuggingSnapshotter):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # klog-quiet
+            pass
+
+        def _send(self, code: int, body: str, ctype="text/plain"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._send(200, default_registry.expose_text())
+            elif self.path == "/healthz":
+                ok = autoscaler.health.healthy()
+                self._send(200 if ok else 500, "ok" if ok else "loop stalled")
+            elif self.path == "/statusz":
+                st = autoscaler.last_status
+                self._send(200, st.to_json() if st else "{}",
+                           "application/json")
+            elif self.path == "/snapshotz":
+                handle = snapshotter.request_snapshot()
+                payload = handle.wait(timeout=120.0)
+                self._send(200 if payload else 504, payload or "timed out",
+                           "application/json")
+            else:
+                self._send(404, "not found")
+
+    return Handler
+
+
+def main(argv: list[str] | None = None) -> int:
+    options, args = parse_options(argv)
+    if not args.scenario:
+        print("standalone mode needs --scenario <file>; deployment mode is "
+              "driven via the sidecar gRPC service (sidecar/server.py)")
+        return 2
+
+    fake = cluster_from_scenario(args.scenario)
+    snapshotter = DebuggingSnapshotter()
+    autoscaler = StaticAutoscaler(
+        fake.provider, fake, options=options, eviction_sink=fake,
+        debugging_snapshotter=snapshotter,
+    )
+
+    host, _, port = args.address.rpartition(":")
+    server = ThreadingHTTPServer((host or "0.0.0.0", int(port)),
+                                 make_mux(autoscaler, snapshotter))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # non-main thread (tests)
+
+    def run():
+        trigger = LoopTrigger(options.scan_interval_s)
+        max_it = args.max_iterations or None
+        run_loop(autoscaler, trigger, max_iterations=max_it, stop=stop)
+        return 0
+
+    try:
+        if args.leader_elect:
+            elector = FileLeaderElector(args.leader_elect_lease_file)
+            return elector.run_or_die(run)
+        return run()
+    finally:
+        server.shutdown()
+        autoscaler.provider.cleanup()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,191 @@
+"""CLI flags → AutoscalingOptions.
+
+Reference counterpart: config/flags/flags.go (~125 pflag definitions feeding
+config.AutoscalingOptions; auto-documented into FAQ.md:1000+). Flag names keep
+the reference's kebab-case spelling so operator muscle memory transfers;
+durations accept Go-style strings ("10s", "5m", "1h30m") and plain seconds.
+
+Flags without behavioral force in this framework (cloud-SDK endpoints,
+kubeconfig plumbing) are accepted-and-ignored via `--ignore-unknown` parity
+mode rather than erroring, mirroring how operators carry flag soups between
+autoscaler versions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ms|h|m|s)")
+_UNIT_S = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 0.001}
+
+
+def parse_duration_s(text: str) -> float:
+    """Go duration ("1h30m", "10s") or bare seconds ("90")."""
+    text = text.strip()
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    total, pos = 0.0, 0
+    for m in _DURATION_RE.finditer(text):
+        if m.start() != pos:
+            raise ValueError(f"bad duration {text!r}")
+        total += float(m.group(1)) * _UNIT_S[m.group(2)]
+        pos = m.end()
+    if pos != len(text) or pos == 0:
+        raise ValueError(f"bad duration {text!r}")
+    return total
+
+
+def _bool(text: str) -> bool:
+    return text.lower() in ("1", "true", "t", "yes", "y")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-autoscaler-tpu",
+        description="TPU-native cluster autoscaling framework",
+    )
+    dur = parse_duration_s
+
+    # loop (reference flags.go: --scan-interval)
+    p.add_argument("--scan-interval", type=dur, default=10.0)
+
+    # scale-up
+    p.add_argument("--estimator", default="binpacking")
+    p.add_argument("--expander", default="least-waste")
+    p.add_argument("--max-nodes-per-scaleup", type=int, default=1000)
+    p.add_argument("--max-nodes-total", type=int, default=0)
+    p.add_argument("--cores-total", default="0:320000",
+                   help="min:max cluster cores (reference --cores-total)")
+    p.add_argument("--memory-total", default="0:6400000",
+                   help="min:max cluster memory in GiB")
+    p.add_argument("--balance-similar-node-groups", type=_bool, default=False)
+    p.add_argument("--new-pod-scale-up-delay", type=dur, default=0.0)
+    p.add_argument("--expendable-pods-priority-cutoff", type=int, default=-10)
+    p.add_argument("--max-binpacking-time", type=dur, default=300.0)
+
+    # scale-down
+    p.add_argument("--scale-down-enabled", type=_bool, default=True)
+    p.add_argument("--scale-down-delay-after-add", type=dur, default=600.0)
+    p.add_argument("--scale-down-delay-after-delete", type=dur, default=0.0)
+    p.add_argument("--scale-down-delay-after-failure", type=dur, default=180.0)
+    p.add_argument("--scale-down-unneeded-time", type=dur, default=600.0)
+    p.add_argument("--scale-down-unready-time", type=dur, default=1200.0)
+    p.add_argument("--scale-down-utilization-threshold", type=float, default=0.5)
+    p.add_argument("--scale-down-gpu-utilization-threshold", type=float, default=0.5)
+    p.add_argument("--scale-down-candidates-pool-ratio", type=float, default=1.0)
+    p.add_argument("--scale-down-candidates-pool-min-count", type=int, default=50)
+    p.add_argument("--max-scale-down-parallelism", type=int, default=10)
+    p.add_argument("--max-drain-parallelism", type=int, default=1)
+    p.add_argument("--max-empty-bulk-delete", type=int, default=10)
+    p.add_argument("--max-graceful-termination-sec", type=int, default=600)
+    p.add_argument("--skip-nodes-with-system-pods", type=_bool, default=True)
+    p.add_argument("--skip-nodes-with-local-storage", type=_bool, default=True)
+    p.add_argument("--skip-nodes-with-custom-controller-pods", type=_bool,
+                   default=False)
+    p.add_argument("--min-replica-count", type=int, default=0)
+
+    # cluster health
+    p.add_argument("--max-total-unready-percentage", type=float, default=45.0)
+    p.add_argument("--ok-total-unready-count", type=int, default=3)
+    p.add_argument("--max-node-startup-time", type=dur, default=900.0)
+    p.add_argument("--max-node-provision-time", type=dur, default=900.0)
+    p.add_argument("--unregistered-node-removal-time", type=dur, default=900.0)
+
+    # backoff
+    p.add_argument("--initial-node-group-backoff-duration", type=dur, default=300.0)
+    p.add_argument("--max-node-group-backoff-duration", type=dur, default=1800.0)
+    p.add_argument("--node-group-backoff-reset-timeout", type=dur, default=10800.0)
+
+    # process / observability (reference: main.go flags)
+    p.add_argument("--address", default=":8085",
+                   help="metrics/healthz listen address")
+    p.add_argument("--leader-elect", type=_bool, default=True)
+    p.add_argument("--leader-elect-lease-file", default="/tmp/ka-tpu-leader.lock")
+    p.add_argument("--profiling", type=_bool, default=False)
+    p.add_argument("--ignore-daemonsets-utilization", type=_bool, default=False)
+
+    # TPU data plane (no reference analog — Go has no tracing/compile cache)
+    p.add_argument("--node-shape-bucket", type=int, default=256)
+    p.add_argument("--group-shape-bucket", type=int, default=64)
+    p.add_argument("--max-new-nodes-static", type=int, default=1024)
+    p.add_argument("--drain-chunk", type=int, default=32)
+    p.add_argument("--max-pods-per-node", type=int, default=128)
+
+    # runner (standalone mode)
+    p.add_argument("--scenario", default="",
+                   help="JSON scenario file for the in-memory provider")
+    p.add_argument("--max-iterations", type=int, default=0,
+                   help="0 = run forever")
+    return p
+
+
+def _min_max(text: str) -> tuple[int, int]:
+    lo, _, hi = text.partition(":")
+    return int(lo or 0), int(hi or 0)
+
+
+def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
+    _, max_cores = _min_max(args.cores_total)
+    _, max_mem_gib = _min_max(args.memory_total)
+    return AutoscalingOptions(
+        scan_interval_s=args.scan_interval,
+        estimator=args.estimator,
+        expander=args.expander,
+        max_nodes_per_scaleup=args.max_nodes_per_scaleup,
+        max_nodes_total=args.max_nodes_total,
+        max_cores_total=max_cores,
+        max_memory_total_mib=max_mem_gib * 1024,
+        balance_similar_node_groups=args.balance_similar_node_groups,
+        new_pod_scale_up_delay_s=args.new_pod_scale_up_delay,
+        expendable_pods_priority_cutoff=args.expendable_pods_priority_cutoff,
+        max_binpacking_time_s=args.max_binpacking_time,
+        scale_down_enabled=args.scale_down_enabled,
+        scale_down_delay_after_add_s=args.scale_down_delay_after_add,
+        scale_down_delay_after_delete_s=args.scale_down_delay_after_delete,
+        scale_down_delay_after_failure_s=args.scale_down_delay_after_failure,
+        scale_down_candidates_pool_ratio=args.scale_down_candidates_pool_ratio,
+        scale_down_candidates_pool_min_count=args.scale_down_candidates_pool_min_count,
+        max_scale_down_parallelism=args.max_scale_down_parallelism,
+        max_drain_parallelism=args.max_drain_parallelism,
+        max_empty_bulk_delete=args.max_empty_bulk_delete,
+        max_graceful_termination_s=float(args.max_graceful_termination_sec),
+        skip_nodes_with_system_pods=args.skip_nodes_with_system_pods,
+        skip_nodes_with_local_storage=args.skip_nodes_with_local_storage,
+        skip_nodes_with_custom_controller_pods=args.skip_nodes_with_custom_controller_pods,
+        min_replica_count=args.min_replica_count,
+        max_total_unready_percentage=args.max_total_unready_percentage,
+        ok_total_unready_count=args.ok_total_unready_count,
+        max_node_startup_time_s=args.max_node_startup_time,
+        unregistered_node_removal_time_s=args.unregistered_node_removal_time,
+        initial_node_group_backoff_s=args.initial_node_group_backoff_duration,
+        max_node_group_backoff_s=args.max_node_group_backoff_duration,
+        node_group_backoff_reset_timeout_s=args.node_group_backoff_reset_timeout,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_utilization_threshold=args.scale_down_utilization_threshold,
+            scale_down_gpu_utilization_threshold=args.scale_down_gpu_utilization_threshold,
+            scale_down_unneeded_time_s=args.scale_down_unneeded_time,
+            scale_down_unready_time_s=args.scale_down_unready_time,
+            max_node_provision_time_s=args.max_node_provision_time,
+            ignore_daemonsets_utilization=args.ignore_daemonsets_utilization,
+        ),
+        node_shape_bucket=args.node_shape_bucket,
+        group_shape_bucket=args.group_shape_bucket,
+        max_new_nodes_static=args.max_new_nodes_static,
+        drain_chunk=args.drain_chunk,
+        max_pods_per_node=args.max_pods_per_node,
+    )
+
+
+def parse_options(argv: list[str] | None = None
+                  ) -> tuple[AutoscalingOptions, argparse.Namespace]:
+    args, unknown = build_parser().parse_known_args(argv)
+    # unknown flags: parity-accepted, ignored (see module docstring)
+    return options_from_args(args), args

@@ -1,0 +1,143 @@
+"""Flags parsing, leader election, and the process entry's HTTP mux.
+
+Reference analogs: config/flags/flags.go parsing, main.go leader election and
+mux wiring.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_autoscaler_tpu.config.flags import (
+    parse_duration_s,
+    parse_options,
+)
+from kubernetes_autoscaler_tpu.utils.leaderelection import FileLeaderElector
+
+
+def test_parse_duration_formats():
+    assert parse_duration_s("10s") == 10.0
+    assert parse_duration_s("5m") == 300.0
+    assert parse_duration_s("1h30m") == 5400.0
+    assert parse_duration_s("90") == 90.0
+    assert parse_duration_s("100ms") == 0.1
+    with pytest.raises(ValueError):
+        parse_duration_s("10parsecs")
+
+
+def test_flags_map_to_options():
+    opts, args = parse_options([
+        "--scan-interval", "30s",
+        "--expander", "priority,least-waste",
+        "--scale-down-unneeded-time", "5m",
+        "--max-nodes-total", "500",
+        "--cores-total", "0:1000",
+        "--balance-similar-node-groups", "true",
+        "--some-unknown-cloud-flag", "xyz",       # parity-ignored
+    ])
+    assert opts.scan_interval_s == 30.0
+    assert opts.expander == "priority,least-waste"
+    assert opts.node_group_defaults.scale_down_unneeded_time_s == 300.0
+    assert opts.max_nodes_total == 500
+    assert opts.max_cores_total == 1000
+    assert opts.balance_similar_node_groups is True
+
+
+def test_flags_defaults_match_reference():
+    opts, _ = parse_options([])
+    assert opts.scan_interval_s == 10.0
+    assert opts.expander == "least-waste"
+    assert opts.max_nodes_per_scaleup == 1000      # FAQ.md:1086
+    assert opts.scale_down_delay_after_add_s == 600.0
+    assert opts.node_group_defaults.scale_down_utilization_threshold == 0.5
+    assert opts.max_total_unready_percentage == 45.0
+    assert opts.ok_total_unready_count == 3
+
+
+def test_leader_election_excludes_second_acquirer(tmp_path):
+    lease = str(tmp_path / "leader.lock")
+    a = FileLeaderElector(lease, retry_period_s=0.05)
+    b = FileLeaderElector(lease, retry_period_s=0.05)
+    assert a.try_acquire()
+    assert not b.try_acquire()          # held by a
+    a.release()
+    assert b.try_acquire()              # freed
+    b.release()
+
+
+def test_leader_election_run_or_die_blocks_then_runs(tmp_path):
+    lease = str(tmp_path / "leader.lock")
+    a = FileLeaderElector(lease, retry_period_s=0.02)
+    b = FileLeaderElector(lease, retry_period_s=0.02)
+    assert a.try_acquire()
+    ran = []
+
+    t = threading.Thread(target=lambda: b.run_or_die(lambda: ran.append(1)))
+    t.start()
+    time.sleep(0.1)
+    assert not ran                      # blocked while a leads
+    a.release()
+    t.join(timeout=5.0)
+    assert ran == [1]
+
+
+def test_main_scenario_end_to_end(tmp_path):
+    """Whole process entry: scenario file -> loop iterations -> HTTP mux."""
+    from kubernetes_autoscaler_tpu.__main__ import main
+
+    scenario = {
+        "node_groups": [{
+            "id": "ng1", "min": 0, "max": 10,
+            "template": {"cpu_milli": 4000, "mem_mib": 8192},
+        }],
+        "nodes": [{"group": "ng1", "name": "n1", "cpu_milli": 4000,
+                   "mem_mib": 8192}],
+        "pods": [{"name": f"p{i}", "cpu_milli": 1500, "mem_mib": 512,
+                  "owner_name": "rs"} for i in range(4)],
+    }
+    path = tmp_path / "scenario.json"
+    path.write_text(json.dumps(scenario))
+
+    port = 18085
+    rc_holder = []
+
+    def run():
+        rc_holder.append(main([
+            "--scenario", str(path),
+            "--max-iterations", "2",
+            "--scan-interval", "50ms",
+            "--address", f"127.0.0.1:{port}",
+            "--leader-elect-lease-file", str(tmp_path / "lease.lock"),
+            "--node-shape-bucket", "16",
+            "--group-shape-bucket", "16",
+            "--max-new-nodes-static", "32",
+            "--scale-down-delay-after-add", "0s",
+            "--scale-down-unneeded-time", "0s",
+        ]))
+
+    t = threading.Thread(target=run)
+    t.start()
+    # poll the mux while the loop runs
+    deadline = time.time() + 60
+    status_doc = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz", timeout=2
+            ) as r:
+                doc = json.loads(r.read())
+                if doc and doc.get("nodeGroups"):
+                    status_doc = doc
+                    break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    t.join(timeout=120)
+    assert rc_holder == [0]
+    assert status_doc is not None
+    assert status_doc["nodeGroups"][0]["name"] == "ng1"
+    # the 4x1500m pods forced a scale-up past the single seed node
+    assert status_doc["nodeGroups"][0]["health"]["targetSize"] >= 2
