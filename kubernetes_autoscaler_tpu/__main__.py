@@ -120,7 +120,8 @@ def main(argv: list[str] | None = None) -> int:
     try:
         if args.leader_elect:
             elector = FileLeaderElector(args.leader_elect_lease_file)
-            return elector.run_or_die(run)
+            rc = elector.run_or_die(run, stop=stop)
+            return 0 if rc is None else rc   # stop during standby = clean exit
         return run()
     finally:
         server.shutdown()

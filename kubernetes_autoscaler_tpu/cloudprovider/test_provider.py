@@ -43,6 +43,8 @@ class TestNodeGroup(NodeGroup):
         self._options = options
         self.price_per_node = price_per_node
         self._instances: list[InstanceStatus] = []
+        self._exists = True
+        self._autoprovisioned = False
 
     def id(self) -> str:
         return self._id
@@ -105,13 +107,42 @@ class TestNodeGroup(NodeGroup):
     def get_options(self, defaults: NodeGroupOptions) -> NodeGroupOptions:
         return self._options or defaults
 
+    # ---- auto-provisioning lifecycle (reference: cloud_provider.go
+    # Create/Delete/Autoprovisioned; test provider supports them for the
+    # NodeGroupManager tests) ----
+
+    def exist(self) -> bool:
+        return self._exists
+
+    def autoprovisioned(self) -> bool:
+        return self._autoprovisioned
+
+    def create(self) -> "TestNodeGroup":
+        if self._exists:
+            raise NodeGroupError(f"node group {self._id} already exists")
+        if self._id in self._provider._groups:
+            # a registered group with this id exists (this object is a stale
+            # candidate) — never silently overwrite it
+            raise NodeGroupError(f"node group {self._id} already registered")
+        self._exists = True
+        self._provider._groups[self._id] = self
+        return self
+
+    def delete(self) -> None:
+        if not self._autoprovisioned:
+            raise NodeGroupError(f"node group {self._id} is not autoprovisioned")
+        if self._provider.nodes_of(self._id):
+            raise NodeGroupError(f"node group {self._id} still has nodes")
+        self._exists = False
+        self._provider._groups.pop(self._id, None)
+
 
 @dataclass
 class TestCloudProvider(CloudProvider):
     on_scale_up: Callable[[str, int], None] | None = None
     on_scale_down: Callable[[str, str], None] | None = None
     resource_limiter: ResourceLimiter = field(default_factory=ResourceLimiter)
-    machine_types: list[str] = field(default_factory=list)
+    machine_templates: dict[str, tuple] = field(default_factory=dict)
 
     def __post_init__(self):
         self._groups: dict[str, TestNodeGroup] = {}
@@ -156,3 +187,24 @@ class TestCloudProvider(CloudProvider):
 
     def pricing(self):
         return {gid: g.price_per_node for gid, g in self._groups.items()}
+
+    # ---- machine catalog for auto-provisioning (reference:
+    # GetAvailableMachineTypes + NewNodeGroup, cloud_provider.go:128-131) ----
+
+    def add_machine_type(self, name: str, template: Node,
+                         price_per_node: float = 1.0) -> None:
+        self.machine_templates[name] = (template, price_per_node)
+
+    def get_available_machine_types(self) -> list[str]:
+        return list(self.machine_templates)
+
+    def new_node_group(self, machine_type: str, max_size: int = 1000) -> TestNodeGroup:
+        """A candidate group that does not exist until create() is called."""
+        if machine_type not in self.machine_templates:
+            raise NodeGroupError(f"unknown machine type {machine_type}")
+        template, price = self.machine_templates[machine_type]
+        g = TestNodeGroup(f"autoprovisioned-{machine_type}", 0, max_size, 0,
+                          template, self, None, price)
+        g._exists = False
+        g._autoprovisioned = True
+        return g

@@ -40,6 +40,15 @@ class AutoscalingOptions:
     new_pod_scale_up_delay_s: float = 0.0
     expendable_pods_priority_cutoff: int = -10
     max_binpacking_time_s: float = 5 * 60.0
+    # salvo mode (reference: runScaleUpSalvo static_autoscaler.go:669 —
+    # iterate scale-up within one loop, re-injecting scaled-up capacity)
+    scale_up_salvo_enabled: bool = False
+    salvo_max_rounds: int = 5
+    salvo_time_budget_s: float = 2.0
+    # node-group auto-provisioning (reference: --node-autoprovisioning-enabled,
+    # --max-autoprovisioned-node-group-count)
+    node_autoprovisioning_enabled: bool = False
+    max_autoprovisioned_node_group_count: int = 15
 
     # scale-down
     scale_down_enabled: bool = True

@@ -67,12 +67,23 @@ class ScaleUpOrchestrator:
         cluster_state: ClusterStateRegistry,
         expander: ChainStrategy,
         quota: QuotaTracker | None = None,
+        node_group_list_processor=None,
+        node_group_manager=None,
     ):
+        from kubernetes_autoscaler_tpu.processors.nodegroups import (
+            IdentityNodeGroupListProcessor,
+            NodeGroupManager,
+        )
+
         self.provider = provider
         self.options = options
         self.cluster_state = cluster_state
         self.expander = expander
         self.quota = quota
+        self.node_group_list_processor = (
+            node_group_list_processor or IdentityNodeGroupListProcessor()
+        )
+        self.node_group_manager = node_group_manager or NodeGroupManager()
 
     # ---- node-group validity (reference: filterValidScaleUpNodeGroups :152) ----
 
@@ -98,6 +109,11 @@ class ScaleUpOrchestrator:
             return ScaleUpResult(scaled_up=False)
 
         groups = self._valid_groups(now)
+        # candidate extension (reference: NodeGroupListProcessor — the
+        # autoprovisioning variant appends not-yet-existing groups)
+        groups = self.node_group_list_processor.process(
+            self.provider, groups, enc.pending_pods
+        )
         if not groups:
             return ScaleUpResult(scaled_up=False, pods_remaining=pending_total)
 
@@ -251,7 +267,12 @@ class ScaleUpOrchestrator:
         result = ScaleUpResult(scaled_up=False)
 
         def one(gid: str, delta: int):
-            by_id[gid].increase_size(delta)
+            g = by_id[gid]
+            if not g.exist():
+                # winner is an auto-provisioning candidate: create first
+                # (reference: orchestrator CreateNodeGroup before IncreaseSize)
+                self.node_group_manager.create_node_group(g)
+            g.increase_size(delta)
             return gid, delta
 
         with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:

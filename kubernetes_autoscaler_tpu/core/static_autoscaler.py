@@ -98,8 +98,24 @@ class StaticAutoscaler:
         self.cluster_state = ClusterStateRegistry(provider, self.options)
         self.quota = QuotaTracker(provider.get_resource_limiter(), None)  # registry set per loop
         expander = build_expander(self.options.expander, expander_priorities)
+        # auto-provisioning wiring (reference: builder picks the
+        # autoprovisioning NodeGroupListProcessor when the flag is on)
+        from kubernetes_autoscaler_tpu.processors.nodegroups import (
+            AutoprovisioningNodeGroupListProcessor,
+            NodeGroupManager,
+        )
+
+        self.node_group_manager = NodeGroupManager()
+        ng_list_proc = (
+            AutoprovisioningNodeGroupListProcessor(
+                self.options.max_autoprovisioned_node_group_count
+            )
+            if self.options.node_autoprovisioning_enabled else None
+        )
         self.scale_up_orchestrator = ScaleUpOrchestrator(
-            provider, self.options, self.cluster_state, expander, None
+            provider, self.options, self.cluster_state, expander, None,
+            node_group_list_processor=ng_list_proc,
+            node_group_manager=self.node_group_manager,
         )
         # shared scale-down trackers (reference: planner & actuator share one
         # RemainingPdbTracker; latency spans plan→delete)
@@ -214,14 +230,7 @@ class StaticAutoscaler:
             # upcoming nodes (reference: addUpcomingNodesToClusterSnapshot :499)
             upcoming = self.cluster_state.upcoming_nodes()
             for gid, count in upcoming.items():
-                g = next((x for x in self.provider.node_groups() if x.id() == gid), None)
-                if g is None:
-                    continue
-                tmpl = g.template_node_info()
-                for k in range(count):
-                    t = self.processors.template_node_info_provider.sanitize(tmpl, gid)
-                    t.name = f"upcoming-{gid}-{k}"
-                    snapshot.add_node(t, group_id=-1)
+                self._inject_template_nodes(snapshot, gid, count, "upcoming")
 
             # debugging snapshot collection (reference:
             # static_autoscaler.go:299-300,404 — only when /snapshotz armed)
@@ -259,11 +268,13 @@ class StaticAutoscaler:
             enc.specs = snapshot.state.specs
             enc.nodes = snapshot.state.nodes
 
-            # scale-up (reference: runSingleScaleUp :589)
+            # scale-up (reference: runSingleScaleUp :589 / runScaleUpSalvo
+            # :669 — salvo iterates under a time budget, re-injecting the
+            # scaled-up capacity into the snapshot each round :723)
             scaled_up = False
             if remaining > 0:
                 with self.metrics.time_function("scale_up"):
-                    result = self.scale_up_orchestrator.scale_up(enc, len(nodes), now)
+                    result = self._dispatch_scale_up(enc, snapshot, nodes, now)
                 status.scale_up = result
                 scaled_up = result.scaled_up
                 for cb in self.processors.on_scale_up_status:
@@ -323,6 +334,11 @@ class StaticAutoscaler:
                         len(status.scale_down_deleted)
                     )
 
+            # reap empty autoprovisioned groups (reference: NodeGroupManager
+            # cleanup in the default processors chain)
+            if self.options.node_autoprovisioning_enabled:
+                self.node_group_manager.remove_unneeded_node_groups(self.provider)
+
             # status document (reference: WriteStatusConfigMap every loop,
             # static_autoscaler.go:418-421)
             from kubernetes_autoscaler_tpu.clusterstate.api import build_status
@@ -343,7 +359,70 @@ class StaticAutoscaler:
             self.health.mark_active(now)
         return status
 
+    # ---- scale-up dispatch (single vs salvo) ----
+
+    def _dispatch_scale_up(self, enc, snapshot, nodes: list[Node],
+                           now: float) -> ScaleUpResult:
+        result = self.scale_up_orchestrator.scale_up(enc, len(nodes), now)
+        if not self.options.scale_up_salvo_enabled or not result.scaled_up:
+            return result
+        deadline = time.monotonic() + self.options.salvo_time_budget_s
+        rounds = 1
+        last_increases = dict(result.increases)   # only the LATEST round's
+        while (
+            result.pods_remaining > 0
+            and rounds < self.options.salvo_max_rounds
+            and time.monotonic() < deadline
+        ):
+            # re-inject the capacity this salvo round just bought (reference:
+            # :723) so the next round only scales for still-unplaced pods
+            injected = 0
+            for gid, delta in last_increases.items():
+                injected += self._inject_template_nodes(
+                    snapshot, gid, delta, f"salvo-{rounds}"
+                )
+            if injected == 0:
+                break
+            packed = snapshot.schedule_pending_on_existing()
+            snapshot.apply_placement(packed.placed)
+            enc.specs = snapshot.state.specs
+            enc.nodes = snapshot.state.nodes
+            remaining = int(np.asarray(enc.specs.count).sum())
+            if remaining == 0:
+                result.pods_remaining = 0
+                break
+            # cluster size includes what earlier rounds already bought, so
+            # the cluster-capacity limiter caps against the true total
+            grown = len(nodes) + sum(result.increases.values())
+            nxt = self.scale_up_orchestrator.scale_up(enc, grown, now)
+            rounds += 1
+            if not nxt.scaled_up:
+                result.pods_remaining = nxt.pods_remaining
+                result.errors.update(nxt.errors)
+                break
+            for gid, delta in nxt.increases.items():
+                result.increases[gid] = result.increases.get(gid, 0) + delta
+            last_increases = dict(nxt.increases)
+            result.pods_helped += nxt.pods_helped
+            result.pods_remaining = nxt.pods_remaining
+            result.errors.update(nxt.errors)
+        return result
+
     # ---- helpers ----
+
+    def _inject_template_nodes(self, snapshot, gid: str, count: int,
+                               prefix: str) -> int:
+        """Add `count` sanitized template nodes of group `gid` to the
+        snapshot (upcoming-node and salvo re-injection share this)."""
+        g = next((x for x in self.provider.node_groups() if x.id() == gid), None)
+        if g is None:
+            return 0
+        tmpl = g.template_node_info()
+        for k in range(count):
+            t = self.processors.template_node_info_provider.sanitize(tmpl, gid)
+            t.name = f"{prefix}-{gid}-{k}"
+            snapshot.add_node(t, group_id=-1)
+        return count
 
     def _node_group_index(self, nodes: list[Node]) -> dict[str, int]:
         group_ids = {g.id(): i for i, g in enumerate(self.provider.node_groups())}

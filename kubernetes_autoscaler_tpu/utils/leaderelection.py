@@ -34,14 +34,21 @@ class FileLeaderElector:
         self._fd = fd
         return True
 
-    def acquire(self, timeout_s: float | None = None) -> bool:
+    def acquire(self, timeout_s: float | None = None, stop=None) -> bool:
+        """Block for leadership. `stop` (threading.Event) aborts the wait —
+        a passive replica must stay killable by SIGTERM while standing by."""
         deadline = None if timeout_s is None else time.time() + timeout_s
         while True:
+            if stop is not None and stop.is_set():
+                return False
             if self.try_acquire():
                 return True
             if deadline is not None and time.time() >= deadline:
                 return False
-            time.sleep(self.retry_period_s)
+            if stop is not None:
+                stop.wait(self.retry_period_s)
+            else:
+                time.sleep(self.retry_period_s)
 
     def release(self) -> None:
         if self._fd is not None:
@@ -52,9 +59,12 @@ class FileLeaderElector:
     def is_leader(self) -> bool:
         return self._fd is not None
 
-    def run_or_die(self, fn, timeout_s: float | None = None):
-        """reference: leaderelection.RunOrDie — block for leadership, run."""
-        if not self.acquire(timeout_s):
+    def run_or_die(self, fn, timeout_s: float | None = None, stop=None):
+        """reference: leaderelection.RunOrDie — block for leadership, run.
+        Returns None without running fn when `stop` fires during the wait."""
+        if not self.acquire(timeout_s, stop=stop):
+            if stop is not None and stop.is_set():
+                return None
             raise TimeoutError("could not acquire leadership")
         try:
             return fn()

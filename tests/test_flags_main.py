@@ -84,6 +84,29 @@ def test_leader_election_run_or_die_blocks_then_runs(tmp_path):
     assert ran == [1]
 
 
+def test_leader_election_standby_aborts_on_stop(tmp_path):
+    """A passive replica must stay killable: stop fires -> acquire aborts
+    without running the body."""
+    lease = str(tmp_path / "leader.lock")
+    a = FileLeaderElector(lease, retry_period_s=0.02)
+    b = FileLeaderElector(lease, retry_period_s=0.02)
+    assert a.try_acquire()
+    stop = threading.Event()
+    ran = []
+    out = []
+
+    t = threading.Thread(
+        target=lambda: out.append(b.run_or_die(lambda: ran.append(1), stop=stop))
+    )
+    t.start()
+    time.sleep(0.1)
+    stop.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert ran == [] and out == [None]
+    a.release()
+
+
 def test_main_scenario_end_to_end(tmp_path):
     """Whole process entry: scenario file -> loop iterations -> HTTP mux."""
     from kubernetes_autoscaler_tpu.__main__ import main

@@ -1,0 +1,124 @@
+"""Scale-up salvo mode and node-group auto-provisioning.
+
+Reference analogs: core/static_autoscaler_salvo_test.go and the
+processors/nodegroups autoprovisioning tests.
+"""
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.processors.nodegroups import (
+    AutoprovisioningNodeGroupListProcessor,
+    NodeGroupManager,
+)
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def _opts(**kw):
+    base = dict(
+        scale_down_delay_after_add_s=0.0,
+        scale_down_delay_after_failure_s=0.0,
+        node_shape_bucket=16, group_shape_bucket=16,
+        max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    base.update(kw)
+    return AutoscalingOptions(**base)
+
+
+def test_salvo_covers_heterogeneous_pods_in_one_loop():
+    """Two pod shapes, each only feasible on a different node group: single
+    mode helps one population per loop; salvo helps both in ONE loop."""
+    def make_world():
+        fake = FakeCluster()
+        small = build_test_node("tmpl-small", cpu_milli=2000, mem_mib=4096,
+                                labels={"pool": "small"})
+        big = build_test_node("tmpl-big", cpu_milli=16000, mem_mib=32768,
+                              labels={"pool": "big"})
+        fake.add_node_group("small", small, min_size=0, max_size=10)
+        fake.add_node_group("big", big, min_size=0, max_size=10)
+        for i in range(4):
+            fake.add_pod(build_test_pod(
+                f"s{i}", cpu_milli=1500, mem_mib=512, owner_name="rs-small",
+                node_selector={"pool": "small"}))
+        for i in range(2):
+            fake.add_pod(build_test_pod(
+                f"b{i}", cpu_milli=12000, mem_mib=1024, owner_name="rs-big",
+                node_selector={"pool": "big"}))
+        return fake
+
+    # single mode: one loop, one winner
+    fake1 = make_world()
+    a1 = StaticAutoscaler(fake1.provider, fake1, options=_opts(),
+                          eviction_sink=fake1)
+    st1 = a1.run_once(now=1000.0)
+    assert len(st1.scale_up.increases) == 1
+
+    # salvo: both populations served in the same loop
+    fake2 = make_world()
+    a2 = StaticAutoscaler(
+        fake2.provider, fake2,
+        options=_opts(scale_up_salvo_enabled=True, salvo_max_rounds=5,
+                      salvo_time_budget_s=30.0),
+        eviction_sink=fake2,
+    )
+    st2 = a2.run_once(now=1000.0)
+    assert set(st2.scale_up.increases) == {"small", "big"}
+    assert st2.scale_up.increases["small"] == 4   # 4 x 1500m on 2-CPU nodes
+    assert st2.scale_up.increases["big"] == 2
+    assert st2.scale_up.pods_remaining == 0
+
+
+def test_autoprovisioning_creates_group_for_unmatched_pods():
+    """No existing group fits GPU pods; the machine catalog has a GPU type —
+    auto-provisioning creates the group and scales it."""
+    fake = FakeCluster()
+    cpu_tmpl = build_test_node("tmpl-cpu", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("cpu", cpu_tmpl, min_size=0, max_size=10)
+    fake.provider.add_machine_type(
+        "gpu-8x", build_test_node("tmpl-gpu", cpu_milli=16000, mem_mib=65536,
+                                  gpus=8), price_per_node=10.0)
+    for i in range(2):
+        fake.add_pod(build_test_pod(f"g{i}", cpu_milli=1000, mem_mib=1024,
+                                    owner_name="rs", gpus=4))
+    a = StaticAutoscaler(
+        fake.provider, fake,
+        options=_opts(node_autoprovisioning_enabled=True),
+        eviction_sink=fake,
+    )
+    st = a.run_once(now=1000.0)
+    assert st.scale_up is not None and st.scale_up.scaled_up
+    assert st.scale_up.increases == {"autoprovisioned-gpu-8x": 1}
+    gids = {g.id() for g in fake.provider.node_groups()}
+    assert "autoprovisioned-gpu-8x" in gids
+
+
+def test_autoprovisioned_group_reaped_when_empty():
+    fake = FakeCluster()
+    fake.add_node_group("cpu", build_test_node("t", cpu_milli=4000, mem_mib=8192),
+                        min_size=0, max_size=10)
+    fake.provider.add_machine_type(
+        "mt", build_test_node("tm", cpu_milli=8000, mem_mib=16384))
+    g = fake.provider.new_node_group("mt")
+    g.create()
+    assert "autoprovisioned-mt" in {x.id() for x in fake.provider.node_groups()}
+    removed = NodeGroupManager().remove_unneeded_node_groups(fake.provider)
+    assert removed == ["autoprovisioned-mt"]
+    assert "autoprovisioned-mt" not in {x.id() for x in fake.provider.node_groups()}
+
+
+def test_autoprovisioning_processor_respects_cap():
+    fake = FakeCluster()
+    for i in range(5):
+        fake.provider.add_machine_type(
+            f"mt{i}", build_test_node(f"t{i}", cpu_milli=4000, mem_mib=8192))
+    proc = AutoprovisioningNodeGroupListProcessor(max_autoprovisioned_groups=2)
+    pending = [build_test_pod("p", cpu_milli=100)]
+    out = proc.process(fake.provider, [], pending)
+    assert len(out) == 2
+    # nothing pending -> no candidates at all
+    assert proc.process(fake.provider, [], []) == []
