@@ -205,6 +205,22 @@ class StaticAutoscaler:
             list_pdbs = getattr(self.source, "list_pdbs", None)
             self.pdb_tracker.set_pdbs(list_pdbs() if list_pdbs else [])
 
+            # DRA / CSI lowering (reference: DraProvider/CsiProvider.Snapshot
+            # at static_autoscaler.go:313-328, joined into NodeInfos) — device
+            # claims and volume limits fold into the resource axis pre-encode
+            dra_snapshot_fn = getattr(self.source, "dra_snapshot", None)
+            if dra_snapshot_fn is not None:
+                from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+                    apply_dra,
+                )
+
+                apply_dra(nodes, pods, dra_snapshot_fn())
+            csi_snapshot_fn = getattr(self.source, "csi_snapshot", None)
+            if csi_snapshot_fn is not None:
+                from kubernetes_autoscaler_tpu.simulator.csi import apply_csi
+
+                apply_csi(nodes, pods, csi_snapshot_fn())
+
             # tensor snapshot
             node_group_ids = self._node_group_index(nodes)
             with self.metrics.time_function("snapshot_build"):

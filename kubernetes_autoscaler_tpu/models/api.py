@@ -28,6 +28,9 @@ SCALE_DOWN_DISABLED_KEY = "cluster-autoscaler.kubernetes.io/scale-down-disabled"
 # Taints CA itself places (reference: utils/taints/taints.go).
 TO_BE_DELETED_TAINT = "ToBeDeletedByClusterAutoscaler"
 DELETION_CANDIDATE_TAINT = "DeletionCandidateOfClusterAutoscaler"
+# Set by lowering passes (DRA selectored claims, shared claims) whose
+# constraint is not dense-encodable: forces the winner-verification tier.
+HOST_CHECK_ANNOTATION = "autoscaler.x-k8s.io/host-check"
 
 
 @dataclass(frozen=True)

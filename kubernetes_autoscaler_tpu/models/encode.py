@@ -156,7 +156,10 @@ class _PodSpecEncoding:
 
 
 def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
-    lossy = False
+    from kubernetes_autoscaler_tpu.models.api import HOST_CHECK_ANNOTATION
+
+    # lowering passes (DRA/CSI) flag constraints the dense encoding can't carry
+    lossy = pod.annotations.get(HOST_CHECK_ANNOTATION) == "true"
     # --- selector terms (AND of ORs) ---
     sel_req = np.zeros((dims.max_sel_terms, dims.max_sel_alts), dtype=np.int32)
     sel_neg = np.zeros((dims.max_neg_terms,), dtype=np.int32)

@@ -1,0 +1,75 @@
+"""CSI volume-limit tracking on the tensor plane.
+
+Reference counterpart: simulator/csi/ (269 LoC, flag-gated — SURVEY.md §2.3):
+a fork/commit/revert snapshot of CSINode objects so the scheduler's volume-
+limits filter sees simulated attach counts.
+
+TPU re-design: same lowering pattern as DRA — each CSI driver's attachable
+volume limit becomes an extended-resource slot ("csi/<driver>"): node
+capacity = the driver's allocatable count from CSINode, pod request = how
+many of the pod's PVCs that driver serves. The volume-limits predicate then
+IS the resource-fit comparison; fork/commit/revert ride the pytree snapshot.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubernetes_autoscaler_tpu.models.api import Node, Pod
+
+CSI_RESOURCE_PREFIX = "csi/"
+
+
+@dataclass
+class CSINodeDriver:
+    name: str
+    allocatable_count: int = 0      # max attachable volumes (0 = unlimited)
+
+
+@dataclass
+class CSINode:
+    """reference: storage.k8s.io CSINode, joined into framework.NodeInfo
+    (infos.go:57-68)."""
+
+    node_name: str
+    drivers: list[CSINodeDriver] = field(default_factory=list)
+
+
+@dataclass
+class CsiSnapshot:
+    csi_nodes: dict[str, CSINode] = field(default_factory=dict)
+    # pvc (namespace/name) -> driver name, from PV/StorageClass resolution
+    pvc_driver: dict[str, str] = field(default_factory=dict)
+
+    def add(self, csi_node: CSINode) -> None:
+        self.csi_nodes[csi_node.node_name] = csi_node
+
+
+def apply_csi(nodes: list[Node], pods: list[Pod], csi: CsiSnapshot) -> None:
+    """Lower volume limits into the resource axis before encode_cluster."""
+    drivers_seen: set[str] = set()
+    for nd in nodes:
+        cn = csi.csi_nodes.get(nd.name)
+        if cn is None:
+            continue
+        for d in cn.drivers:
+            if d.allocatable_count <= 0:
+                continue
+            key = CSI_RESOURCE_PREFIX + d.name
+            nd.capacity[key] = d.allocatable_count
+            if nd.allocatable:
+                nd.allocatable[key] = d.allocatable_count
+            drivers_seen.add(d.name)
+
+    for pod in pods:
+        per_driver: dict[str, int] = {}
+        for ref in pod.pvc_refs:
+            key = ref if "/" in ref else f"{pod.namespace}/{ref}"
+            driver = csi.pvc_driver.get(key)
+            if driver:
+                per_driver[driver] = per_driver.get(driver, 0) + 1
+        # overwrite, not accumulate — the loop re-lists the same Pod objects
+        # every tick and this pass must be idempotent
+        for driver, n in per_driver.items():
+            if driver in drivers_seen:
+                pod.requests[CSI_RESOURCE_PREFIX + driver] = n

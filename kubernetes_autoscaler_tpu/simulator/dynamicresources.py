@@ -1,0 +1,182 @@
+"""Dynamic Resource Allocation (DRA): device claims on the tensor plane.
+
+Reference counterpart: simulator/dynamicresources/ (2679 LoC — SURVEY.md
+§2.3): a fork/commit/revert patchset store of ResourceClaims / ResourceSlices
+/ DeviceClasses, with claim allocation and reservation performed during
+simulated scheduling, plus eager joining of slices into NodeInfos
+(predicate_snapshot.go:72-120).
+
+TPU re-design: the pointer-graph store disappears. Devices are counted per
+(node, device-class) and LOWERED INTO THE RESOURCE AXIS before encoding:
+each device class maps to an extended-resource slot ("dra/<class>"), node
+device counts become capacity, per-pod claims become requests. Feasibility,
+allocation charging, and fork/commit/revert then ride the existing
+int32 resource tensors for free — one comparison per class on the VPU
+instead of per-device object matching.
+
+Exactness tiering (the framework-wide pattern): what the dense encoding
+cannot express — CEL-style device attribute selectors, shared multi-pod
+claims (ReservedFor), partitionable devices — sets `needs_host_check`, and
+the winner-verification tier re-checks with `claim_fits_exact` before
+actuation (same contract as oracle.check_pod_on_node for affinity).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubernetes_autoscaler_tpu.models.api import (
+    HOST_CHECK_ANNOTATION,
+    Node,
+    Pod,
+)
+
+DRA_RESOURCE_PREFIX = "dra/"
+
+
+@dataclass
+class DeviceClass:
+    """reference: resource.k8s.io DeviceClass (simulator/dynamicresources
+    snapshot stores these verbatim)."""
+
+    name: str
+    # class-level required attributes (every device of the class has them)
+    attributes: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceSlice:
+    """A pool of identical devices one node publishes (reference:
+    ResourceSlice; LocalResourceSlices joined into NodeInfo at
+    framework/infos.go:57)."""
+
+    node_name: str
+    device_class: str
+    count: int
+    # per-device attributes for selector matching (uniform within a slice)
+    attributes: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ClaimRequest:
+    """One request inside a claim: N devices of a class, optionally
+    attribute-constrained (the simulable subset of CEL selectors:
+    attribute equality)."""
+
+    device_class: str
+    count: int = 1
+    selector: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceClaim:
+    """reference: ResourceClaim/ResourceClaimTemplate. `owner_pod` empty means
+    a shared claim (multiple pods reserve it) — host-check tier."""
+
+    name: str
+    namespace: str = "default"
+    requests: list[ClaimRequest] = field(default_factory=list)
+    owner_pod: str = ""               # pod name for per-pod (template) claims
+    allocated_node: str = ""          # "" = unallocated
+    reserved_for: list[str] = field(default_factory=list)
+
+
+@dataclass
+class DraSnapshot:
+    """The queryable DRA world handed to the lowering pass (reference:
+    DraProvider.Snapshot() at static_autoscaler.go:313)."""
+
+    classes: dict[str, DeviceClass] = field(default_factory=dict)
+    slices: list[ResourceSlice] = field(default_factory=list)
+    claims: list[ResourceClaim] = field(default_factory=list)
+
+    def claims_for_pod(self, pod: Pod) -> list[ResourceClaim]:
+        return [c for c in self.claims
+                if c.owner_pod == pod.name and c.namespace == pod.namespace]
+
+    def device_capacity(self) -> dict[str, dict[str, int]]:
+        """node -> class -> device count."""
+        out: dict[str, dict[str, int]] = {}
+        for s in self.slices:
+            per = out.setdefault(s.node_name, {})
+            per[s.device_class] = per.get(s.device_class, 0) + s.count
+        return out
+
+
+def slice_matches(s: ResourceSlice, req: ClaimRequest,
+                  classes: dict[str, DeviceClass]) -> bool:
+    if s.device_class != req.device_class:
+        return False
+    attrs = dict(classes.get(req.device_class, DeviceClass(req.device_class)).attributes)
+    attrs.update(s.attributes)
+    return all(attrs.get(k) == v for k, v in req.selector.items())
+
+
+def claim_fits_exact(claim: ResourceClaim, node: Node, dra: DraSnapshot,
+                     allocated: dict[tuple[str, str], int] | None = None) -> bool:
+    """Host-side exact check: every request satisfiable from the node's
+    matching slices minus what's already allocated (the winner-verification
+    tier for selectored/shared claims)."""
+    allocated = allocated or {}
+    for req in claim.requests:
+        avail = 0
+        for s in dra.slices:
+            if s.node_name != node.name:
+                continue
+            if slice_matches(s, req, dra.classes):
+                avail += s.count
+        avail -= allocated.get((node.name, req.device_class), 0)
+        if avail < req.count:
+            return False
+    return True
+
+
+def apply_dra(nodes: list[Node], pods: list[Pod], dra: DraSnapshot) -> None:
+    """The lowering pass: fold device counts into node capacity and claim
+    counts into pod requests as 'dra/<class>' extended resources, BEFORE
+    encode_cluster. Pods with selectored or shared claims additionally get
+    the host-check annotation (consumed by models/encode)."""
+    cap = dra.device_capacity()
+    for nd in nodes:
+        for cls, count in cap.get(nd.name, {}).items():
+            key = DRA_RESOURCE_PREFIX + cls
+            nd.capacity[key] = count
+            if nd.allocatable:
+                nd.allocatable[key] = count
+
+    # allocated claims on live nodes consume device capacity exactly like
+    # resident pods consume cpu/mem (encode charges scheduled pods' requests).
+    # Totals are recomputed and OVERWRITTEN each pass — the loop re-lists the
+    # same Pod objects every tick, so += would compound across loops.
+    for pod in pods:
+        totals: dict[str, int] = {}
+        lossy = False
+        for claim in dra.claims_for_pod(pod):
+            if len(claim.reserved_for) > 1:
+                lossy = True
+            for req in claim.requests:
+                key = DRA_RESOURCE_PREFIX + req.device_class
+                totals[key] = totals.get(key, 0) + req.count
+                if req.selector:
+                    lossy = True
+        for key, total in totals.items():
+            pod.requests[key] = total
+        if lossy:
+            pod.annotations[HOST_CHECK_ANNOTATION] = "true"
+
+
+def allocate_claim(claim: ResourceClaim, node: Node, pod: Pod) -> None:
+    """Actuation-time bookkeeping (reference: RunReserve during SchedulePod,
+    predicate_snapshot.go SchedulePod → DRA claim reservation)."""
+    claim.allocated_node = node.name
+    ref = f"{pod.namespace}/{pod.name}"
+    if ref not in claim.reserved_for:
+        claim.reserved_for.append(ref)
+
+
+def deallocate_claim(claim: ResourceClaim, pod: Pod) -> None:
+    ref = f"{pod.namespace}/{pod.name}"
+    if ref in claim.reserved_for:
+        claim.reserved_for.remove(ref)
+    if not claim.reserved_for:
+        claim.allocated_node = ""

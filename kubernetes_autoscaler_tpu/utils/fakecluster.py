@@ -38,6 +38,8 @@ class FakeCluster:
         self.pdbs: list = []
         self.workloads: list = []
         self.provreqs: list = []
+        self._dra = None
+        self._csi = None
         self.provision_delay_s = provision_delay_s
         self.evicted: list[str] = []
         self._pending: list[_PendingProvision] = []
@@ -129,6 +131,22 @@ class FakeCluster:
 
     def add_provisioning_request(self, pr) -> None:
         self.provreqs.append(pr)
+
+    def dra_snapshot(self):
+        from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+            DraSnapshot,
+        )
+
+        if self._dra is None:
+            self._dra = DraSnapshot()
+        return self._dra
+
+    def csi_snapshot(self):
+        from kubernetes_autoscaler_tpu.simulator.csi import CsiSnapshot
+
+        if self._csi is None:
+            self._csi = CsiSnapshot()
+        return self._csi
 
     # ---- EvictionSink ----
 

@@ -1,0 +1,160 @@
+"""DRA device claims and CSI volume limits on the tensor plane.
+
+Reference analogs: simulator/dynamicresources tests,
+core/static_autoscaler_dra_test.go, static_autoscaler_csi_test.go.
+"""
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.models.api import HOST_CHECK_ANNOTATION
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.ops.schedule import schedule_pending_on_existing
+from kubernetes_autoscaler_tpu.simulator.csi import (
+    CSINode,
+    CSINodeDriver,
+    CsiSnapshot,
+    apply_csi,
+)
+from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+    ClaimRequest,
+    DeviceClass,
+    DraSnapshot,
+    ResourceClaim,
+    ResourceSlice,
+    allocate_claim,
+    claim_fits_exact,
+    deallocate_claim,
+    apply_dra,
+)
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def test_apply_dra_lowers_devices_into_resources():
+    nodes = [build_test_node("n1", cpu_milli=8000, mem_mib=16384)]
+    pods = [build_test_pod("p1", cpu_milli=500, owner_name="rs")]
+    dra = DraSnapshot(
+        classes={"gpu.example.com": DeviceClass("gpu.example.com")},
+        slices=[ResourceSlice("n1", "gpu.example.com", 4)],
+        claims=[ResourceClaim("c1", owner_pod="p1",
+                              requests=[ClaimRequest("gpu.example.com", 2)])],
+    )
+    apply_dra(nodes, pods, dra)
+    assert nodes[0].capacity["dra/gpu.example.com"] == 4
+    assert pods[0].requests["dra/gpu.example.com"] == 2
+    assert HOST_CHECK_ANNOTATION not in pods[0].annotations
+    # idempotent across loops (same objects re-listed)
+    apply_dra(nodes, pods, dra)
+    assert pods[0].requests["dra/gpu.example.com"] == 2
+
+
+def test_dra_feasibility_rides_resource_axis():
+    nodes = [
+        build_test_node("with-dev", cpu_milli=8000, mem_mib=16384),
+        build_test_node("without-dev", cpu_milli=8000, mem_mib=16384),
+    ]
+    pods = [build_test_pod(f"p{i}", cpu_milli=100, owner_name="rs") for i in range(3)]
+    dra = DraSnapshot(
+        slices=[ResourceSlice("with-dev", "tpu.example.com", 2)],
+        claims=[ResourceClaim(f"c{i}", owner_pod=f"p{i}",
+                              requests=[ClaimRequest("tpu.example.com", 1)])
+                for i in range(3)],
+    )
+    apply_dra(nodes, pods, dra)
+    enc = encode_cluster(nodes, pods, node_bucket=16, group_bucket=16)
+    packed = schedule_pending_on_existing(enc.nodes, enc.specs, enc.scheduled)
+    # only 2 devices exist cluster-wide -> exactly 2 of 3 pods place
+    assert int(np.asarray(packed.scheduled).sum()) == 2
+
+
+def test_selectored_claim_flags_host_check_and_exact_check():
+    nodes = [build_test_node("n1")]
+    pods = [build_test_pod("p1", owner_name="rs")]
+    dra = DraSnapshot(
+        classes={"gpu.example.com": DeviceClass("gpu.example.com")},
+        slices=[ResourceSlice("n1", "gpu.example.com", 4,
+                              attributes={"memoryGiB": "80"})],
+        claims=[ResourceClaim(
+            "c1", owner_pod="p1",
+            requests=[ClaimRequest("gpu.example.com", 1,
+                                   selector={"memoryGiB": "80"})])],
+    )
+    apply_dra(nodes, pods, dra)
+    assert pods[0].annotations[HOST_CHECK_ANNOTATION] == "true"
+    claim = dra.claims[0]
+    assert claim_fits_exact(claim, nodes[0], dra)
+    # selector mismatch -> exact check refuses
+    bad = ResourceClaim("c2", owner_pod="p1", requests=[
+        ClaimRequest("gpu.example.com", 1, selector={"memoryGiB": "40"})])
+    assert not claim_fits_exact(bad, nodes[0], dra)
+    # and encode marks the group for the winner-verification tier
+    enc = encode_cluster(nodes, pods, node_bucket=16, group_bucket=16)
+    assert bool(np.asarray(enc.specs.needs_host_check)[
+        : int(np.asarray(enc.specs.valid).sum())].any())
+
+
+def test_claim_reservation_lifecycle():
+    node = build_test_node("n1")
+    pod = build_test_pod("p1")
+    claim = ResourceClaim("c1", owner_pod="p1",
+                          requests=[ClaimRequest("gpu.example.com", 1)])
+    allocate_claim(claim, node, pod)
+    assert claim.allocated_node == "n1"
+    assert claim.reserved_for == ["default/p1"]
+    deallocate_claim(claim, pod)
+    assert claim.allocated_node == "" and claim.reserved_for == []
+
+
+def test_csi_volume_limits_block_placement():
+    nodes = [build_test_node("n1", cpu_milli=8000, mem_mib=16384)]
+    # 3 pods each with one PVC on the same driver; node allows 2 attachments
+    pods = []
+    csi = CsiSnapshot()
+    csi.add(CSINode("n1", [CSINodeDriver("ebs.csi.example.com", 2)]))
+    for i in range(3):
+        p = build_test_pod(f"p{i}", cpu_milli=100, owner_name="rs")
+        p.pvc_refs = (f"claim-{i}",)
+        csi.pvc_driver[f"default/claim-{i}"] = "ebs.csi.example.com"
+        pods.append(p)
+    apply_csi(nodes, pods, csi)
+    assert nodes[0].capacity["csi/ebs.csi.example.com"] == 2
+    enc = encode_cluster(nodes, pods, node_bucket=16, group_bucket=16)
+    packed = schedule_pending_on_existing(enc.nodes, enc.specs, enc.scheduled)
+    assert int(np.asarray(packed.scheduled).sum()) == 2
+
+
+def test_runonce_scales_up_for_dra_pods():
+    """Pending device claims force scale-up of the device-publishing group."""
+    fake = FakeCluster()
+    cpu_tmpl = build_test_node("t-cpu", cpu_milli=8000, mem_mib=16384)
+    dev_tmpl = build_test_node("t-dev", cpu_milli=8000, mem_mib=16384)
+    dev_tmpl.capacity["dra/gpu.example.com"] = 4
+    dev_tmpl.allocatable["dra/gpu.example.com"] = 4
+    fake.add_node_group("cpu", cpu_tmpl, min_size=0, max_size=5)
+    fake.add_node_group("dev", dev_tmpl, min_size=0, max_size=5)
+    fake.add_existing_node("cpu", build_test_node("n-cpu", cpu_milli=8000,
+                                                  mem_mib=16384))
+    dra = fake.dra_snapshot()
+    for i in range(8):
+        fake.add_pod(build_test_pod(f"g{i}", cpu_milli=500, mem_mib=256,
+                                    owner_name="rs"))
+        dra.claims.append(ResourceClaim(
+            f"c{i}", owner_pod=f"g{i}",
+            requests=[ClaimRequest("gpu.example.com", 1)]))
+    opts = AutoscalingOptions(
+        scale_down_delay_after_add_s=0.0,
+        node_shape_bucket=16, group_shape_bucket=16,
+        max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    a = StaticAutoscaler(fake.provider, fake, options=opts, eviction_sink=fake)
+    st = a.run_once(now=1000.0)
+    assert st.scale_up is not None and st.scale_up.scaled_up
+    # 8 claims x 1 device, 4 devices/node -> 2 "dev" nodes; cpu group useless
+    assert st.scale_up.increases == {"dev": 2}
