@@ -8,13 +8,23 @@ this problem: 1000-node clusters with a ≤60 s scale-up SLO and microbenchmarks
 that disclaim absolute numbers (BASELINE.md); our target is the sim in
 < 200 ms on one TPU chip.
 
-Measures: p50 on-device latency of ops.autoscale_step.scale_up_sim — the
-filter-out-schedulable pack + all 20 binpacking expansion options + expander
-scoring (reference hot loops A+B, SURVEY.md §3.1) — after compilation, over
-`--iters` runs. Host-side string→tensor encoding happens once per cluster
+Measures: steady-state on-device latency of ops.autoscale_step.scale_up_sim —
+the filter-out-schedulable pack + all 20 binpacking expansion options +
+expander scoring (reference hot loops A+B, SURVEY.md §3.1) — after
+compilation. Host-side string→tensor encoding happens once per cluster
 *change* in production and is reported separately on stderr, not in the metric
 (the reference benchmark likewise builds its snapshot outside the timed loop,
 core/bench/benchmark_runonce_test.go:404-418).
+
+Methodology: the TPU in this environment sits behind a network tunnel whose
+per-synchronization round trip (~70 ms) dwarfs device time, so single-dispatch
+wall clock measures the tunnel, not the simulator. We therefore time chains of
+data-dependent sims (each iteration consumes a scalar from the previous
+output, so nothing overlaps) and difference two chain lengths:
+  per_sim = (T(chain k2) - T(chain k1)) / (k2 - k1)
+which cancels the fixed sync cost exactly — the standard throughput
+methodology for accelerators behind an async dispatch queue. p50 over
+`--iters` chain pairs.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": <p50 ms>, "unit": "ms", "vs_baseline": <200/value>}
@@ -109,10 +119,12 @@ def main() -> None:
     ap.add_argument("--pod-groups", type=int, default=25)
     ap.add_argument("--nodegroups", type=int, default=20)
     ap.add_argument("--max-new-nodes", type=int, default=1024)
-    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--iters", type=int, default=7)
+    ap.add_argument("--chain", type=int, default=25, help="long chain length k2")
     args = ap.parse_args()
 
     import jax
+    import jax.numpy as jnp
 
     from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS
     from kubernetes_autoscaler_tpu.ops.autoscale_step import scale_up_sim
@@ -120,25 +132,48 @@ def main() -> None:
     enc, groups, encode_s = build_world(
         args.nodes, args.pods, args.pod_groups, args.nodegroups
     )
+    dev = jax.devices()[0]
+    nodes, specs, sched, groups = jax.device_put(
+        (enc.nodes, enc.specs, enc.scheduled, groups), dev
+    )
 
-    def run():
-        out = scale_up_sim(
-            enc.nodes, enc.specs, enc.scheduled, groups,
+    @jax.jit
+    def step(nodes, specs, sched, groups, token):
+        # Thread a device scalar through each iteration so chained sims are
+        # data-dependent. The bump is always 0 — token is out.best from the
+        # previous sim, which lives in [-1, NG) and never hits the sentinel —
+        # but XLA cannot know that, so iterations serialize.
+        bump = jnp.where(token == jnp.int32(-(1 << 30)), 1, 0).astype(jnp.int32)
+        specs = specs.replace(count=specs.count + bump)
+        return scale_up_sim.__wrapped__(
+            nodes, specs, sched, groups,
             DEFAULT_DIMS, args.max_new_nodes, "least-waste",
         )
-        jax.block_until_ready(out)
-        return out
 
     t0 = time.perf_counter()
-    out = run()
+    out = step(nodes, specs, sched, groups, jnp.int32(0))
+    jax.block_until_ready(out)
     compile_s = time.perf_counter() - t0
+    # Force the tunnel into synchronous mode so every block below is a real
+    # round trip (any D2H readback does this; see module docstring).
+    _ = int(out.best)
 
-    times = []
-    for _ in range(args.iters):
+    def chain(k: int) -> float:
         t0 = time.perf_counter()
-        run()
-        times.append((time.perf_counter() - t0) * 1000.0)
-    p50 = float(np.percentile(times, 50))
+        tok = jnp.int32(0)
+        for _ in range(k):
+            o = step(nodes, specs, sched, groups, tok)
+            tok = o.best
+        jax.block_until_ready(o)
+        return (time.perf_counter() - t0) * 1000.0
+
+    k2 = max(args.chain, 2)
+    k1 = max(k2 // 5, 1)
+    chain(2)  # warm dispatch path
+    samples = []
+    for _ in range(args.iters):
+        samples.append((chain(k2) - chain(k1)) / (k2 - k1))
+    p50 = float(np.percentile(samples, 50))
 
     checks = int(np.asarray(enc.specs.count).sum()) * args.nodes
     print(

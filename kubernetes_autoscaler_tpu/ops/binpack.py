@@ -18,6 +18,8 @@ Output shapes: NG node groups × G pod groups × M max-new-nodes (static).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -29,6 +31,24 @@ from kubernetes_autoscaler_tpu.models.cluster_state import (
 )
 from kubernetes_autoscaler_tpu.ops import predicates
 from kubernetes_autoscaler_tpu.ops.pack import ffd_order, pack_groups
+
+
+def pack_backend() -> str:
+    """Which FFD pack implementation estimate_all uses.
+
+    'pallas' — one fused Mosaic kernel over (nodegroup, node-tile) with the
+    free-capacity carry resident in VMEM (ops/pallas/pack_kernel.py); the
+    measured-faster path on TPU. 'xla' — the lax.scan formulation (ops/pack.py),
+    used on CPU where Pallas would run interpreted. Override with
+    KA_TPU_PACK=xla|pallas.
+
+    The choice is read at TRACE time: once a jitted caller (e.g.
+    scale_up_sim) has compiled, changing the env var does not affect the
+    cached executable — set it before the first call."""
+    choice = os.environ.get("KA_TPU_PACK", "auto")
+    if choice in ("xla", "pallas"):
+        return choice
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
 class EstimateResult(struct.PyTreeNode):
@@ -52,6 +72,29 @@ def estimate_all(
     mask_gt = predicates.feasibility_mask(tmpl_nodes, specs, check_resources=False)
     order = ffd_order(specs.req, specs.valid & (specs.count > 0))
     count = jnp.where(specs.valid, specs.count, 0)
+
+    if pack_backend() == "pallas":
+        from kubernetes_autoscaler_tpu.ops.pallas.pack_kernel import (
+            pack_groups_batched,
+        )
+
+        ng, r = groups.cap.shape
+        free3 = jnp.broadcast_to(groups.cap[:, None, :], (ng, max_new_nodes, r))
+        bin_open = jnp.arange(max_new_nodes, dtype=jnp.int32)[None, :] < groups.max_new[:, None]
+        mask3 = mask_gt.T[:, :, None] & bin_open[:, None, :]
+        res = pack_groups_batched(
+            free3, mask3, specs.req, count, order, specs.one_per_node()
+        )
+        pods_per_node = res.placed.sum(axis=1)
+        node_count = (pods_per_node > 0).sum(axis=-1).astype(jnp.int32)
+        node_count = jnp.where(groups.valid, node_count, 0)
+        return EstimateResult(
+            node_count=node_count,
+            scheduled=res.scheduled * groups.valid[:, None],
+            pods_per_node=pods_per_node,
+            free_after=res.free_after,
+            template_fits=mask_gt.T,
+        )
 
     def one_group(cap_row, max_new, feas_col):
         free0 = jnp.broadcast_to(cap_row[None, :], (max_new_nodes, cap_row.shape[0]))

@@ -63,6 +63,10 @@ def pack_groups(
         c = fit_count(free_c, reqg)
         c = jnp.where(mask[g], c, 0)
         c = jnp.where(limit_one[g], jnp.minimum(c, 1), c)
+        # Clamp to the group's pod count: semantics-neutral (placement is
+        # capped by count anyway) and keeps the prefix sum away from i32
+        # overflow when a zero-request pod makes fit_count() huge.
+        c = jnp.minimum(c, count[g])
         cum = jnp.cumsum(c)
         place = jnp.clip(count[g] - (cum - c), 0, c)
         free_c = free_c - place[:, None] * reqg[None, :]

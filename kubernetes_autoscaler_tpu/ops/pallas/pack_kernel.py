@@ -1,0 +1,202 @@
+"""Pallas TPU kernel for the first-fit-decreasing pack scan.
+
+This is the Pallas tier of the hot op named in SURVEY.md §7 ("the
+scatter-heavy incremental node_alloc update and the first-fit argmin with
+tie-break ordering"). The XLA tier (ops/pack.py pack_groups) expresses the
+FFD pass as a `lax.scan` over pod equivalence groups whose carry — the
+free-capacity tensor — round-trips through the scan machinery every step.
+Here the whole pass is ONE kernel launch:
+
+  * grid = (batch, node-tiles); the TPU grid is sequential, so tiles see
+    free capacity exactly as a serial first-fit would,
+  * the free tensor lives in VMEM for the whole group loop (read-modify-
+    write on the output block, no HBM traffic per group),
+  * per-group remaining pod counts persist across node tiles in SMEM
+    scratch — the cross-tile spill carry of first-fit,
+  * group metadata (requests, counts, FFD order, one-per-node flags) ride
+    the scalar-prefetch channel into SMEM.
+
+Semantics are bit-identical to ops/pack.pack_groups (property-tested in
+tests/test_pallas_pack.py): nodes fill in ascending index order, groups in
+the caller-supplied order, placement capped by per-node fit counts and the
+group's remaining pod count.
+
+Reference counterpart (behavior, not design): the serial per-pod
+SchedulePod loop in estimator/binpacking_estimator.go:163-238 and
+simulator/scheduling/hinting_simulator.go:53.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubernetes_autoscaler_tpu.ops.pack import PackResult
+
+_BIG = 1 << 30  # Python int: jnp scalars would be captured tracer constants
+
+
+def _cumsum_lanes(x: jnp.ndarray, tile: int) -> jnp.ndarray:
+    """Inclusive prefix sum along the lane axis of i32[1, T] (Hillis–Steele).
+
+    log2(T) shift-and-add steps; jnp.roll wraps, the iota mask zeroes the
+    wrapped lanes."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    s = 1
+    while s < tile:
+        x = x + jnp.where(idx >= s, jnp.roll(x, s, axis=1), 0)
+        s *= 2
+    return x
+
+
+def _pack_kernel(
+    # scalar prefetch (SMEM)
+    req_ref,      # i32[G, R]
+    count_ref,    # i32[G]
+    order_ref,    # i32[G]
+    limone_ref,   # i32[G]
+    # VMEM blocks
+    free_ref,     # i32[1, R, T] this tile's starting free capacity
+    mask_ref,     # i32[1, G, T] feasibility (already includes bin_open/validity)
+    placed_ref,   # i32[1, G, T] out
+    freeout_ref,  # i32[1, R, T] out
+    # scratch
+    rem_ref,      # SMEM i32[G] pods still wanted per group (carries across tiles)
+    *,
+    n_groups: int,
+    n_res: int,
+    tile: int,
+):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _init_remaining():
+        def init(i, _):
+            rem_ref[i] = count_ref[i]
+            return 0
+        jax.lax.fori_loop(0, n_groups, init, 0)
+
+    freeout_ref[...] = free_ref[...]
+
+    def body(i, _):
+        g = order_ref[i]
+        cnt = rem_ref[g]
+        lim = limone_ref[g]
+
+        fit = jnp.full((1, tile), _BIG, jnp.int32)
+        for r in range(n_res):
+            rv = req_ref[g, r]
+            fr = jnp.maximum(freeout_ref[0, r : r + 1, :], 0)
+            q = fr // jnp.maximum(rv, 1)
+            fit = jnp.minimum(fit, jnp.where(rv > 0, q, _BIG))
+
+        m = mask_ref[0, pl.ds(g, 1), :]
+        fit = jnp.where(m > 0, fit, 0)
+        fit = jnp.where(lim > 0, jnp.minimum(fit, 1), fit)
+        # Clamp to the remaining count: semantics-neutral, and keeps the
+        # prefix sum far from i32 overflow (50k pods × 8k lanes < 2^31).
+        fit = jnp.minimum(fit, cnt)
+
+        cum = _cumsum_lanes(fit, tile)
+        place = jnp.clip(cnt - (cum - fit), 0, fit)
+
+        for r in range(n_res):
+            rv = req_ref[g, r]
+            freeout_ref[0, r : r + 1, :] = freeout_ref[0, r : r + 1, :] - place * rv
+        placed_ref[0, pl.ds(g, 1), :] = place
+        rem_ref[g] = cnt - jnp.sum(place)
+        return 0
+
+    jax.lax.fori_loop(0, n_groups, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def pack_groups_batched(
+    free: jnp.ndarray,       # i32[B, N, R] starting free capacity per batch row
+    mask: jnp.ndarray,       # bool[B, G, N] placement-independent feasibility
+    req: jnp.ndarray,        # i32[G, R]
+    count: jnp.ndarray,      # i32[G]
+    order: jnp.ndarray,      # i32[G]
+    limit_one: jnp.ndarray,  # bool[G]
+    tile: int = 512,
+    interpret: bool | None = None,
+) -> PackResult:
+    """Batched FFD pack as one Pallas launch; batch rows are independent.
+
+    Returns a PackResult with a leading batch axis on every field."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, n, r = free.shape
+    g = req.shape[0]
+    tile = min(tile, max(128, n))
+    n_pad = ((n + tile - 1) // tile) * tile
+    nt = n_pad // tile
+
+    free_t = jnp.swapaxes(free.astype(jnp.int32), 1, 2)          # [B, R, N]
+    if n_pad != n:
+        free_t = jnp.pad(free_t, ((0, 0), (0, 0), (0, n_pad - n)))
+    mask_i = jnp.pad(mask.astype(jnp.int32), ((0, 0), (0, 0), (0, n_pad - n)))
+
+    kernel = functools.partial(_pack_kernel, n_groups=g, n_res=r, tile=tile)
+    placed, free_out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(b, nt),
+            in_specs=[
+                pl.BlockSpec((1, r, tile), lambda bi, t, *_: (bi, 0, t)),
+                pl.BlockSpec((1, g, tile), lambda bi, t, *_: (bi, 0, t)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, g, tile), lambda bi, t, *_: (bi, 0, t)),
+                pl.BlockSpec((1, r, tile), lambda bi, t, *_: (bi, 0, t)),
+            ],
+            scratch_shapes=[pltpu.SMEM((g,), jnp.int32)],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, g, n_pad), jnp.int32),
+            jax.ShapeDtypeStruct((b, r, n_pad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        req.astype(jnp.int32),
+        count.astype(jnp.int32),
+        order.astype(jnp.int32),
+        limit_one.astype(jnp.int32),
+        free_t,
+        mask_i,
+    )
+
+    placed = placed[:, :, :n]
+    free_after = jnp.swapaxes(free_out, 1, 2)[:, :n, :]
+    return PackResult(
+        free_after=free_after,
+        placed=placed,
+        scheduled=placed.sum(axis=-1),
+    )
+
+
+def pack_groups_pallas(
+    free: jnp.ndarray,       # i32[N, R]
+    mask: jnp.ndarray,       # bool[G, N]
+    req: jnp.ndarray,
+    count: jnp.ndarray,
+    order: jnp.ndarray,
+    limit_one: jnp.ndarray,
+    tile: int = 512,
+    interpret: bool | None = None,
+) -> PackResult:
+    """Drop-in Pallas replacement for ops/pack.pack_groups (unbatched)."""
+    res = pack_groups_batched(
+        free[None], mask[None], req, count, order, limit_one,
+        tile=tile, interpret=interpret,
+    )
+    return PackResult(
+        free_after=res.free_after[0],
+        placed=res.placed[0],
+        scheduled=res.scheduled[0],
+    )

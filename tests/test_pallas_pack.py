@@ -1,0 +1,171 @@
+"""Property tests: the Pallas FFD pack kernel is bit-identical to the XLA scan.
+
+Mirrors the reference's oracle idiom (SURVEY.md §4): the XLA `pack_groups`
+scan plays the role the serial Go path plays for the reference — the Pallas
+kernel must agree exactly on placements, spill order, and leftover capacity.
+Runs in interpret mode on the CPU test mesh; the same kernel compiles via
+Mosaic on real TPU (the default estimate_all path there; KA_TPU_PACK selects).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kubernetes_autoscaler_tpu.ops.pack import ffd_order, fit_count, pack_groups
+from kubernetes_autoscaler_tpu.ops.pallas.pack_kernel import (
+    pack_groups_batched,
+    pack_groups_pallas,
+)
+
+
+def _rand_instance(rng, n, g, r=4, max_req=6, max_cap=40, max_count=30):
+    free = rng.integers(0, max_cap, size=(n, r)).astype(np.int32)
+    req = rng.integers(0, max_req, size=(g, r)).astype(np.int32)
+    # ensure most groups request something; leave some all-zero rows to cover
+    # the zero-request overflow edge
+    count = rng.integers(0, max_count, size=(g,)).astype(np.int32)
+    mask = rng.random((g, n)) < 0.8
+    limit_one = rng.random((g,)) < 0.2
+    valid = np.ones((g,), bool)
+    order = np.asarray(ffd_order(jnp.asarray(req), jnp.asarray(valid)))
+    return (
+        jnp.asarray(free), jnp.asarray(mask), jnp.asarray(req),
+        jnp.asarray(count), jnp.asarray(order), jnp.asarray(limit_one),
+    )
+
+
+def _assert_same(res_ref, res_pl):
+    np.testing.assert_array_equal(np.asarray(res_ref.placed), np.asarray(res_pl.placed))
+    np.testing.assert_array_equal(
+        np.asarray(res_ref.scheduled), np.asarray(res_pl.scheduled))
+    np.testing.assert_array_equal(
+        np.asarray(res_ref.free_after), np.asarray(res_pl.free_after))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_pallas_matches_xla_random(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(3, 40))
+    g = int(rng.integers(1, 12))
+    args = _rand_instance(rng, n, g)
+    _assert_same(pack_groups(*args), pack_groups_pallas(*args, tile=128))
+
+
+def test_pallas_matches_xla_tiled_spill():
+    """Counts large enough to spill across several node tiles: the SMEM
+    remaining-count carry must hand off between sequential grid steps."""
+    rng = np.random.default_rng(99)
+    n, g = 300, 5
+    free, mask, req, count, order, limit_one = _rand_instance(rng, n, g)
+    count = jnp.full((g,), 400, jnp.int32)  # force cross-tile spill
+    args = (free, mask, req, count, order, limit_one)
+    _assert_same(pack_groups(*args), pack_groups_pallas(*args, tile=128))
+
+
+def test_zero_request_group_no_overflow():
+    """A pod requesting zero resources fits 'infinitely'; the prefix sum must
+    not overflow and placement must stop at the group's count."""
+    n, g, r = 200, 2, 4
+    free = jnp.zeros((n, r), jnp.int32)
+    req = jnp.zeros((g, r), jnp.int32)
+    count = jnp.asarray([7, 0], jnp.int32)
+    mask = jnp.ones((g, n), bool)
+    order = jnp.asarray([0, 1], jnp.int32)
+    limit_one = jnp.zeros((g,), bool)
+    args = (free, mask, req, count, order, limit_one)
+    ref = pack_groups(*args)
+    assert int(ref.scheduled[0]) == 7
+    assert int(ref.scheduled[1]) == 0
+    assert int(ref.placed.max()) <= 7
+    _assert_same(ref, pack_groups_pallas(*args, tile=128))
+
+
+def test_batched_independent_rows():
+    """Batch rows must not leak capacity or remaining counts into each other
+    (each row re-packs ALL pods — the estimate_all usage)."""
+    rng = np.random.default_rng(7)
+    n, g, b = 60, 6, 3
+    free, mask, req, count, order, limit_one = _rand_instance(rng, n, g)
+    free3 = jnp.stack([free, free // 2, free * 0])
+    mask3 = jnp.stack([mask, mask, mask])
+    res = pack_groups_batched(free3, mask3, req, count, order, limit_one, tile=128)
+    for i, fr in enumerate([free, free // 2, free * 0]):
+        ref = pack_groups(fr, mask, req, count, order, limit_one)
+        np.testing.assert_array_equal(np.asarray(res.placed[i]), np.asarray(ref.placed))
+        np.testing.assert_array_equal(
+            np.asarray(res.free_after[i]), np.asarray(ref.free_after))
+
+
+def test_batched_multi_tile_carry_reset():
+    """b>1 AND nt>1: the SMEM remaining-count carry must reset at tile 0 of
+    every batch row, not just the first — a leak would let row 0's leftover
+    counts bleed into row 1's packing."""
+    rng = np.random.default_rng(11)
+    n, g, b = 300, 4, 3
+    free, mask, req, count, order, limit_one = _rand_instance(rng, n, g)
+    count = jnp.full((g,), 150, jnp.int32)  # spills across tiles in every row
+    free3 = jnp.stack([free, free // 3, free * 2])
+    mask3 = jnp.stack([mask, mask, mask])
+    res = pack_groups_batched(free3, mask3, req, count, order, limit_one, tile=128)
+    for i, fr in enumerate([free, free // 3, free * 2]):
+        ref = pack_groups(fr, mask, req, count, order, limit_one)
+        np.testing.assert_array_equal(np.asarray(res.placed[i]), np.asarray(ref.placed))
+        np.testing.assert_array_equal(
+            np.asarray(res.scheduled[i]), np.asarray(ref.scheduled))
+
+
+def test_first_fit_order_contract():
+    """Nodes fill in ascending index order; spill continues at the next node."""
+    free = jnp.asarray([[2, 10], [2, 10], [2, 10]], jnp.int32)
+    req = jnp.asarray([[1, 1]], jnp.int32)
+    count = jnp.asarray([5], jnp.int32)
+    mask = jnp.ones((1, 3), bool)
+    order = jnp.asarray([0], jnp.int32)
+    lim = jnp.zeros((1,), bool)
+    res = pack_groups_pallas(free, mask, req, count, order, lim, tile=128)
+    np.testing.assert_array_equal(np.asarray(res.placed[0]), [2, 2, 1])
+
+
+def test_estimate_all_backend_parity(monkeypatch):
+    """estimate_all must produce identical expansion options on both pack
+    backends (XLA scan vs Pallas kernel)."""
+    from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS
+    from kubernetes_autoscaler_tpu.models.encode import (
+        encode_cluster,
+        encode_node_groups,
+    )
+    from kubernetes_autoscaler_tpu.ops import binpack
+    from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192) for i in range(4)]
+    pods = [
+        build_test_pod(f"p{i}", cpu_milli=500 + 250 * (i % 3), mem_mib=512,
+                       owner_name=f"rs{i % 3}")
+        for i in range(40)
+    ]
+    enc = encode_cluster(nodes, pods, node_bucket=64, group_bucket=64)
+    templates = [
+        (build_test_node(f"t{k}", cpu_milli=8000 * (k + 1), mem_mib=32768), 50, 1.0)
+        for k in range(3)
+    ]
+    groups = encode_node_groups(templates, enc.registry, enc.zone_table)
+
+    monkeypatch.setenv("KA_TPU_PACK", "xla")
+    ref = binpack.estimate_all(enc.specs, groups, DEFAULT_DIMS, 64)
+    monkeypatch.setenv("KA_TPU_PACK", "pallas")
+    got = binpack.estimate_all(enc.specs, groups, DEFAULT_DIMS, 64)
+    np.testing.assert_array_equal(np.asarray(ref.node_count), np.asarray(got.node_count))
+    np.testing.assert_array_equal(np.asarray(ref.scheduled), np.asarray(got.scheduled))
+    np.testing.assert_array_equal(
+        np.asarray(ref.pods_per_node), np.asarray(got.pods_per_node))
+    np.testing.assert_array_equal(
+        np.asarray(ref.free_after), np.asarray(got.free_after))
+
+
+def test_fit_count_sanity():
+    free = jnp.asarray([[4, 4], [1, 8], [-2, 8]], jnp.int32)
+    req = jnp.asarray([2, 1], jnp.int32)
+    np.testing.assert_array_equal(np.asarray(fit_count(free, req)), [2, 0, 0])
