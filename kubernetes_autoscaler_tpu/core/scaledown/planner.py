@@ -113,8 +113,12 @@ class Planner:
             return self.state
 
         cand = np.asarray(eligible_idx, dtype=np.int32)
+        # Destinations include other candidates (reference: GetPodDestinations
+        # defaults to all nodes, planner.go:768-774) — consolidation onto
+        # fellow candidates is what lets 400 nodes at 40% drain down to 160.
+        # The per-candidate device verdict is "in isolation"; the sequential
+        # confirmation pass in nodes_to_delete() resolves interactions.
         dest_allowed = np.ones((enc.nodes.n,), dtype=bool)
-        dest_allowed[cand] = False   # destinations: nodes staying up
         removal = simulate_removals(
             enc.nodes, enc.specs, enc.scheduled,
             jnp.asarray(cand), jnp.asarray(dest_allowed),
@@ -152,18 +156,29 @@ class Planner:
         removal = self.state.removal
         cand = self.state.candidate_indices
         drainable = np.asarray(removal.drainable)
-        n_moved = np.asarray(removal.n_moved)
-        dest_node = np.asarray(removal.dest_node)
         pod_slot = np.asarray(removal.pod_slot)
+        feas = np.asarray(removal.feas)              # bool[G, N]
         by_index = {int(c): k for k, c in enumerate(cand)}
         name_to_i = {nd.name: i for i, nd in enumerate(nodes)}
 
-        # Greedy confirmation: walk unneeded nodes (oldest clock first) and
-        # charge their pods' destinations against a host-side free tensor so
-        # two drains can't double-book one destination (reference: the serial
-        # commit-on-success in RemovalSimulator).
+        # Sequential confirmation: walk unneeded nodes (oldest clock first),
+        # re-placing each candidate's pods — original AND any received from
+        # earlier confirmed drains — against a host-side running free tensor
+        # and the device-computed predicate plane. This reproduces the
+        # reference's commit-on-success sequencing (each successful removal's
+        # moves are committed into the working snapshot before the next
+        # candidate is simulated, simulator/cluster.go:174-188), which the
+        # independent per-candidate device sweep deliberately omits.
         free = (np.asarray(enc.nodes.cap) - np.asarray(enc.nodes.alloc)).astype(np.int64)
         reqs = np.asarray(enc.scheduled.req)
+        group_ref = np.asarray(enc.scheduled.group_ref)
+        movable_f = np.asarray(enc.scheduled.movable)
+        limit_g = np.asarray(enc.specs.one_per_node())
+        node_valid = np.asarray(enc.nodes.valid)
+        deleted_mask = np.zeros((enc.nodes.n,), dtype=bool)
+        received_slots: dict[int, list[int]] = {}   # node idx -> extra pod slots
+        moved_marks: set[tuple[int, int]] = set()   # (group_ref, node) one-per-node
+        final_dest: dict[int, int] = {}             # pod slot -> latest destination
         quota_status = None
         if self.quota is not None:
             quota_status = self.quota.status_from_encoded(enc)
@@ -208,7 +223,12 @@ class Planner:
                 self._mark(name, "MinimalResourceLimitExceeded", now)
                 continue
 
-            is_empty = n_moved[k] == 0
+            orig_slots = [
+                int(pod_slot[k, s]) for s in range(pod_slot.shape[1])
+                if int(pod_slot[k, s]) >= 0 and movable_f[int(pod_slot[k, s])]
+            ]
+            victim_slots = orig_slots + received_slots.get(i, [])
+            is_empty = not victim_slots
             if is_empty:
                 if empty_budget <= 0:
                     continue
@@ -218,36 +238,42 @@ class Planner:
 
             # PDB gate (reference: planner consults the shared
             # RemainingPdbTracker before confirming a drain; the actuator
-            # deducts at eviction time). Need is accumulated across the
-            # candidates confirmed in THIS pass so two drains can't jointly
-            # overdraw one budget.
+            # deducts at eviction time). Only pods physically on the node are
+            # evicted — received slots were accounted when their own node was
+            # confirmed. Need is accumulated across the candidates confirmed
+            # in THIS pass so two drains can't jointly overdraw one budget.
             pdb_need: dict[int, int] = {}
-            if not is_empty and self.pdb_tracker is not None:
-                victims = [
-                    enc.scheduled_pods[int(pod_slot[k, s])]
-                    for s in range(dest_node.shape[1])
-                    if int(dest_node[k, s]) >= 0
-                ]
+            if orig_slots and self.pdb_tracker is not None:
+                victims = [enc.scheduled_pods[s] for s in orig_slots]
                 if not self.pdb_tracker.can_remove_pods(victims, pdb_reserved):
                     self._mark(name, "NotEnoughPdb", now)
                     continue
                 pdb_need = self.pdb_tracker.reservation(victims)
 
-            # charge destinations
+            # Re-place every victim (original + received) sequentially:
+            # first feasible node in index order — the device packer's
+            # tie-break — over live free capacity and this round's state.
             moves: dict[int, int] = {}
+            local_marks: set[tuple[int, int]] = set()
             ok = True
-            for s in range(dest_node.shape[1]):
-                d = int(dest_node[k, s])
-                if d < 0:
-                    continue
-                slot = int(pod_slot[k, s])
+            for slot in victim_slots:
+                g_ref = int(group_ref[slot])
                 req = reqs[slot]
-                if (free[d] >= req).all():
-                    free[d] -= req
-                    moves[slot] = d
-                else:
+                fits = feas[g_ref] & node_valid & ~deleted_mask
+                fits &= (free >= req[None, :]).all(axis=1)
+                fits[i] = False
+                if limit_g[g_ref]:
+                    for (gm, dm) in moved_marks | local_marks:
+                        if gm == g_ref:
+                            fits[dm] = False
+                d = int(np.argmax(fits))
+                if not fits[d]:
                     ok = False
                     break
+                free[d] -= req
+                moves[slot] = d
+                if limit_g[g_ref]:
+                    local_marks.add((g_ref, d))
             if not ok:
                 # revert charges; try again next loop (destinations taken by an
                 # earlier candidate this round)
@@ -268,7 +294,19 @@ class Planner:
                 empty_budget -= 1
             else:
                 drain_budget -= 1
-            out.append(NodeToRemove(nd, bool(is_empty),
-                                    pods_to_move=list(moves.keys()),
-                                    destinations=moves))
+            deleted_mask[i] = True
+            for slot, d in moves.items():
+                received_slots.setdefault(d, []).append(slot)
+                final_dest[slot] = d
+            moved_marks |= local_marks
+            # The actuator evicts only pods physically on the node; received
+            # slots were capacity bookkeeping for this round's working state.
+            out.append(NodeToRemove(nd, bool(is_empty), pods_to_move=orig_slots))
+
+        # A destination chosen early can itself be confirmed for deletion
+        # later in the pass (its received pods were then re-placed); report
+        # each pod's FINAL destination, never a deleted node.
+        for r in out:
+            r.destinations = {s: final_dest[s] for s in r.pods_to_move
+                              if s in final_dest}
         return out

@@ -94,7 +94,11 @@ def scale_down_sim(
         specs,
         scheduled,
         candidates,
-        dest_allowed=~eligible,  # destinations: nodes staying up
+        # Destinations: every node but the candidate itself (the planner's
+        # policy — consolidation onto fellow candidates is allowed; each
+        # verdict is per-candidate-in-isolation, and the planner's sequential
+        # confirmation pass resolves interactions between accepted drains).
+        dest_allowed=jnp.ones((nodes.n,), bool),
         max_pods_per_node=max_pods_per_node,
         chunk=chunk,
     )

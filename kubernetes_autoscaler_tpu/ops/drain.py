@@ -43,6 +43,9 @@ class RemovalResult(struct.PyTreeNode):
     n_failed: jax.Array    # i32[C] movable pods with no destination
     dest_node: jax.Array   # i32[C, MPN] destination node per pod slot (-1 = none)
     pod_slot: jax.Array    # i32[C, MPN] index into ScheduledPodTensors per slot
+    feas: jax.Array        # bool[G, N] shared predicate plane (pre-capacity);
+                           # lets the host's sequential confirmation pass
+                           # re-pick destinations without re-running predicates
 
 
 def simulate_removals(
@@ -137,4 +140,5 @@ def simulate_removals(
         n_failed=n_failed,
         dest_node=dests,
         pod_slot=pod_slot,
+        feas=feas_gn,
     )

@@ -1,0 +1,148 @@
+"""Reference-mirror scenario benchmarks as correctness tests.
+
+These reproduce the reference's two RunOnce microbenchmark scenarios as
+assertions (core/bench/benchmark_runonce_test.go):
+
+  * BenchmarkRunOnceScaleUp (:493-503, setup :393-418): N pending pods,
+    one node group scaling 0 -> N/50 where each node holds 50 pods — the
+    whole demand must be satisfied in one RunOnce.
+  * BenchmarkRunOnceScaleDown (:505-520, setup :424-453): a fleet at 40%
+    utilization must consolidate — 60% of the nodes drain onto the other
+    40% in one RunOnce (the reference asserts 240 of 400 tainted).
+
+Scaled to CPU-mesh-friendly sizes by default; the proportions and the
+assertions are the reference's. KA_TPU_BENCH_FULL=1 runs reference scale.
+"""
+
+import os
+
+import pytest
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+FULL = os.environ.get("KA_TPU_BENCH_FULL") == "1"
+
+
+def test_runonce_scale_up_benchmark_scenario():
+    """One node group 0->N, 50 pods per node (pods-slot constrained)."""
+    pods_total = 10_000 if FULL else 500
+    pods_per_node = 50
+    want_nodes = pods_total // pods_per_node
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=200 * pods_per_node + 1000,
+                           mem_mib=128 * pods_per_node + 1024, pods=pods_per_node)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=max(want_nodes, 1000))
+    for i in range(pods_total):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=200, mem_mib=128,
+                                    owner_name="rs"))
+    opts = AutoscalingOptions(
+        node_shape_bucket=64,
+        group_shape_bucket=16,
+        max_new_nodes_static=max(2 * want_nodes, 32),
+        max_pods_per_node=pods_per_node + 4,
+        drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    a = StaticAutoscaler(fake.provider, fake, options=opts, eviction_sink=fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    assert status.scale_up.increases == {"ng1": want_nodes}
+    assert len(fake.nodes) == want_nodes
+
+
+def test_runonce_scale_down_benchmark_scenario():
+    """Fleet at 40% utilization consolidates: 60% of nodes drain in one
+    RunOnce onto the remaining 40% (reference: 240 of 400 tainted)."""
+    n_nodes = 400 if FULL else 40
+    pods_per_node = 2          # 2 x 2000m on a 10000m node = 40% utilization
+    want_deleted = int(n_nodes * 0.6)
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=10_000, mem_mib=32_768,
+                           pods=16)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=2 * n_nodes)
+    for i in range(n_nodes):
+        fake.add_existing_node("ng1", build_test_node(
+            f"n{i}", cpu_milli=10_000, mem_mib=32_768, pods=16))
+        for j in range(pods_per_node):
+            fake.add_pod(build_test_pod(
+                f"p{i}-{j}", cpu_milli=2000, mem_mib=512,
+                owner_name=f"rs{i % 7}", node_name=f"n{i}"))
+    opts = AutoscalingOptions(
+        node_shape_bucket=64,
+        group_shape_bucket=16,
+        max_new_nodes_static=32,
+        max_pods_per_node=16,
+        drain_chunk=8,
+        max_scale_down_parallelism=n_nodes,
+        max_drain_parallelism=n_nodes,
+        max_empty_bulk_delete=n_nodes,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    a = StaticAutoscaler(fake.provider, fake, options=opts, eviction_sink=fake)
+    status = a.run_once(now=1000.0)
+    deleted = status.scale_down_deleted
+    # Identical pods: first-fit consolidation is optimal, exactly 60% drain
+    # (each survivor fills 2 own + 3 received = 5 x 2000m = 100%).
+    assert len(deleted) == want_deleted, f"deleted {len(deleted)} of {n_nodes}"
+    assert len(fake.nodes) == n_nodes - want_deleted
+
+
+def test_consolidation_destinations_are_survivors():
+    """A destination chosen early in the confirmation pass can itself be
+    deleted later; the plan must report each pod's FINAL landing node."""
+    from kubernetes_autoscaler_tpu.core.scaledown.planner import Planner
+    from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+    from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
+        apply_drainability,
+    )
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=10_000, mem_mib=32_768, pods=16)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=100)
+    nodes = []
+    pods = []
+    for i in range(8):
+        nd = build_test_node(f"n{i}", cpu_milli=10_000, mem_mib=32_768, pods=16)
+        fake.add_existing_node("ng1", nd)
+        nodes.append(fake.nodes[nd.name])
+        for j in range(2):
+            p = build_test_pod(f"p{i}-{j}", cpu_milli=2000, mem_mib=512,
+                               owner_name=f"rs{i % 3}", node_name=f"n{i}")
+            fake.add_pod(p)
+            pods.append(p)
+    enc = encode_cluster(nodes, pods, node_bucket=64, group_bucket=16)
+    apply_drainability(enc)
+    opts = AutoscalingOptions(
+        node_shape_bucket=64, group_shape_bucket=16, max_new_nodes_static=32,
+        max_pods_per_node=16, drain_chunk=8,
+        max_scale_down_parallelism=16, max_drain_parallelism=16,
+        max_empty_bulk_delete=16,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    planner = Planner(fake.provider, opts)
+    planner.update(enc, nodes, now=1000.0)
+    plan = planner.nodes_to_delete(enc, nodes, now=1000.0)
+    # 16 pods / (5 per survivor: 2 own + 3 received) -> 4 survivors, 4 deleted
+    assert len(plan) == 4
+    deleted_idx = {i for i, nd in enumerate(nodes)
+                   if nd.name in {r.node.name for r in plan}}
+    for r in plan:
+        for slot, d in r.destinations.items():
+            assert d not in deleted_idx, (
+                f"{r.node.name} pod slot {slot} routed to deleted node idx {d}")
+
+
+@pytest.mark.skipif(not FULL, reason="reference-scale run only with KA_TPU_BENCH_FULL=1")
+def test_runonce_scale_up_reference_scale():
+    test_runonce_scale_up_benchmark_scenario()
