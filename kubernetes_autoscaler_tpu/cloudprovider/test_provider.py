@@ -76,6 +76,10 @@ class TestNodeGroup(NodeGroup):
             if self._provider.on_scale_down:
                 self._provider.on_scale_down(self._id, nd.name)
             self._provider.remove_node(self._id, nd.name)
+            # deleting a never-registered instance clears its cloud-side
+            # record too (otherwise a reaped create-error instance would be
+            # re-reaped — and the target re-decremented — every loop)
+            self._instances = [i for i in self._instances if i.name != nd.name]
             self._target -= 1
 
     def decrease_target_size(self, delta: int) -> None:

@@ -174,7 +174,12 @@ class Planner:
         group_ref = np.asarray(enc.scheduled.group_ref)
         movable_f = np.asarray(enc.scheduled.movable)
         limit_g = np.asarray(enc.specs.one_per_node())
-        node_valid = np.asarray(enc.nodes.valid)
+        # same destination gates the device sweep applies (ops/drain.py):
+        # valid & ready & schedulable — a cordoned or unready node must not
+        # absorb paper capacity during confirmation
+        node_valid = (np.asarray(enc.nodes.valid)
+                      & np.asarray(enc.nodes.ready)
+                      & np.asarray(enc.nodes.schedulable))
         deleted_mask = np.zeros((enc.nodes.n,), dtype=bool)
         received_slots: dict[int, list[int]] = {}   # node idx -> extra pod slots
         moved_marks: set[tuple[int, int]] = set()   # (group_ref, node) one-per-node

@@ -177,6 +177,10 @@ class StaticAutoscaler:
 
             # unregistered-instance reaping (reference: removeOldUnregisteredNodes :976)
             self._clean_long_unregistered(now)
+            # failed-boot reaping (reference: deleteCreatedNodesWithErrors
+            # static_autoscaler.go:1081 — instances stuck in a create-error
+            # state are deleted immediately and the group backed off)
+            self._delete_created_nodes_with_errors(nodes, now)
 
             if not self.cluster_state.is_cluster_healthy():
                 status.ran = False
@@ -468,3 +472,23 @@ class StaticAutoscaler:
                 g.delete_nodes([Node(name=u.name)])
             except Exception:
                 pass
+
+    def _delete_created_nodes_with_errors(self, nodes: list[Node],
+                                          now: float) -> None:
+        """Reap instances that failed to boot (create-error status): delete
+        them so the target size drops, and back off the group so the next
+        loop expands elsewhere (reference: deleteCreatedNodesWithErrors
+        static_autoscaler.go:1081 + RegisterFailedScaleUp)."""
+        registered = {n.name for n in nodes}
+        for g in self.provider.node_groups():
+            errored = [
+                i for i in g.nodes()
+                if i.error_class and i.name not in registered
+            ]
+            if not errored:
+                continue
+            try:
+                g.delete_nodes([Node(name=i.name) for i in errored])
+            except Exception:
+                continue
+            self.cluster_state.register_failed_scale_up(g, now)

@@ -16,8 +16,6 @@ assertions are the reference's. KA_TPU_BENCH_FULL=1 runs reference scale.
 
 import os
 
-import pytest
-
 from kubernetes_autoscaler_tpu.config.options import (
     AutoscalingOptions,
     NodeGroupDefaults,
@@ -143,6 +141,3 @@ def test_consolidation_destinations_are_survivors():
                 f"{r.node.name} pod slot {slot} routed to deleted node idx {d}")
 
 
-@pytest.mark.skipif(not FULL, reason="reference-scale run only with KA_TPU_BENCH_FULL=1")
-def test_runonce_scale_up_reference_scale():
-    test_runonce_scale_up_benchmark_scenario()

@@ -1,0 +1,171 @@
+"""Cloud-provider parity: external gRPC provider and the kwok/kubemark analog.
+
+Reference counterparts: cloudprovider/externalgrpc (out-of-process provider
+over gRPC), cloudprovider/kwok + the kubemark hollow-node harness
+(proposals/scalability_tests.md), and deleteCreatedNodesWithErrors
+(static_autoscaler.go:1081).
+"""
+
+import os
+
+import pytest
+
+from kubernetes_autoscaler_tpu.cloudprovider.external_grpc import (
+    ExternalGrpcProvider,
+    serve_cloud_provider,
+)
+from kubernetes_autoscaler_tpu.cloudprovider.kwok import KwokCluster
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+FULL = os.environ.get("KA_TPU_BENCH_FULL") == "1"
+
+
+def make_options(**kw):
+    base = dict(
+        node_shape_bucket=16, group_shape_bucket=16, max_new_nodes_static=32,
+        max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    base.update(kw)
+    return AutoscalingOptions(**base)
+
+
+@pytest.fixture
+def grpc_world():
+    """A FakeCluster whose provider is reached over a real gRPC hop."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    server, port = serve_cloud_provider(fake.provider)
+    server.start()
+    try:
+        yield fake, ExternalGrpcProvider(port)
+    finally:
+        server.stop(None)
+
+
+def test_external_grpc_surface(grpc_world):
+    fake, ext = grpc_world
+    groups = ext.node_groups()
+    assert [g.id() for g in groups] == ["ng1"]
+    g = groups[0]
+    assert (g.min_size(), g.max_size(), g.target_size()) == (0, 10, 0)
+    tmpl = g.template_node_info()
+    assert tmpl.capacity["cpu"] == 4.0
+    g.increase_size(2)
+    assert g.target_size() == 2          # cache invalidated by the mutation
+    assert len(fake.nodes) == 2          # materialized server-side
+    nd = list(fake.nodes.values())[0]
+    back = ext.node_group_for_node(nd)
+    assert back is not None and back.id() == "ng1"
+    g.delete_nodes([nd])
+    assert g.target_size() == 1
+
+
+def test_external_grpc_full_runonce(grpc_world):
+    """A whole RunOnce with every cloud call crossing the gRPC boundary."""
+    fake, ext = grpc_world
+    for i in range(4):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1500, mem_mib=512,
+                                    owner_name="rs"))
+    a = StaticAutoscaler(ext, fake, options=make_options(), eviction_sink=fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    assert status.scale_up.increases == {"ng1": 2}
+    assert len(fake.nodes) == 2
+
+
+def test_kwok_boot_delay_counts_upcoming():
+    """Instances in flight register late; the registry must report them as
+    upcoming so the next loop doesn't double-scale."""
+    kwok = KwokCluster(boot_delay_s=30.0)
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    kwok.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    for i in range(4):
+        kwok.add_pod(build_test_pod(f"p{i}", cpu_milli=1500, mem_mib=512,
+                                    owner_name="rs"))
+    a = StaticAutoscaler(kwok.provider, kwok, options=make_options(),
+                         eviction_sink=kwok)
+    kwok.advance_to(1000.0)
+    st1 = a.run_once(now=1000.0)
+    assert st1.scale_up.increases == {"ng1": 2}
+    assert len(kwok.nodes) == 0                       # still booting
+    assert a.cluster_state.upcoming_nodes() == {"ng1": 2}
+    # second loop before boot completes: no double scale-up
+    kwok.advance_to(1010.0)
+    st2 = a.run_once(now=1010.0)
+    assert st2.scale_up is None or not st2.scale_up.scaled_up
+    # boot completes; pods land
+    kwok.advance_to(1035.0)
+    assert len(kwok.nodes) == 2
+    st3 = a.run_once(now=1035.0)
+    assert st3.pending_pods == 0
+
+
+def test_kwok_failed_boot_reaped_and_backed_off():
+    kwok = KwokCluster(boot_delay_s=5.0)
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    kwok.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    kwok.fail_next("ng1", 2)
+    for i in range(4):
+        kwok.add_pod(build_test_pod(f"p{i}", cpu_milli=1500, mem_mib=512,
+                                    owner_name="rs"))
+    a = StaticAutoscaler(kwok.provider, kwok, options=make_options(),
+                         eviction_sink=kwok)
+    kwok.advance_to(1000.0)
+    st1 = a.run_once(now=1000.0)
+    assert st1.scale_up.increases == {"ng1": 2}
+    g = kwok.provider.node_groups()[0]
+    assert g.target_size() == 2
+    # next loop: errored instances reaped (target back to 0), group backed off
+    kwok.advance_to(1010.0)
+    a.run_once(now=1010.0)
+    assert g.target_size() == 0
+    assert not any(i.error_class for i in g.nodes())
+    assert a.cluster_state.backoff.is_backed_off("ng1", 1011.0)
+    # once backoff expires, scale-up is attempted again
+    later = 1010.0 + a.options.initial_node_group_backoff_s + 1.0
+    kwok.advance_to(later)
+    st3 = a.run_once(now=later)
+    assert st3.scale_up is not None and st3.scale_up.scaled_up
+
+
+def test_kubemark_scale_claim():
+    """The GA scale claim (FAQ.md:148): 1000 nodes x 30 pods/node RunOnce.
+
+    Default run uses 100 nodes to keep CPU CI fast; KA_TPU_BENCH_FULL=1 runs
+    the full 1000."""
+    n_nodes = 1000 if FULL else 100
+    kwok = KwokCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=65536, pods=110)
+    g = kwok.add_node_group("ng1", tmpl, min_size=0, max_size=2 * n_nodes)
+    g.increase_size(n_nodes)
+    kwok.advance_to(0.0)
+    assert len(kwok.nodes) == n_nodes
+    kwok.saturate(pods_per_node=30, cpu_milli=250)   # 7500m of 8000m used
+    assert len(kwok.pods) == 30 * n_nodes
+    # add pending load requiring ~5% more nodes
+    extra = max(n_nodes // 20, 1) * 8
+    for i in range(extra):
+        kwok.add_pod(build_test_pod(f"pend{i}", cpu_milli=900, mem_mib=512,
+                                    owner_name="pend-rs"))
+    a = StaticAutoscaler(
+        kwok.provider, kwok,
+        options=make_options(node_shape_bucket=256,
+                             max_new_nodes_static=max(n_nodes // 8, 32),
+                             max_pods_per_node=64),
+        eviction_sink=kwok)
+    status = a.run_once(now=100.0)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    added = sum(status.scale_up.increases.values())
+    # 8 pending pods of 900m fit a fresh 8-CPU node -> extra/8 new nodes
+    assert added == extra // 8
+    kwok.advance_to(100.0)   # zero boot delay: instances register on tick
+    assert len(kwok.nodes) == n_nodes + added
