@@ -135,7 +135,7 @@ class _ProviderService:
 
     def NodeGroupNodes(self, req: dict) -> dict:
         return {"instances": [
-            {"id": i.id, "status": i.status, "errorInfo": i.error_info}
+            {"name": i.name, "state": i.state, "errorClass": i.error_class}
             for i in self._group(req["id"]).nodes()
         ]}
 
@@ -252,8 +252,8 @@ class ExternalNodeGroup(NodeGroup):
 
     def nodes(self) -> list[InstanceStatus]:
         return [
-            InstanceStatus(id=i["id"], status=i["status"],
-                           error_info=i.get("errorInfo", ""))
+            InstanceStatus(name=i["name"], state=i.get("state", ""),
+                           error_class=i.get("errorClass", ""))
             for i in self._client.call("NodeGroupNodes", {"id": self._id})["instances"]
         ]
 
@@ -277,6 +277,7 @@ class ExternalGrpcProvider(CloudProvider):
 
     def __init__(self, port: int):
         self._client = _Client(port)
+        self._by_id: dict[str, ExternalNodeGroup] = {}
         self._groups: list[ExternalNodeGroup] | None = None
 
     def name(self) -> str:
@@ -284,10 +285,21 @@ class ExternalGrpcProvider(CloudProvider):
 
     def node_groups(self) -> list[NodeGroup]:
         if self._groups is None:
-            self._groups = [
-                ExternalNodeGroup(self._client, g["id"], g["minSize"], g["maxSize"])
-                for g in self._client.call("NodeGroups", {})["nodeGroups"]
-            ]
+            out = []
+            for g in self._client.call("NodeGroups", {})["nodeGroups"]:
+                # reuse group objects across refreshes so callers holding a
+                # reference observe invalidated (fresh) caches, not stale ones
+                existing = self._by_id.get(g["id"])
+                if existing is not None:
+                    existing._min = g["minSize"]
+                    existing._max = g["maxSize"]
+                    out.append(existing)
+                else:
+                    ng = ExternalNodeGroup(self._client, g["id"],
+                                           g["minSize"], g["maxSize"])
+                    self._by_id[g["id"]] = ng
+                    out.append(ng)
+            self._groups = out
         return list(self._groups)
 
     def node_group_for_node(self, node: Node) -> NodeGroup | None:
@@ -298,7 +310,9 @@ class ExternalGrpcProvider(CloudProvider):
         for existing in self.node_groups():
             if existing.id() == g["id"]:
                 return existing
-        return ExternalNodeGroup(self._client, g["id"], g["minSize"], g["maxSize"])
+        ng = ExternalNodeGroup(self._client, g["id"], g["minSize"], g["maxSize"])
+        self._by_id[g["id"]] = ng
+        return ng
 
     def gpu_label(self) -> str:
         return self._client.call("GPULabel", {})["label"]
@@ -308,6 +322,8 @@ class ExternalGrpcProvider(CloudProvider):
 
     def refresh(self) -> None:
         self._client.call("Refresh", {})
+        for g in self._by_id.values():
+            g.invalidate()
         self._groups = None
 
     def cleanup(self) -> None:

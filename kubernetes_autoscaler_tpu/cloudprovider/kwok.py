@@ -82,6 +82,9 @@ class KwokCluster(FakeCluster):
                 continue
             g = next(x for x in self.provider.node_groups() if x.id() == h.group_id)
             if h.registered_at is None and now >= h.created_at + self.boot_delay_s:
+                # register at the LOGICAL boot time so a single large time
+                # jump can register and ready the node in one tick
+                h.registered_at = h.created_at + self.boot_delay_s
                 t = g.template_node_info()
                 nd = Node(
                     name=h.name,
@@ -89,12 +92,11 @@ class KwokCluster(FakeCluster):
                     capacity=dict(t.capacity),
                     allocatable=dict(t.allocatable),
                     taints=list(t.taints),
-                    ready=self.ready_delay_s <= 0.0,
+                    ready=now >= h.registered_at + self.ready_delay_s,
                 )
                 self.nodes[h.name] = nd
                 self.provider.add_node(h.group_id, nd)
                 g._instances = [i for i in g._instances if i.name != h.name]
-                h.registered_at = now
             elif (h.registered_at is not None
                   and now >= h.registered_at + self.ready_delay_s):
                 self.nodes[h.name].ready = True

@@ -102,6 +102,26 @@ class Planner:
             group_deletable[g.id()] -= 1
             eligible_idx.append(i)
 
+        # Candidate-pool policy (reference: processors/scaledowncandidates —
+        # previous candidates sorted first so their unneeded clocks keep
+        # running, then empty nodes so cheap deletions come first, pool
+        # capped at max(ratio x cluster, min) via
+        # --scale-down-candidates-pool-ratio, FAQ.md:1117).
+        if eligible_idx:
+            sched_valid = np.asarray(enc.scheduled.valid)
+            occupied = {
+                int(x) for x in np.asarray(enc.scheduled.node_idx)[sched_valid]
+            }
+            prev = self.unneeded_nodes.since
+            eligible_idx.sort(key=lambda i: (nodes[i].name not in prev,
+                                             i in occupied))
+            if self.options.scale_down_candidates_pool_ratio < 1.0:
+                pool = max(
+                    int(self.options.scale_down_candidates_pool_ratio * n_real),
+                    self.options.scale_down_candidates_pool_min_count,
+                )
+                eligible_idx = eligible_idx[:pool]
+
         if not eligible_idx:
             self.state.unneeded = []
             self.state.removal = None
@@ -194,6 +214,34 @@ class Planner:
         out: list[NodeToRemove] = []
 
         ordered = sorted(self.state.unneeded, key=lambda n: self.unneeded_nodes.since.get(n, now))
+
+        # Atomic-group pre-screen (reference: AtomicResizeFilteringProcessor):
+        # a ZeroOrMaxNodeScaling group drains all-or-nothing, so unless EVERY
+        # registered node of the group is an unneeded candidate, skip its
+        # nodes up front — before they consume budgets or destination
+        # capacity that plain candidates need.
+        unneeded_set = set(ordered)
+        atomic_blocked: set[str] = set()
+        atomic_groups: dict[str, str] = {}
+        for name in ordered:
+            i0 = name_to_i.get(name)
+            if i0 is None:
+                continue
+            g0 = self.provider.node_group_for_node(nodes[i0])
+            if g0 is None or not g0.get_options(defaults).zero_or_max_node_scaling:
+                continue
+            atomic_groups[name] = g0.id()
+            members = [nd.name for nd in nodes
+                       if (gg := self.provider.node_group_for_node(nd))
+                       and gg.id() == g0.id()]
+            if not all(m in unneeded_set for m in members):
+                atomic_blocked.add(g0.id())
+        for name in list(unneeded_set):
+            if atomic_groups.get(name) in atomic_blocked:
+                self._mark(name, "AtomicScaleDownFailed", now)
+        ordered = [n for n in ordered
+                   if atomic_groups.get(n) not in atomic_blocked]
+
         group_room: dict[str, int] = {}
         pdb_reserved: dict[int, int] = {}  # budget consumed by candidates confirmed THIS pass
         for name in ordered:
@@ -314,4 +362,31 @@ class Planner:
         for r in out:
             r.destinations = {s: final_dest[s] for s in r.pods_to_move
                               if s in final_dest}
+
+        # AtomicResizeFilteringProcessor (reference: ScaleDownSetProcessor
+        # honoring ZeroOrMaxNodeScaling): a zero-or-max group's nodes leave
+        # only when the WHOLE group drains in one round.
+        atomic_selected: dict[str, list[NodeToRemove]] = {}
+        group_of: dict[str, str] = {}
+        for r in out:
+            g = self.provider.node_group_for_node(r.node)
+            if g is not None and g.get_options(defaults).zero_or_max_node_scaling:
+                atomic_selected.setdefault(g.id(), []).append(r)
+                group_of[r.node.name] = g.id()
+        if atomic_selected:
+            registered: dict[str, int] = {}
+            for nd in nodes:
+                g = self.provider.node_group_for_node(nd)
+                if g is not None and g.id() in atomic_selected:
+                    registered[g.id()] = registered.get(g.id(), 0) + 1
+            dropped = {
+                gid for gid, rs in atomic_selected.items()
+                if len(rs) != registered.get(gid, 0)
+            }
+            if dropped:
+                for r in list(out):
+                    if group_of.get(r.node.name) in dropped:
+                        self._mark(r.node.name, "AtomicScaleDownFailed", now)
+                out = [r for r in out
+                       if group_of.get(r.node.name) not in dropped]
         return out

@@ -149,10 +149,18 @@ class ScaleUpOrchestrator:
             return ScaleUpResult(scaled_up=False, pods_remaining=pending_total,
                                  considered_options=options)
 
-        # similar-group balancing (reference: balanceScaleUps :652 via
-        # BalancingNodeGroupSetProcessor) — split the winning delta across
-        # groups similar to the winner.
-        plan = self._balance(best, groups, est)
+        # ZeroOrMaxNodeScaling winners scale all-or-nothing and are excluded
+        # from similar-group balancing — balancing several atomic groups
+        # would blow each to max (reference: atomic groups bypass the
+        # BalancingNodeGroupSetProcessor and use AtomicIncreaseSize).
+        winner = groups[best.group_index]
+        if self._ng_opts(winner).zero_or_max_node_scaling:
+            plan = {winner.id(): winner.max_size() - winner.target_size()}
+        else:
+            # similar-group balancing (reference: balanceScaleUps :652 via
+            # BalancingNodeGroupSetProcessor) — split the winning delta
+            # across groups similar to the winner.
+            plan = self._balance(best, groups, est)
 
         # quota caps (reference: applyLimits :205-217)
         plan = self._apply_quota(plan, groups, enc)
@@ -223,6 +231,8 @@ class ScaleUpOrchestrator:
         for i, g in enumerate(groups):
             if g.id() == target.id():
                 continue
+            if self._ng_opts(g).zero_or_max_node_scaling:
+                continue  # an atomic sibling cannot absorb a partial split
             t = g.template_node_info()
             if _similar_templates(tmpl, t) and g.target_size() < g.max_size():
                 similar.append(g)
@@ -243,6 +253,11 @@ class ScaleUpOrchestrator:
 
     # ---- quota caps ----
 
+    def _ng_opts(self, g: NodeGroup):
+        from kubernetes_autoscaler_tpu.clusterstate.registry import _ng_defaults
+
+        return g.get_options(_ng_defaults(self.options))
+
     def _apply_quota(self, plan: dict[str, int], groups: list[NodeGroup],
                      enc: EncodedCluster) -> dict[str, int]:
         capped = dict(plan)
@@ -253,7 +268,10 @@ class ScaleUpOrchestrator:
                 allowed = self.quota.max_nodes_addable(
                     status, g.template_node_info(), capped[gid]
                 )
-                if allowed <= 0:
+                if allowed < capped[gid] and self._ng_opts(g).zero_or_max_node_scaling:
+                    # an atomic group cannot partially scale: all or nothing
+                    del capped[gid]
+                elif allowed <= 0:
                     del capped[gid]
                 elif allowed < capped[gid]:
                     capped[gid] = allowed
@@ -272,7 +290,10 @@ class ScaleUpOrchestrator:
                 # winner is an auto-provisioning candidate: create first
                 # (reference: orchestrator CreateNodeGroup before IncreaseSize)
                 self.node_group_manager.create_node_group(g)
-            g.increase_size(delta)
+            if self._ng_opts(g).zero_or_max_node_scaling:
+                g.atomic_increase_size(delta)
+            else:
+                g.increase_size(delta)
             return gid, delta
 
         with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:

@@ -487,8 +487,10 @@ class StaticAutoscaler:
             ]
             if not errored:
                 continue
+            # back off FIRST — even if deletion fails (e.g. min-size guard),
+            # a group producing create-errors must stop winning scale-ups
+            self.cluster_state.register_failed_scale_up(g, now)
             try:
                 g.delete_nodes([Node(name=i.name) for i in errored])
             except Exception:
-                continue
-            self.cluster_state.register_failed_scale_up(g, now)
+                pass

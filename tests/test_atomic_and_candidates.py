@@ -1,0 +1,104 @@
+"""ZeroOrMaxNodeScaling (atomic groups) and scale-down candidate-pool policy.
+
+Reference counterparts: NodeGroupAutoscalingOptions.ZeroOrMaxNodeScaling
+consumed by the scale-up orchestrator (AtomicIncreaseSize) and by the
+AtomicResizeFilteringProcessor (ScaleDownSetProcessor default,
+processors.go); processors/scaledowncandidates sorting + pool-ratio caps
+(--scale-down-candidates-pool-ratio, FAQ.md:1117).
+"""
+
+from kubernetes_autoscaler_tpu.cloudprovider.provider import NodeGroupOptions
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def make_options(**kw):
+    base = dict(
+        node_shape_bucket=16, group_shape_bucket=16, max_new_nodes_static=32,
+        max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    base.update(kw)
+    return AutoscalingOptions(**base)
+
+
+def test_atomic_group_scales_all_or_nothing_up():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group(
+        "atomic", tmpl, min_size=0, max_size=6,
+        options=NodeGroupOptions(zero_or_max_node_scaling=True))
+    # demand worth 2 nodes -> the atomic group must still go to max (6)
+    for i in range(4):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1500, mem_mib=512,
+                                    owner_name="rs"))
+    a = StaticAutoscaler(fake.provider, fake, options=make_options(),
+                         eviction_sink=fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    assert status.scale_up.increases == {"atomic": 6}
+    assert len(fake.nodes) == 6
+
+
+def test_atomic_group_scale_down_all_or_nothing():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group(
+        "atomic", tmpl, min_size=0, max_size=4,
+        options=NodeGroupOptions(zero_or_max_node_scaling=True))
+    fake.add_node_group("plain", tmpl, min_size=0, max_size=4)
+    for i in range(3):
+        fake.add_existing_node(
+            "atomic", build_test_node(f"a{i}", cpu_milli=4000, mem_mib=8192))
+    fake.add_existing_node(
+        "plain", build_test_node("keeper", cpu_milli=4000, mem_mib=8192))
+    # pin one atomic node with an unmovable (naked) pod: the whole atomic
+    # group must then stay, even though a0/a1 are idle
+    fake.add_pod(build_test_pod("naked", cpu_milli=500, mem_mib=256,
+                                node_name="a2"))
+    a = StaticAutoscaler(fake.provider, fake, options=make_options(),
+                         eviction_sink=fake)
+    status = a.run_once(now=1000.0)
+    assert all(not n.startswith("a") for n in status.scale_down_deleted), (
+        f"partial atomic deletion: {status.scale_down_deleted}")
+
+    # unpin: whole group (all 3 nodes) may now leave in one round
+    fake.pods.clear()
+    status2 = a.run_once(now=2000.0)
+    assert sorted(n for n in status2.scale_down_deleted
+                  if n.startswith("a")) == ["a0", "a1", "a2"]
+
+
+def test_candidate_pool_ratio_caps_and_prefers_previous():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=32)
+    for i in range(10):
+        fake.add_existing_node(
+            "ng1", build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192))
+    opts = make_options(
+        scale_down_candidates_pool_ratio=0.2,       # pool = max(2, 3) = 3
+        scale_down_candidates_pool_min_count=3,
+        max_empty_bulk_delete=2, max_scale_down_parallelism=2,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=100.0, scale_down_unready_time_s=100.0),
+    )
+    a = StaticAutoscaler(fake.provider, fake, options=opts, eviction_sink=fake)
+    st1 = a.run_once(now=1000.0)
+    # pool caps the unneeded set at 3 of 10 idle nodes
+    assert len(st1.unneeded_nodes) == 3
+    first_pool = set(st1.unneeded_nodes)
+    assert st1.scale_down_deleted == []              # unneeded time not met
+    # next loop: the SAME nodes stay candidates (previous-first sorting), so
+    # their unneeded clocks accrue instead of resetting
+    st2 = a.run_once(now=1050.0)
+    assert set(st2.unneeded_nodes) == first_pool
+    st3 = a.run_once(now=1101.0)
+    assert set(st3.scale_down_deleted) <= first_pool
+    assert len(st3.scale_down_deleted) == 2          # deletion budgets apply

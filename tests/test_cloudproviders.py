@@ -80,6 +80,12 @@ def test_external_grpc_full_runonce(grpc_world):
     assert status.scale_up is not None and status.scale_up.scaled_up
     assert status.scale_up.increases == {"ng1": 2}
     assert len(fake.nodes) == 2
+    # instances now exist: nodes() must round-trip and a SECOND loop (which
+    # scans g.nodes() for create-errors) must not crash
+    insts = ext.node_groups()[0].nodes()
+    assert len(insts) == 2 and all(i.name for i in insts)
+    status2 = a.run_once(now=1010.0)
+    assert status2.ran and len(fake.nodes) == 2
 
 
 def test_kwok_boot_delay_counts_upcoming():
