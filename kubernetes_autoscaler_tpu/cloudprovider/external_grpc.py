@@ -310,8 +310,12 @@ class ExternalGrpcProvider(CloudProvider):
         for existing in self.node_groups():
             if existing.id() == g["id"]:
                 return existing
-        ng = ExternalNodeGroup(self._client, g["id"], g["minSize"], g["maxSize"])
-        self._by_id[g["id"]] = ng
+        # group absent from the listing (e.g. autoprovisioned): keep ONE
+        # proxy object per id so refresh() invalidation reaches every holder
+        ng = self._by_id.get(g["id"])
+        if ng is None:
+            ng = ExternalNodeGroup(self._client, g["id"], g["minSize"], g["maxSize"])
+            self._by_id[g["id"]] = ng
         return ng
 
     def gpu_label(self) -> str:

@@ -221,21 +221,35 @@ class Planner:
         # nodes up front — before they consume budgets or destination
         # capacity that plain candidates need.
         unneeded_set = set(ordered)
+        # one provider lookup per node (node_group_for_node may be an RPC)
+        node_gid: dict[str, str | None] = {}
+        gid_members: dict[str, list[str]] = {}
+        atomic_gids: set[str] = set()
+        seen_groups: dict[str, object] = {}
+        for nd in nodes:
+            g0 = self.provider.node_group_for_node(nd)
+            gid = g0.id() if g0 is not None else None
+            node_gid[nd.name] = gid
+            if gid is not None:
+                gid_members.setdefault(gid, []).append(nd.name)
+                if gid not in seen_groups:
+                    seen_groups[gid] = g0
+                    if g0.get_options(defaults).zero_or_max_node_scaling:
+                        atomic_gids.add(gid)
         atomic_blocked: set[str] = set()
-        atomic_groups: dict[str, str] = {}
-        for name in ordered:
-            i0 = name_to_i.get(name)
-            if i0 is None:
-                continue
-            g0 = self.provider.node_group_for_node(nodes[i0])
-            if g0 is None or not g0.get_options(defaults).zero_or_max_node_scaling:
-                continue
-            atomic_groups[name] = g0.id()
-            members = [nd.name for nd in nodes
-                       if (gg := self.provider.node_group_for_node(nd))
-                       and gg.id() == g0.id()]
-            if not all(m in unneeded_set for m in members):
-                atomic_blocked.add(g0.id())
+        # budgets cannot fit a partial atomic group either: if the whole
+        # group exceeds what this round may delete, skip it up front
+        # (reference: budgets.go CropNodes keeps/drops atomic groups whole)
+        budget_cap = min(self.options.max_scale_down_parallelism,
+                         self.options.max_empty_bulk_delete
+                         + self.options.max_drain_parallelism)
+        for gid in atomic_gids:
+            members = gid_members.get(gid, [])
+            if (not all(m in unneeded_set for m in members)
+                    or len(members) > budget_cap):
+                atomic_blocked.add(gid)
+        atomic_groups = {name: node_gid.get(name) for name in ordered
+                         if node_gid.get(name) in atomic_gids}
         for name in list(unneeded_set):
             if atomic_groups.get(name) in atomic_blocked:
                 self._mark(name, "AtomicScaleDownFailed", now)
@@ -365,23 +379,21 @@ class Planner:
 
         # AtomicResizeFilteringProcessor (reference: ScaleDownSetProcessor
         # honoring ZeroOrMaxNodeScaling): a zero-or-max group's nodes leave
-        # only when the WHOLE group drains in one round.
+        # only when the WHOLE group drains in one round. The pre-screen above
+        # handles the common cases; this backstop catches mid-confirmation
+        # failures (e.g. NoPlaceToMovePods for one member). Reuses the
+        # node->group map built by the pre-screen — no provider re-lookups.
         atomic_selected: dict[str, list[NodeToRemove]] = {}
         group_of: dict[str, str] = {}
         for r in out:
-            g = self.provider.node_group_for_node(r.node)
-            if g is not None and g.get_options(defaults).zero_or_max_node_scaling:
-                atomic_selected.setdefault(g.id(), []).append(r)
-                group_of[r.node.name] = g.id()
+            gid = node_gid.get(r.node.name)
+            if gid in atomic_gids:
+                atomic_selected.setdefault(gid, []).append(r)
+                group_of[r.node.name] = gid
         if atomic_selected:
-            registered: dict[str, int] = {}
-            for nd in nodes:
-                g = self.provider.node_group_for_node(nd)
-                if g is not None and g.id() in atomic_selected:
-                    registered[g.id()] = registered.get(g.id(), 0) + 1
             dropped = {
                 gid for gid, rs in atomic_selected.items()
-                if len(rs) != registered.get(gid, 0)
+                if len(rs) != len(gid_members.get(gid, []))
             }
             if dropped:
                 for r in list(out):

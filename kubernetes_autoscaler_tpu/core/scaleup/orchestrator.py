@@ -26,7 +26,10 @@ from kubernetes_autoscaler_tpu.cloudprovider.provider import (
     NodeGroup,
     NodeGroupError,
 )
-from kubernetes_autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
+from kubernetes_autoscaler_tpu.clusterstate.registry import (
+    ClusterStateRegistry,
+    _ng_defaults,
+)
 from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
 from kubernetes_autoscaler_tpu.estimator.estimator import (
     BinpackingEstimator,
@@ -254,8 +257,6 @@ class ScaleUpOrchestrator:
     # ---- quota caps ----
 
     def _ng_opts(self, g: NodeGroup):
-        from kubernetes_autoscaler_tpu.clusterstate.registry import _ng_defaults
-
         return g.get_options(_ng_defaults(self.options))
 
     def _apply_quota(self, plan: dict[str, int], groups: list[NodeGroup],

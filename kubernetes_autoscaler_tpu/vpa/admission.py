@@ -22,6 +22,35 @@ class PatchOp:
     value: float
 
 
+def validate_vpa(vpa: VerticalPodAutoscaler) -> list[str]:
+    """Validate a VPA object (reference: admission-controller also validates
+    VPA create/update — vpa_lint: sane min<=max policy bounds, known modes,
+    a target ref). Returns human-readable problems; empty means valid."""
+    problems: list[str] = []
+    if not vpa.target_name:
+        problems.append("spec.targetRef is required")
+    if vpa.min_replicas < 0:
+        problems.append("minReplicas must be >= 0")
+    for cp in vpa.resource_policies:
+        if cp.mode not in ("Auto", "Off"):
+            problems.append(
+                f"container {cp.container_name!r}: unknown mode {cp.mode!r}")
+        if cp.controlled_values not in ("RequestsOnly", "RequestsAndLimits"):
+            problems.append(
+                f"container {cp.container_name!r}: unknown controlledValues "
+                f"{cp.controlled_values!r}")
+        for res, lo in cp.min_allowed.items():
+            hi = cp.max_allowed.get(res)
+            if lo < 0:
+                problems.append(
+                    f"container {cp.container_name!r}: minAllowed[{res}] < 0")
+            if hi is not None and hi < lo:
+                problems.append(
+                    f"container {cp.container_name!r}: maxAllowed[{res}] < "
+                    f"minAllowed[{res}]")
+    return problems
+
+
 def patch_for_pod(
     namespace: str,
     owner_name: str,

@@ -110,13 +110,71 @@ class Recommender:
         if mem_rows:
             self.memory.add_samples(np.asarray(mem_rows), np.asarray(mem_vals))
 
+    def feed_history(self, samples: list[ContainerUsageSample],
+                     now: float) -> None:
+        """Batched historical ingestion: mathematically identical to feeding
+        each sample at its own timestamp (decay is exponential, so a sample
+        aged `now - t` simply carries weight x 2^(-(age)/half_life)), but the
+        whole history lands in ONE scatter-add per resource instead of a
+        device dispatch pair per sample."""
+        self.cpu.decay_to(now)
+        self.memory.decay_to(now)
+        cpu_rows, cpu_vals, cpu_w = [], [], []
+        mem_rows, mem_vals, mem_w = [], [], []
+        for s in samples:
+            key = AggregateKey(s.namespace, s.owner_name, s.container_name)
+            row = self._row(key)
+            kid = key.id()
+            t = s.timestamp or now
+            prev = self.first_sample_time.get(kid)
+            if prev is None or t < prev:
+                self.first_sample_time[kid] = t
+            self.sample_counts[kid] = self.sample_counts.get(kid, 0) + 1
+            age = max(now - t, 0.0)
+            if s.cpu_cores is not None:
+                cpu_rows.append(row)
+                cpu_vals.append(s.cpu_cores)
+                cpu_w.append(max(s.cpu_cores, 0.1)
+                             * 2.0 ** (-age / self.cpu.half_life_s))
+            if s.memory_bytes is not None:
+                mem_rows.append(row)
+                val = s.memory_bytes
+                if s.is_oom:
+                    val *= OOM_BUMP_RATIO
+                mem_vals.append(val)
+                mem_w.append(2.0 ** (-age / self.memory.half_life_s))
+        if cpu_rows:
+            self.cpu.add_samples(np.asarray(cpu_rows), np.asarray(cpu_vals),
+                                 np.asarray(cpu_w, np.float32))
+        if mem_rows:
+            self.memory.add_samples(np.asarray(mem_rows), np.asarray(mem_vals),
+                                    np.asarray(mem_w, np.float32))
+
     # ---- estimation (reference: logic/recommender.go RecommendedPodResources) ----
+
+    def _confidence_days(self, kid: tuple, now: float) -> float:
+        """History confidence in days (reference: logic/estimator.go
+        getConfidence — min of lifespan-days and samples-per-minute-days)."""
+        first = self.first_sample_time.get(kid, now)
+        life_days = max(now - first, 0.0) / 86400.0
+        sample_days = self.sample_counts.get(kid, 0) / (60.0 * 24.0)
+        return min(life_days, sample_days)
+
+    @staticmethod
+    def _confidence_scale(value: float, conf: float, multiplier: float,
+                          exponent: float) -> float:
+        """reference: confidenceMultiplier — value x (1 + m/conf)^e; with no
+        history the bounds blow wide open (upper) / collapse (lower)."""
+        if conf <= 0:
+            return value * (1e9 if exponent > 0 else 0.0)
+        return value * (1.0 + multiplier / conf) ** exponent
 
     def recommend(self, vpas: list[VerticalPodAutoscaler],
                   containers_by_target: dict[str, list[str]],
                   now: float | None = None) -> None:
         """Fill VPA.recommendation for every VPA; all percentiles computed in
         six device reductions total (3 quantiles × 2 resources)."""
+        now = time.time() if now is None else now
         cpu_p50 = self.cpu.percentile(LOWER_BOUND_PERCENTILE)
         cpu_p90 = self.cpu.percentile(TARGET_CPU_PERCENTILE)
         cpu_p95 = self.cpu.percentile(UPPER_BOUND_PERCENTILE)
@@ -156,11 +214,20 @@ class Recommender:
                     "cpu": float(cpu_p90[row]) * SAFETY_MARGIN,
                     "memory": float(mem_p90[row]) * SAFETY_MARGIN,
                 }
+                # Confidence scaling (reference: WithConfidenceMultiplier —
+                # lower bound x (1+0.001/conf)^-2, upper bound x (1+1/conf)^1):
+                # young aggregates get a wide [lower, upper] band so the
+                # updater doesn't churn pods on thin evidence.
+                conf = self._confidence_days(kid, now)
+                lo_cpu = self._confidence_scale(float(cpu_p50[row]), conf, 0.001, -2.0)
+                lo_mem = self._confidence_scale(float(mem_p50[row]), conf, 0.001, -2.0)
+                hi_cpu = self._confidence_scale(float(cpu_p95[row]), conf, 1.0, 1.0)
+                hi_mem = self._confidence_scale(float(mem_p95[row]), conf, 1.0, 1.0)
                 recs.append(RecommendedContainerResources(
                     container_name=container,
                     target=capped(float(cpu_p90[row]), float(mem_p90[row])),
-                    lower_bound=capped(float(cpu_p50[row]), float(mem_p50[row])),
-                    upper_bound=capped(float(cpu_p95[row]), float(mem_p95[row])),
+                    lower_bound=capped(lo_cpu, lo_mem),
+                    upper_bound=capped(hi_cpu, hi_mem),
                     uncapped_target=uncapped,
                 ))
             vpa.recommendation = recs

@@ -46,10 +46,16 @@ class Updater:
         evict: Callable[[PodView], None],
         in_place_resize: Callable[[PodView, dict], bool] | None = None,
         eviction_rate_limit_per_loop: int = 10,
+        can_evict: Callable[[PodView], bool] | None = None,
     ):
         self.evict = evict
         self.in_place_resize = in_place_resize
         self.eviction_rate_limit = eviction_rate_limit_per_loop
+        # PDB gate (reference: eviction/pods_eviction_restriction.go — the
+        # updater consults PodDisruptionBudgets before every eviction); the
+        # callback owns the budget bookkeeping so repeated evictions of one
+        # controller's pods draw down the same allowance.
+        self.can_evict = can_evict
 
     def run_once(
         self,
@@ -89,6 +95,8 @@ class Updater:
                 if self.in_place_resize(d.pod, targets):
                     acted.append(d)
                     continue  # no eviction needed
+            if self.can_evict is not None and not self.can_evict(d.pod):
+                continue  # PDB exhausted for this pod's controller
             self.evict(d.pod)
             acted.append(d)
             budget -= 1

@@ -102,3 +102,33 @@ def test_candidate_pool_ratio_caps_and_prefers_previous():
     st3 = a.run_once(now=1101.0)
     assert set(st3.scale_down_deleted) <= first_pool
     assert len(st3.scale_down_deleted) == 2          # deletion budgets apply
+
+
+def test_atomic_group_exceeding_budget_does_not_starve_plain():
+    """An atomic group bigger than the deletion budgets must be skipped up
+    front — not consume the budgets and then be dropped, starving plain
+    candidates forever (reference: budgets.go CropNodes treats atomic
+    groups as a unit)."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group(
+        "atomic", tmpl, min_size=0, max_size=8,
+        options=NodeGroupOptions(zero_or_max_node_scaling=True))
+    fake.add_node_group("plain", tmpl, min_size=0, max_size=8)
+    for i in range(4):
+        fake.add_existing_node(
+            "atomic", build_test_node(f"a{i}", cpu_milli=4000, mem_mib=8192))
+    fake.add_existing_node(
+        "plain", build_test_node("idle", cpu_milli=4000, mem_mib=8192))
+    fake.add_existing_node(
+        "plain", build_test_node("busy", cpu_milli=4000, mem_mib=8192))
+    fake.add_pod(build_test_pod("b", cpu_milli=3000, mem_mib=512,
+                                owner_name="rs", node_name="busy"))
+    opts = make_options(max_scale_down_parallelism=2,
+                        max_empty_bulk_delete=2, max_drain_parallelism=2)
+    a = StaticAutoscaler(fake.provider, fake, options=opts, eviction_sink=fake)
+    status = a.run_once(now=1000.0)
+    # atomic group (4 nodes) exceeds the budget of 2 -> whole group skipped;
+    # the plain idle node must still be deleted
+    assert "idle" in status.scale_down_deleted
+    assert all(not n.startswith("a") for n in status.scale_down_deleted)

@@ -160,3 +160,110 @@ def test_checkpoint_roundtrip(tmp_path):
     assert abs(r.cpu.percentile(0.5)[0] - r2.cpu.percentile(0.5)[0]) < 1e-6
     assert r._index == r2._index
     assert load_checkpoint(os.path.join(tmp_path, "missing.npz")) is None
+
+
+def test_confidence_widens_band_for_young_aggregates():
+    """reference: WithConfidenceMultiplier — thin history must produce a wide
+    [lower, upper] band so the updater doesn't churn on day one."""
+    young, old = Recommender(), Recommender()
+    for rec, n in ((young, 10), (old, 3 * 24 * 60)):   # 10 min vs 3 days
+        samples = [ContainerUsageSample(
+            namespace="default", pod_name="p0", container_name="app",
+            owner_name="web", cpu_cores=0.5, memory_bytes=400e6,
+            timestamp=60.0 * (i + 1)) for i in range(n)]   # 0.0 means unset
+        rec.feed(samples, now=60.0 * (n + 1))
+    v_young = VerticalPodAutoscaler(name="v", target_name="web")
+    v_old = VerticalPodAutoscaler(name="v", target_name="web")
+    young.recommend([v_young], {"web": ["app"]}, now=600.0)
+    old.recommend([v_old], {"web": ["app"]}, now=3 * 24 * 3600.0)
+    ry, ro = v_young.recommendation[0], v_old.recommendation[0]
+    band = lambda r: r.upper_bound["cpu"] - r.lower_bound["cpu"]
+    assert band(ry) > band(ro)
+    assert ry.upper_bound["cpu"] > ro.upper_bound["cpu"] * 2
+
+
+def test_updater_pdb_gate():
+    from kubernetes_autoscaler_tpu.vpa.model import RecommendedContainerResources
+
+    evicted = []
+    budget = {"web": 1}   # one disruption allowed for the controller
+
+    def can_evict(pod):
+        if budget.get(pod.owner_name, 0) <= 0:
+            return False
+        budget[pod.owner_name] -= 1
+        return True
+
+    u = Updater(evict=lambda p: evicted.append(p.name), can_evict=can_evict)
+    vpa = VerticalPodAutoscaler(name="v", target_name="web", min_replicas=1)
+    vpa.recommendation = [RecommendedContainerResources(
+        container_name="app", target={"cpu": 1.0},
+        lower_bound={"cpu": 0.8}, upper_bound={"cpu": 1.2})]
+    pods = [PodView(name=f"p{i}", namespace="default", owner_name="web",
+                    containers={"app": {"cpu": 0.1}}, replicas_of_owner=3)
+            for i in range(3)]
+    acted = u.run_once([vpa], pods, now=1e6)
+    assert len(evicted) == 1          # PDB allowed exactly one disruption
+    assert len(acted) == 1
+
+
+def test_prometheus_history_provider_warms_recommender():
+    from kubernetes_autoscaler_tpu.vpa.history import PrometheusHistoryProvider
+
+    def query_fn(query, start, end):
+        metric = {"namespace": "default", "pod": "web-abc12", "container": "app"}
+        if "cpu" in query:
+            return [{"metric": metric,
+                     "values": [[start + 60.0 * i, "0.4"] for i in range(120)]}]
+        return [{"metric": metric,
+                 "values": [[start + 60.0 * i, "5e8"] for i in range(120)]}]
+
+    r = Recommender()
+    prov = PrometheusHistoryProvider(
+        query_fn=query_fn, pod_owner=lambda ns, pod: "web")
+    n = prov.load_into(r, now=1_000_000.0)
+    assert n == 240
+    vpa = VerticalPodAutoscaler(name="v", target_name="web")
+    r.recommend([vpa], {"web": ["app"]}, now=1_000_000.0)
+    rec = vpa.recommendation[0]
+    assert 0.4 <= rec.target["cpu"] <= 0.6          # 0.4 x 1.15 margin
+    assert rec.target["memory"] >= 5e8
+
+
+def test_validate_vpa():
+    from kubernetes_autoscaler_tpu.vpa.admission import validate_vpa
+    from kubernetes_autoscaler_tpu.vpa.model import ContainerResourcePolicy
+
+    ok = VerticalPodAutoscaler(name="v", target_name="web")
+    assert validate_vpa(ok) == []
+    bad = VerticalPodAutoscaler(
+        name="v", target_name="",
+        resource_policies=[ContainerResourcePolicy(
+            container_name="app", mode="Sometimes",
+            min_allowed={"cpu": 2.0}, max_allowed={"cpu": 1.0})])
+    problems = validate_vpa(bad)
+    assert any("targetRef" in p for p in problems)
+    assert any("unknown mode" in p for p in problems)
+    assert any("maxAllowed" in p for p in problems)
+
+
+def test_history_batch_ingestion_matches_sequential():
+    """feed_history's age-weighted single batch must equal feeding each
+    sample chronologically (the decay is exponential, so pre-scaling by
+    2^(-age/half_life) is exact)."""
+    seq, bat = Recommender(), Recommender()
+    samples = [ContainerUsageSample(
+        namespace="default", pod_name="p", container_name="app",
+        owner_name="web", cpu_cores=0.2 + 0.05 * (i % 7),
+        memory_bytes=3e8 + 1e7 * (i % 11), timestamp=3600.0 * (i + 1))
+        for i in range(48)]
+    now = 3600.0 * 50
+    for s in samples:
+        seq.feed([s], now=s.timestamp)
+    seq.cpu.decay_to(now)
+    seq.memory.decay_to(now)
+    bat.feed_history(samples, now=now)
+    np.testing.assert_allclose(np.asarray(seq.cpu.weights[:1]),
+                               np.asarray(bat.cpu.weights[:1]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(seq.memory.weights[:1]),
+                               np.asarray(bat.memory.weights[:1]), rtol=1e-5)
