@@ -316,6 +316,9 @@ class ExternalGrpcProvider(CloudProvider):
         if ng is None:
             ng = ExternalNodeGroup(self._client, g["id"], g["minSize"], g["maxSize"])
             self._by_id[g["id"]] = ng
+        else:
+            ng._min = g["minSize"]   # sizes come fresh from the server
+            ng._max = g["maxSize"]
         return ng
 
     def gpu_label(self) -> str:

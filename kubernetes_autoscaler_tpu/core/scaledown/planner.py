@@ -200,19 +200,6 @@ class Planner:
         node_valid = (np.asarray(enc.nodes.valid)
                       & np.asarray(enc.nodes.ready)
                       & np.asarray(enc.nodes.schedulable))
-        deleted_mask = np.zeros((enc.nodes.n,), dtype=bool)
-        received_slots: dict[int, list[int]] = {}   # node idx -> extra pod slots
-        moved_marks: set[tuple[int, int]] = set()   # (group_ref, node) one-per-node
-        final_dest: dict[int, int] = {}             # pod slot -> latest destination
-        quota_status = None
-        if self.quota is not None:
-            quota_status = self.quota.status_from_encoded(enc)
-
-        empty_budget = self.options.max_empty_bulk_delete
-        drain_budget = self.options.max_drain_parallelism
-        total_budget = self.options.max_scale_down_parallelism
-        out: list[NodeToRemove] = []
-
         ordered = sorted(self.state.unneeded, key=lambda n: self.unneeded_nodes.since.get(n, now))
 
         # Atomic-group pre-screen (reference: AtomicResizeFilteringProcessor):
@@ -256,119 +243,171 @@ class Planner:
         ordered = [n for n in ordered
                    if atomic_groups.get(n) not in atomic_blocked]
 
-        group_room: dict[str, int] = {}
-        pdb_reserved: dict[int, int] = {}  # budget consumed by candidates confirmed THIS pass
-        for name in ordered:
-            if len(out) >= total_budget:
-                break
-            i = name_to_i.get(name)
-            if i is None or i not in by_index:
-                continue
-            k = by_index[i]
-            if not drainable[k]:
-                continue
-            nd = nodes[i]
-            g = self.provider.node_group_for_node(nd)
-            if g is None:
-                continue
-            opts = g.get_options(defaults)
-            unneeded_time = (
-                (opts.scale_down_unneeded_time_s if nd.ready
-                 else opts.scale_down_unready_time_s)
-                or (defaults.scale_down_unneeded_time_s if nd.ready
-                    else defaults.scale_down_unready_time_s)
-            )
-            if not self.unneeded_nodes.removable_at(name, now, unneeded_time):
-                continue
-            room = group_room.setdefault(g.id(), g.target_size() - g.min_size())
-            if room <= 0:
-                self._mark(name, "NodeGroupMinSizeReached", now)
-                continue
-            if quota_status is not None and not self.quota.nodes_removable(
-                quota_status, nd
-            ):
-                self._mark(name, "MinimalResourceLimitExceeded", now)
-                continue
+        # The confirmation pass runs as ATTEMPTS: if an atomic group fails
+        # mid-pass (one member can't place its pods), everything it consumed
+        # — budgets, destination capacity, PDB reservations — is poisoned,
+        # so the whole pass re-runs from scratch with that group excluded.
+        # Bounded by the number of atomic groups; the common case is one
+        # attempt. This is the unit semantics of the reference's
+        # budgets.go CropNodes + AtomicResizeFilteringProcessor.
+        excluded_gids: set[str] = set()
 
-            orig_slots = [
-                int(pod_slot[k, s]) for s in range(pod_slot.shape[1])
-                if int(pod_slot[k, s]) >= 0 and movable_f[int(pod_slot[k, s])]
-            ]
-            victim_slots = orig_slots + received_slots.get(i, [])
-            is_empty = not victim_slots
-            if is_empty:
-                if empty_budget <= 0:
-                    continue
-            else:
-                if drain_budget <= 0:
-                    continue
-
-            # PDB gate (reference: planner consults the shared
-            # RemainingPdbTracker before confirming a drain; the actuator
-            # deducts at eviction time). Only pods physically on the node are
-            # evicted — received slots were accounted when their own node was
-            # confirmed. Need is accumulated across the candidates confirmed
-            # in THIS pass so two drains can't jointly overdraw one budget.
-            pdb_need: dict[int, int] = {}
-            if orig_slots and self.pdb_tracker is not None:
-                victims = [enc.scheduled_pods[s] for s in orig_slots]
-                if not self.pdb_tracker.can_remove_pods(victims, pdb_reserved):
-                    self._mark(name, "NotEnoughPdb", now)
-                    continue
-                pdb_need = self.pdb_tracker.reservation(victims)
-
-            # Re-place every victim (original + received) sequentially:
-            # first feasible node in index order — the device packer's
-            # tie-break — over live free capacity and this round's state.
-            moves: dict[int, int] = {}
-            local_marks: set[tuple[int, int]] = set()
-            ok = True
-            for slot in victim_slots:
-                g_ref = int(group_ref[slot])
-                req = reqs[slot]
-                fits = feas[g_ref] & node_valid & ~deleted_mask
-                fits &= (free >= req[None, :]).all(axis=1)
-                fits[i] = False
-                if limit_g[g_ref]:
-                    for (gm, dm) in moved_marks | local_marks:
-                        if gm == g_ref:
-                            fits[dm] = False
-                d = int(np.argmax(fits))
-                if not fits[d]:
-                    ok = False
+        def attempt(names: list[str]) -> tuple[list[NodeToRemove], dict[int, int], set[str]]:
+            free = (np.asarray(enc.nodes.cap)
+                    - np.asarray(enc.nodes.alloc)).astype(np.int64)
+            deleted_mask = np.zeros((enc.nodes.n,), dtype=bool)
+            received_slots: dict[int, list[int]] = {}
+            moved_marks: set[tuple[int, int]] = set()
+            final_dest: dict[int, int] = {}
+            quota_status = None
+            if self.quota is not None:
+                quota_status = self.quota.status_from_encoded(enc)
+            empty_budget = self.options.max_empty_bulk_delete
+            drain_budget = self.options.max_drain_parallelism
+            total_budget = self.options.max_scale_down_parallelism
+            out: list[NodeToRemove] = []
+            group_room: dict[str, int] = {}
+            pdb_reserved: dict[int, int] = {}
+            for name in names:
+                if len(out) >= total_budget:
                     break
-                free[d] -= req
-                moves[slot] = d
-                if limit_g[g_ref]:
-                    local_marks.add((g_ref, d))
-            if not ok:
-                # revert charges; try again next loop (destinations taken by an
-                # earlier candidate this round)
-                for slot, d in moves.items():
-                    free[d] += reqs[slot]
-                self._mark(name, "NoPlaceToMovePods", now)
-                continue
+                i = name_to_i.get(name)
+                if i is None or i not in by_index:
+                    continue
+                k = by_index[i]
+                if not drainable[k]:
+                    continue
+                nd = nodes[i]
+                g = seen_groups.get(node_gid.get(name))
+                if g is None:
+                    continue
+                opts = g.get_options(defaults)
+                unneeded_time = (
+                    (opts.scale_down_unneeded_time_s if nd.ready
+                     else opts.scale_down_unready_time_s)
+                    or (defaults.scale_down_unneeded_time_s if nd.ready
+                        else defaults.scale_down_unready_time_s)
+                )
+                if not self.unneeded_nodes.removable_at(name, now, unneeded_time):
+                    continue
+                room = group_room.setdefault(g.id(), g.target_size() - g.min_size())
+                if room <= 0:
+                    self._mark(name, "NodeGroupMinSizeReached", now)
+                    continue
+                if quota_status is not None and not self.quota.nodes_removable(
+                    quota_status, nd
+                ):
+                    self._mark(name, "MinimalResourceLimitExceeded", now)
+                    continue
 
-            # FINAL acceptance: only now deduct from the quota running totals
-            # so skipped candidates never consume headroom (reference: the
-            # min-quota tracker deducts per confirmed removal)
-            if quota_status is not None:
-                self.quota.deduct(quota_status, nd)
-            for i_pdb, n_pdb in pdb_need.items():
-                pdb_reserved[i_pdb] = pdb_reserved.get(i_pdb, 0) + n_pdb
-            group_room[g.id()] -= 1
-            if is_empty:
-                empty_budget -= 1
-            else:
-                drain_budget -= 1
-            deleted_mask[i] = True
-            for slot, d in moves.items():
-                received_slots.setdefault(d, []).append(slot)
-                final_dest[slot] = d
-            moved_marks |= local_marks
-            # The actuator evicts only pods physically on the node; received
-            # slots were capacity bookkeeping for this round's working state.
-            out.append(NodeToRemove(nd, bool(is_empty), pods_to_move=orig_slots))
+                orig_slots = [
+                    int(pod_slot[k, s]) for s in range(pod_slot.shape[1])
+                    if int(pod_slot[k, s]) >= 0 and movable_f[int(pod_slot[k, s])]
+                ]
+                victim_slots = orig_slots + received_slots.get(i, [])
+                is_empty = not victim_slots
+                if is_empty:
+                    if empty_budget <= 0:
+                        continue
+                else:
+                    if drain_budget <= 0:
+                        continue
+
+                # PDB gate (reference: planner consults the shared
+                # RemainingPdbTracker before confirming a drain; the actuator
+                # deducts at eviction time). Only pods physically on the node
+                # are evicted — received slots were accounted when their own
+                # node was confirmed. Need is accumulated across the
+                # candidates confirmed in THIS pass so two drains can't
+                # jointly overdraw one budget.
+                pdb_need: dict[int, int] = {}
+                if orig_slots and self.pdb_tracker is not None:
+                    victims = [enc.scheduled_pods[s] for s in orig_slots]
+                    if not self.pdb_tracker.can_remove_pods(victims, pdb_reserved):
+                        self._mark(name, "NotEnoughPdb", now)
+                        continue
+                    pdb_need = self.pdb_tracker.reservation(victims)
+
+                # Re-place every victim (original + received) sequentially:
+                # first feasible node in index order — the device packer's
+                # tie-break — over live free capacity and this round's state.
+                moves: dict[int, int] = {}
+                local_marks: set[tuple[int, int]] = set()
+                ok = True
+                for slot in victim_slots:
+                    g_ref = int(group_ref[slot])
+                    req = reqs[slot]
+                    fits = feas[g_ref] & node_valid & ~deleted_mask
+                    fits &= (free >= req[None, :]).all(axis=1)
+                    fits[i] = False
+                    if limit_g[g_ref]:
+                        for (gm, dm) in moved_marks | local_marks:
+                            if gm == g_ref:
+                                fits[dm] = False
+                    d = int(np.argmax(fits))
+                    if not fits[d]:
+                        ok = False
+                        break
+                    free[d] -= req
+                    moves[slot] = d
+                    if limit_g[g_ref]:
+                        local_marks.add((g_ref, d))
+                if not ok:
+                    # revert charges; try again next loop (destinations taken
+                    # by an earlier candidate this round)
+                    for slot, d in moves.items():
+                        free[d] += reqs[slot]
+                    self._mark(name, "NoPlaceToMovePods", now)
+                    continue
+
+                # FINAL acceptance: only now deduct from the quota running
+                # totals so skipped candidates never consume headroom
+                # (reference: min-quota tracker deducts per confirmed removal)
+                if quota_status is not None:
+                    self.quota.deduct(quota_status, nd)
+                for i_pdb, n_pdb in pdb_need.items():
+                    pdb_reserved[i_pdb] = pdb_reserved.get(i_pdb, 0) + n_pdb
+                group_room[g.id()] -= 1
+                if is_empty:
+                    empty_budget -= 1
+                else:
+                    drain_budget -= 1
+                deleted_mask[i] = True
+                for slot, d in moves.items():
+                    received_slots.setdefault(d, []).append(slot)
+                    final_dest[slot] = d
+                moved_marks |= local_marks
+                # The actuator evicts only pods physically on the node;
+                # received slots were capacity bookkeeping for the pass.
+                out.append(NodeToRemove(nd, bool(is_empty),
+                                        pods_to_move=orig_slots))
+
+            # backstop: an atomic group that only PARTIALLY confirmed (a
+            # member failed mid-pass) must not ship partial deletions
+            dropped: set[str] = set()
+            selected_per_gid: dict[str, int] = {}
+            for r in out:
+                gid = node_gid.get(r.node.name)
+                if gid in atomic_gids:
+                    selected_per_gid[gid] = selected_per_gid.get(gid, 0) + 1
+            for gid, n_sel in selected_per_gid.items():
+                if n_sel != len(gid_members.get(gid, [])):
+                    dropped.add(gid)
+            return out, final_dest, dropped
+
+        while True:
+            names = [n for n in ordered
+                     if node_gid.get(n) not in excluded_gids]
+            out, final_dest, dropped = attempt(names)
+            if not dropped:
+                break
+            # the failed group's budget/capacity consumption poisoned the
+            # pass — exclude it and redo from scratch (fresh budgets), so
+            # plain candidates behind it are not starved
+            excluded_gids |= dropped
+            for name in ordered:
+                if node_gid.get(name) in dropped:
+                    self._mark(name, "AtomicScaleDownFailed", now)
 
         # A destination chosen early can itself be confirmed for deletion
         # later in the pass (its received pods were then re-placed); report
@@ -376,29 +415,4 @@ class Planner:
         for r in out:
             r.destinations = {s: final_dest[s] for s in r.pods_to_move
                               if s in final_dest}
-
-        # AtomicResizeFilteringProcessor (reference: ScaleDownSetProcessor
-        # honoring ZeroOrMaxNodeScaling): a zero-or-max group's nodes leave
-        # only when the WHOLE group drains in one round. The pre-screen above
-        # handles the common cases; this backstop catches mid-confirmation
-        # failures (e.g. NoPlaceToMovePods for one member). Reuses the
-        # node->group map built by the pre-screen — no provider re-lookups.
-        atomic_selected: dict[str, list[NodeToRemove]] = {}
-        group_of: dict[str, str] = {}
-        for r in out:
-            gid = node_gid.get(r.node.name)
-            if gid in atomic_gids:
-                atomic_selected.setdefault(gid, []).append(r)
-                group_of[r.node.name] = gid
-        if atomic_selected:
-            dropped = {
-                gid for gid, rs in atomic_selected.items()
-                if len(rs) != len(gid_members.get(gid, []))
-            }
-            if dropped:
-                for r in list(out):
-                    if group_of.get(r.node.name) in dropped:
-                        self._mark(r.node.name, "AtomicScaleDownFailed", now)
-                out = [r for r in out
-                       if group_of.get(r.node.name) not in dropped]
         return out

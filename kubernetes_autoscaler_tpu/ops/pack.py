@@ -77,6 +77,70 @@ def pack_groups(
     return PackResult(free_after=free_after, placed=placed, scheduled=placed.sum(axis=-1))
 
 
+def pack_groups_sharded(
+    mesh,
+    free: jnp.ndarray,       # i32[N, R]  N divisible by the nodes-axis size
+    mask: jnp.ndarray,       # bool[G, N]
+    req: jnp.ndarray,        # i32[G, R]
+    count: jnp.ndarray,      # i32[G]
+    order: jnp.ndarray,      # i32[G]
+    limit_one: jnp.ndarray,  # bool[G]
+) -> PackResult:
+    """First-fit pack with the NODES axis sharded over the device mesh.
+
+    The distributed form of SURVEY.md §2.9's mapping: the reference's
+    goroutine node scan becomes per-shard vector work plus two ICI
+    collectives per group step — an all_gather of the per-shard fit totals
+    (turning local prefix sums into the global first-fit order: shard s's
+    offset is the sum of earlier shards' totals, a hierarchical scan) and a
+    psum of per-group placements. Bit-identical to pack_groups on one
+    device; scales the N axis across chips/hosts (ICI then DCN) the way the
+    scaling-book recipe shards a sequence axis.
+    """
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from kubernetes_autoscaler_tpu.parallel.mesh import NODES_AXIS
+
+    n_shards = mesh.shape[NODES_AXIS]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(NODES_AXIS, None), P(None, NODES_AXIS), P(None, None),
+                  P(None), P(None), P(None)),
+        out_specs=(P(NODES_AXIS, None), P(None, NODES_AXIS), P(None)),
+        check_vma=False,
+    )
+    def run(free_l, mask_l, req_r, count_r, order_r, limone_r):
+        shard = jax.lax.axis_index(NODES_AXIS)
+
+        def step(free_c, g):
+            reqg = req_r[g]
+            c = fit_count(free_c, reqg)
+            c = jnp.where(mask_l[g], c, 0)
+            c = jnp.where(limone_r[g], jnp.minimum(c, 1), c)
+            c = jnp.minimum(c, count_r[g])
+            totals = jax.lax.all_gather(c.sum(), NODES_AXIS)      # i32[S]
+            offset = jnp.sum(
+                jnp.where(jnp.arange(n_shards) < shard, totals, 0))
+            cum = jnp.cumsum(c) + offset
+            place = jnp.clip(count_r[g] - (cum - c), 0, c)
+            free_c = free_c - place[:, None] * reqg[None, :]
+            return free_c, place
+
+        free_after, placed_in_order = jax.lax.scan(step, free_l, order_r)
+        placed = jnp.zeros_like(placed_in_order).at[order_r].set(placed_in_order)
+        scheduled = jax.lax.psum(placed.sum(axis=-1), NODES_AXIS)
+        return free_after, placed, scheduled
+
+    free_after, placed, scheduled = run(
+        jnp.asarray(free), jnp.asarray(mask), jnp.asarray(req),
+        jnp.asarray(count), jnp.asarray(order), jnp.asarray(limit_one))
+    return PackResult(free_after=free_after, placed=placed, scheduled=scheduled)
+
+
 def ffd_order(req: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     """Decreasing-size group order (reference: estimator/decreasing_pod_orderer.go —
     exemplar score over cpu+memory). Invalid rows sort last."""

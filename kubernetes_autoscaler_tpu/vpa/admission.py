@@ -39,12 +39,16 @@ def validate_vpa(vpa: VerticalPodAutoscaler) -> list[str]:
             problems.append(
                 f"container {cp.container_name!r}: unknown controlledValues "
                 f"{cp.controlled_values!r}")
-        for res, lo in cp.min_allowed.items():
+        for res in set(cp.min_allowed) | set(cp.max_allowed):
+            lo = cp.min_allowed.get(res)
             hi = cp.max_allowed.get(res)
-            if lo < 0:
+            if lo is not None and lo < 0:
                 problems.append(
                     f"container {cp.container_name!r}: minAllowed[{res}] < 0")
-            if hi is not None and hi < lo:
+            if hi is not None and hi < 0:
+                problems.append(
+                    f"container {cp.container_name!r}: maxAllowed[{res}] < 0")
+            if hi is not None and lo is not None and hi < lo:
                 problems.append(
                     f"container {cp.container_name!r}: maxAllowed[{res}] < "
                     f"minAllowed[{res}]")

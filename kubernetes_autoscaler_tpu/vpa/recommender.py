@@ -92,8 +92,10 @@ class Recommender:
             row = self._row(key)
             kid = key.id()
             self.first_sample_time.setdefault(kid, s.timestamp or now)
-            self.sample_counts[kid] = self.sample_counts.get(kid, 0) + 1
             if s.cpu_cores is not None:
+                # confidence counts CPU samples only (reference getConfidence
+                # — otherwise cpu+memory datapoints double the sample rate)
+                self.sample_counts[kid] = self.sample_counts.get(kid, 0) + 1
                 cpu_rows.append(row)
                 cpu_vals.append(s.cpu_cores)
             if s.memory_bytes is not None:
@@ -129,7 +131,9 @@ class Recommender:
             prev = self.first_sample_time.get(kid)
             if prev is None or t < prev:
                 self.first_sample_time[kid] = t
-            self.sample_counts[kid] = self.sample_counts.get(kid, 0) + 1
+            if s.cpu_cores is not None:
+                # CPU samples only, matching feed() (see note there)
+                self.sample_counts[kid] = self.sample_counts.get(kid, 0) + 1
             age = max(now - t, 0.0)
             if s.cpu_cores is not None:
                 cpu_rows.append(row)

@@ -132,3 +132,26 @@ def test_atomic_group_exceeding_budget_does_not_starve_plain():
     # the plain idle node must still be deleted
     assert "idle" in status.scale_down_deleted
     assert all(not n.startswith("a") for n in status.scale_down_deleted)
+
+
+def test_atomic_partial_confirm_retries_without_group():
+    """Review scenario: an all-empty atomic group passes the size pre-screen
+    (4 <= empty+drain budgets) but only 2 members fit the empty budget; the
+    pass must re-run WITHOUT the group so plain candidates still drain."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group(
+        "atomic", tmpl, min_size=0, max_size=8,
+        options=NodeGroupOptions(zero_or_max_node_scaling=True))
+    fake.add_node_group("plain", tmpl, min_size=0, max_size=8)
+    for i in range(4):
+        fake.add_existing_node(
+            "atomic", build_test_node(f"a{i}", cpu_milli=4000, mem_mib=8192))
+    fake.add_existing_node(
+        "plain", build_test_node("idle", cpu_milli=4000, mem_mib=8192))
+    opts = make_options(max_scale_down_parallelism=4,
+                        max_empty_bulk_delete=2, max_drain_parallelism=2)
+    a = StaticAutoscaler(fake.provider, fake, options=opts, eviction_sink=fake)
+    status = a.run_once(now=1000.0)
+    assert "idle" in status.scale_down_deleted
+    assert all(not n.startswith("a") for n in status.scale_down_deleted)
