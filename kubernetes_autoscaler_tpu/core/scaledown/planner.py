@@ -189,7 +189,6 @@ class Planner:
         # moves are committed into the working snapshot before the next
         # candidate is simulated, simulator/cluster.go:174-188), which the
         # independent per-candidate device sweep deliberately omits.
-        free = (np.asarray(enc.nodes.cap) - np.asarray(enc.nodes.alloc)).astype(np.int64)
         reqs = np.asarray(enc.scheduled.req)
         group_ref = np.asarray(enc.scheduled.group_ref)
         movable_f = np.asarray(enc.scheduled.movable)

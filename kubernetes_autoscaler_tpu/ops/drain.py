@@ -7,18 +7,30 @@ with a tainted ghost, and try to re-place every pod via the HintingSimulator
 (findPlaceFor :190-228), bounded by a wall-clock timeout and a candidate limit
 (core/scaledown/planner/planner.go:297-309,385).
 
-TPU re-design: ALL candidates are simulated in one device program. For each
-candidate, its resident movable pods are first-fit re-placed onto the
-destination nodes (excluding the candidate itself) against a shared
-group×node predicate plane computed once. Candidates are evaluated
-independently — equivalent to the reference's fork/revert-per-candidate
-semantics — and vmapped in chunks so memory stays bounded; no timeout or
-candidate cap is needed because the whole sweep is O(ms).
+TPU re-design: ALL candidates are simulated in one device program, and the
+serial depth per candidate is the number of DISTINCT POD SHAPES on the node
+(compacted equivalence groups, K slots), not the pod count — the same
+"shapes, not pods" principle as the FFD pack (ops/pack.py). Per candidate:
+
+  1. its resident movable pods are aggregated into per-equivalence-group
+     counts (a window gather + scatter-add),
+  2. a K-step scan first-fits each group's count onto the destination nodes
+     with the cumulative-fit trick (whole group placed in one step; pods
+     spill across nodes in index order exactly as serial first-fit would),
+  3. per-pod destinations are reconstructed from the groups' cumulative
+     placement curves by binary search (a static K-loop of vectorized
+     searchsorted calls — nothing of size pods x nodes is materialized).
+
+Candidates are evaluated independently — equivalent to the reference's
+fork/revert-per-candidate semantics — and vmapped in chunks so memory stays
+bounded. A node carrying more than `max_groups_per_node` distinct shapes is
+conservatively reported undrainable (n_failed counts the overflow pods).
 
 The final *selection* of nodes to delete must not double-book destination
-capacity across candidates; core/scaledown/planner.py does a greedy host-side
-confirmation pass over the (cheap, already-computed) per-candidate results,
-mirroring the reference's commit-on-success ordering (cluster.go:174-188).
+capacity across candidates; core/scaledown/planner.py re-simulates the
+accepted candidates sequentially on the host over the `feas` plane returned
+here, mirroring the reference's commit-on-success ordering
+(cluster.go:174-188).
 """
 
 from __future__ import annotations
@@ -32,7 +44,8 @@ from kubernetes_autoscaler_tpu.models.cluster_state import (
     PodGroupTensors,
     ScheduledPodTensors,
 )
-from kubernetes_autoscaler_tpu.ops import predicates
+from kubernetes_autoscaler_tpu.ops.pack import fit_count
+from kubernetes_autoscaler_tpu.ops.predicates import feasibility_mask
 from kubernetes_autoscaler_tpu.ops.schedule import resident_group_counts
 
 
@@ -55,21 +68,23 @@ def simulate_removals(
     candidates: jnp.ndarray,     # i32[C] node indices to try draining
     dest_allowed: jnp.ndarray,   # bool[N] allowed destination nodes
     max_pods_per_node: int = 128,
-    chunk: int = 32,
+    chunk: int = 256,
+    max_groups_per_node: int = 16,
 ) -> RemovalResult:
     """Simulate removing every candidate node independently."""
     n = nodes.n
+    g_total = specs.g
     mpn = max_pods_per_node
+    kk = max_groups_per_node
 
     # Shared predicate plane: bool[G, N], placement-independent (capacity is
     # checked against the live free tensor during per-candidate packing).
-    feas_gn = predicates.feasibility_mask(nodes, specs, check_resources=False)
-    resident = resident_group_counts(scheduled, specs.g, n)
+    feas_gn = feasibility_mask(nodes, specs, check_resources=False)
+    resident = resident_group_counts(scheduled, g_total, n)
     anti_block = specs.anti_affinity_self[:, None] & (resident > 0)
     feas_gn = feas_gn & ~anti_block
     limit_g = specs.one_per_node()   # bool[G]
     free0 = nodes.free()
-    ring_k = 4                       # one-per-node groups landing on one node during one drain
 
     # Sort resident pods by node so each candidate's pods are one contiguous
     # window — the device-side equivalent of NodeInfo.Pods lists.
@@ -90,40 +105,59 @@ def simulate_removals(
         movable = on_c & scheduled.movable[safe]
         blocker = (on_c & scheduled.blocks[safe]).any()
 
+        # --- compact this node's movable pods into K group slots ---
+        gref = jnp.where(movable, scheduled.group_ref[safe], g_total)  # sentinel
+        counts = jnp.zeros((g_total + 1,), jnp.int32).at[gref].add(
+            movable.astype(jnp.int32))
+        nz = counts[:g_total] > 0                                   # bool[G]
+        rank = jnp.cumsum(nz) - 1                                   # i32[G]
+        compact_of_g = jnp.where(nz & (rank < kk), rank, kk)        # [G] -> K slot
+        gidx = (jnp.zeros((kk + 1,), jnp.int32)
+                .at[compact_of_g].set(jnp.arange(g_total, dtype=jnp.int32))[:kk])
+        filled = jnp.arange(kk) < jnp.minimum(nz.sum(), kk)
+        cnt_k = jnp.where(filled, counts[:g_total][gidx], 0)        # i32[K]
+        # groups beyond K never enter the scan -> their pods stay unplaced
+        # and surface in n_failed (conservatively undrainable)
+
         dest = dest_allowed & nodes.valid & nodes.ready & nodes.schedulable
         dest = dest & (jnp.arange(n) != c)
 
-        def place_pod(carry, slot_and_active):
-            free, ring, ring_cnt = carry
-            slot, active = slot_and_active
-            req = scheduled.req[slot]
-            gref = scheduled.group_ref[slot]
-            is_lim = limit_g[gref]
-            fits = (req[None, :] <= free).all(axis=-1)
-            # One-per-node groups: forbid nodes that already received a sibling
-            # during THIS candidate's drain (the pre-drain resident check is in
-            # feas_gn; this covers intra-drain staleness).
-            sib_here = (ring == gref).any(axis=-1)
-            ok = feas_gn[gref] & dest & fits & ~(is_lim & sib_here)
-            found = ok.any() & active
-            idx = jnp.argmax(ok)  # first feasible node in index order
-            upd = jnp.where(found, 1, 0)
-            free = free.at[idx].add(-req * upd)
-            mark = found & is_lim
-            pos = ring_cnt[idx] % ring_k
-            ring = ring.at[idx, pos].set(jnp.where(mark, gref, ring[idx, pos]))
-            ring_cnt = ring_cnt.at[idx].add(jnp.where(mark, 1, 0))
-            return (free, ring, ring_cnt), jnp.where(found, idx, -1)
+        # --- K-step first-fit of whole groups onto destinations ---
+        def step(free_c, j):
+            gi = gidx[j]
+            want = cnt_k[j]
+            fit = fit_count(free_c, specs.req[gi])
+            fit = jnp.where(feas_gn[gi] & dest, fit, 0)
+            fit = jnp.where(limit_g[gi], jnp.minimum(fit, 1), fit)
+            fit = jnp.minimum(fit, want)
+            cum = jnp.cumsum(fit)
+            place = jnp.clip(want - (cum - fit), 0, fit)
+            free_c = free_c - place[:, None] * specs.req[gi][None, :]
+            return free_c, (place.sum(), jnp.cumsum(place))
 
-        ring0 = jnp.full((n, ring_k), -1, jnp.int32)
-        cnt0 = jnp.zeros((n,), jnp.int32)
-        _, dests = jax.lax.scan(place_pod, (free0, ring0, cnt0), (safe, movable))
-        n_moved = (dests >= 0).sum().astype(jnp.int32)
+        _, (placed_k, cumplace_k) = jax.lax.scan(
+            step, free0, jnp.arange(kk, dtype=jnp.int32))
+        n_moved = placed_k.sum().astype(jnp.int32)
         n_failed = (movable.sum() - n_moved).astype(jnp.int32)
         drainable = (~blocker) & (n_failed == 0)
+
+        # --- reconstruct per-pod destinations from the placement curves ---
+        # rank of each window slot among same-group movable slots before it
+        same = (gref[:, None] == gref[None, :]) & movable[:, None] & movable[None, :]
+        before = jnp.sum(jnp.tril(same, -1), axis=1).astype(jnp.int32)  # [MPN]
+        j_of_slot = jnp.concatenate(
+            [compact_of_g, jnp.full((1,), kk, jnp.int32)])[gref]        # [MPN]
+        dests = jnp.full((mpn,), -1, jnp.int32)
+        for j in range(kk):  # static unroll: vectorized searchsorted per slot
+            d_j = jnp.searchsorted(cumplace_k[j], before + 1).astype(jnp.int32)
+            hit = movable & (j_of_slot == j) & (before < placed_k[j])
+            dests = jnp.where(hit, d_j, dests)
         return drainable, blocker, n_moved, n_failed, dests, jnp.where(on_c, safe, -1)
 
     c_total = candidates.shape[0]
+    # chunk stays FIXED (not fitted to c_total): padded shapes quantize to
+    # chunk multiples so the jit cache hits as the candidate count drifts
+    # loop-to-loop
     pad_c = ((c_total + chunk - 1) // chunk) * chunk
     cand_pad = jnp.concatenate(
         [candidates, jnp.zeros((pad_c - c_total,), jnp.int32)]

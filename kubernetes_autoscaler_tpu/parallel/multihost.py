@@ -1,0 +1,57 @@
+"""Multi-host initialization: the DCN leg of the distributed design.
+
+Reference counterpart (SURVEY.md §2.9/§5.8): the reference has no NCCL/MPI
+backend — its cross-process edges are kube-apiserver + gRPC. Here the
+simulation tensors shard over a Mesh whose inner axis rides ICI within a
+host; spanning hosts only requires initializing the JAX distributed runtime
+so `jax.devices()` becomes the global device set — the SAME named shardings
+(parallel/mesh.py) then place collectives on ICI within a slice and DCN
+across slices. No explicit communication backend to port.
+
+`initialize()` is idempotent and a no-op in single-process settings, so the
+process entry can call it unconditionally.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the multi-host cluster if configured; returns True if distributed.
+
+    Configuration precedence: explicit args, then the standard JAX env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID), then the
+    TPU-pod auto-detection built into jax.distributed.initialize."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        return False  # single process; nothing to join
+
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        # already initialized — idempotent by contract
+        pass
+    return True
+
+
+def global_mesh(nodes_parallel: int | None = None):
+    """Mesh over ALL processes' devices (ICI inner, DCN outer)."""
+    from kubernetes_autoscaler_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(nodes_parallel=nodes_parallel)

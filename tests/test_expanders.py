@@ -1,0 +1,72 @@
+"""Expander strategies: filters, chain composition, gRPC round trip.
+
+Reference analogs: expander/{mostpods,waste,leastnodes,price,priority,
+random,grpcplugin} unit tests and factory/chain.go composition.
+"""
+
+import pytest
+
+from kubernetes_autoscaler_tpu.expander.grpc_transport import (
+    grpc_expander_call,
+    serve_expander,
+)
+from kubernetes_autoscaler_tpu.expander.strategies import (
+    Option,
+    build_expander,
+)
+
+
+def opts():
+    return [
+        Option(group_index=0, group_id="small-pool", node_count=4,
+               pod_count=10, waste=0.10, price=4.0),
+        Option(group_index=1, group_id="big-pool", node_count=2,
+               pod_count=10, waste=0.30, price=6.0),
+        Option(group_index=2, group_id="gpu-pool", node_count=3,
+               pod_count=12, waste=0.20, price=30.0),
+    ]
+
+
+def test_most_pods_then_least_waste_chain():
+    # most-pods keeps the 12-pod gpu option alone -> chain short-circuits
+    assert build_expander("most-pods,least-waste").best_option(opts()).group_id == "gpu-pool"
+
+
+def test_least_nodes_and_price():
+    assert build_expander("least-nodes").best_option(opts()).group_id == "big-pool"
+    assert build_expander("price").best_option(opts()).group_id == "small-pool"
+
+
+def test_priority_tiers_with_regex():
+    e = build_expander("priority,least-waste",
+                       priorities={100: ["^gpu-"], 50: [".*-pool$"]})
+    assert e.best_option(opts()).group_id == "gpu-pool"
+    # no tier matches -> falls through to the next filter over all options
+    e2 = build_expander("priority,least-waste", priorities={10: ["^zzz"]})
+    assert e2.best_option(opts()).group_id == "small-pool"
+
+
+def test_unknown_expander_rejected():
+    with pytest.raises(ValueError):
+        build_expander("bogus")
+
+
+def test_grpc_expander_round_trip():
+    # the external policy prefers the cheapest option, over a REAL gRPC hop
+    def policy(options):
+        best = min(o.price for o in options)
+        return [o for o in options if o.price == best]
+
+    server, port = serve_expander(policy)
+    server.start()
+    try:
+        e = build_expander("grpc", grpc_call=grpc_expander_call(port))
+        assert e.best_option(opts()).group_id == "small-pool"
+    finally:
+        server.stop(None)
+
+
+def test_grpc_expander_fail_open():
+    # dead endpoint: GrpcFilter passes options through (reference fail-open)
+    e = build_expander("grpc,least-nodes", grpc_call=grpc_expander_call(1))
+    assert e.best_option(opts()).group_id == "big-pool"

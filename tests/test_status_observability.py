@@ -146,3 +146,38 @@ def test_debugging_snapshot_roundtrip():
     names = {n["name"] for n in payload["nodeList"]}
     assert "n1" in names and len(names) >= 1
     assert "templateNodes" in payload and "ng1" in payload["templateNodes"]
+
+
+def test_typed_errors():
+    from kubernetes_autoscaler_tpu.utils.errors import (
+        AutoscalerError,
+        ErrorType,
+        to_autoscaler_error,
+    )
+
+    e = AutoscalerError(ErrorType.TRANSIENT, "cloud timeout")
+    assert e.retriable
+    wrapped = e.prefixed("scale-up ng1: ")
+    assert wrapped.error_type is ErrorType.TRANSIENT
+    assert "scale-up ng1: cloud timeout" in str(wrapped)
+    same = to_autoscaler_error(ErrorType.INTERNAL, e)
+    assert same is e
+    conv = to_autoscaler_error(ErrorType.INTERNAL, ValueError("boom"))
+    assert conv.error_type is ErrorType.INTERNAL and not conv.retriable
+
+
+def test_logging_quota(caplog):
+    import logging
+
+    from kubernetes_autoscaler_tpu.utils.klogx import LoggingQuota, frame_up, v
+
+    q = LoggingQuota(2)
+    with caplog.at_level(logging.INFO, logger="kubernetes_autoscaler_tpu"):
+        for i in range(5):
+            v(q, "pod %d unschedulable", i)
+        frame_up(q, "pods")
+    msgs = [r.getMessage() for r in caplog.records]
+    assert msgs[:2] == ["pod 0 unschedulable", "pod 1 unschedulable"]
+    assert msgs[-1] == "... and 3 other pods"
+    assert len(msgs) == 3
+    assert q.left == 2  # reset
