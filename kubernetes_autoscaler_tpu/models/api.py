@@ -32,6 +32,12 @@ DELETION_CANDIDATE_TAINT = "DeletionCandidateOfClusterAutoscaler"
 # constraint is not dense-encodable: forces the winner-verification tier.
 HOST_CHECK_ANNOTATION = "autoscaler.x-k8s.io/host-check"
 
+# Well-known topology keys (k8s core/v1). The dense encoding supports these
+# two domain kinds; other topology keys route through the host-check tier.
+HOSTNAME_KEY = "kubernetes.io/hostname"
+ZONE_KEY = "topology.kubernetes.io/zone"
+ZONE_KEY_BETA = "failure-domain.beta.kubernetes.io/zone"
+
 
 @dataclass(frozen=True)
 class Taint:
@@ -59,16 +65,30 @@ class OwnerRef:
 @dataclass
 class AffinityTerm:
     """One required pod-(anti-)affinity term: selector over pod labels within a
-    topology domain (reference: vendored InterPodAffinity filter semantics)."""
+    topology domain (reference: vendored InterPodAffinity filter semantics).
+
+    `namespaces` empty means "the pod's own namespace" (k8s default)."""
 
     match_labels: dict[str, str] = field(default_factory=dict)
     topology_key: str = "kubernetes.io/hostname"
+    namespaces: tuple[str, ...] = ()
+
+
+@dataclass
+class TopologySpreadConstraint:
+    """One `whenUnsatisfiable: DoNotSchedule` topologySpreadConstraint
+    (reference: vendored PodTopologySpread filter semantics). An empty
+    label_selector matches no pods (k8s semantics)."""
+
+    max_skew: int = 1
+    topology_key: str = "topology.kubernetes.io/zone"
+    match_labels: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
 class NodeSelectorRequirement:
     key: str
-    operator: str = "In"          # In | NotIn | Exists | DoesNotExist
+    operator: str = "In"          # In | NotIn | Exists | DoesNotExist | Gt | Lt
     values: tuple[str, ...] = ()
 
 
@@ -83,13 +103,20 @@ class Pod:
     # resourcehelpers; init-container max() rule applied by the caller/builder).
     requests: dict[str, float] = field(default_factory=dict)  # name -> amount (cpu in cores, memory in bytes)
     node_selector: dict[str, str] = field(default_factory=dict)
+    # Single-term sugar: one ANDed requirement list. For the full k8s shape
+    # (nodeSelectorTerms = OR of terms, each an AND of requirements) set
+    # node_affinity_terms; when it is non-empty it supersedes this field.
     required_node_affinity: list[NodeSelectorRequirement] = field(default_factory=list)
+    node_affinity_terms: list[list[NodeSelectorRequirement]] = field(default_factory=list)
     tolerations: list[Toleration] = field(default_factory=list)
     host_ports: tuple[tuple[int, str], ...] = ()              # (port, protocol)
     anti_affinity: list[AffinityTerm] = field(default_factory=list)
     pod_affinity: list[AffinityTerm] = field(default_factory=list)
+    # Legacy single-constraint sugar (selector = the pod's own labels);
+    # topology_spread supersedes both fields when non-empty.
     topology_spread_max_skew: int = 0                         # 0 = no constraint
     topology_spread_key: str = ""
+    topology_spread: list[TopologySpreadConstraint] = field(default_factory=list)
     owner: Optional[OwnerRef] = None
     priority: int = 0
     node_name: str = ""                                       # scheduled destination ("" = pending)
@@ -104,6 +131,27 @@ class Pod:
 
     def is_mirror(self) -> bool:
         return "kubernetes.io/config.mirror" in self.annotations
+
+    def affinity_node_terms(self) -> list[list[NodeSelectorRequirement]]:
+        """OR-of-AND nodeSelectorTerms (node_affinity_terms, or the single-term
+        sugar wrapped). Empty list = no required node affinity."""
+        if self.node_affinity_terms:
+            return self.node_affinity_terms
+        if self.required_node_affinity:
+            return [self.required_node_affinity]
+        return []
+
+    def spread_constraints(self) -> list[TopologySpreadConstraint]:
+        """All DoNotSchedule spread constraints, legacy sugar included (its
+        selector is the pod's own labels — the dominant real-world shape)."""
+        out = list(self.topology_spread)
+        if not out and self.topology_spread_max_skew > 0:
+            out.append(TopologySpreadConstraint(
+                max_skew=self.topology_spread_max_skew,
+                topology_key=self.topology_spread_key or "topology.kubernetes.io/zone",
+                match_labels=dict(self.labels),
+            ))
+        return out
 
 
 @dataclass

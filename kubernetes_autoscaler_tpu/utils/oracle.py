@@ -1,24 +1,36 @@
-"""Serial exact predicate oracle — the test-time ground truth.
+"""Serial exact predicate oracle — the test-time and host-check ground truth.
 
 Plays the role the reference's Go path plays for its TPU sidecar (SURVEY.md §4
 'oracle-checked against a serial reference implementation'): a direct,
 unvectorized implementation of the simulable Filter subset with full string
-semantics. The device kernels (ops/predicates.py) are property-tested against
-this module; the control plane also uses it to exactly verify selected
-winners before actuation (the host-check tier for lossy encodings).
+semantics. The device kernels (ops/predicates.py, ops/constrained.py) are
+property-tested against this module; the control plane also uses it to exactly
+verify selected winners before actuation (the host-check tier for lossy
+encodings).
 
 Semantics distilled from the vendored kube-scheduler plugins the reference
 runs (simulator/framework/handle.go:84-89 builds the in-tree registry):
-NodeResourcesFit, NodeAffinity, TaintToleration, NodePorts, NodeUnschedulable.
+NodeResourcesFit, NodeAffinity (full OR-of-terms + Gt/Lt), TaintToleration,
+NodePorts, NodeUnschedulable, InterPodAffinity (required affinity and
+anti-affinity, any topology key, first-pod exception), PodTopologySpread
+(DoNotSchedule constraints).
+
+Cluster-wide constraints (spread, inter-pod affinity) need the whole snapshot;
+`check_pod_in_cluster` is the full-context entry. `check_pod_on_node` keeps
+the single-node view for the plain predicates.
 """
 
 from __future__ import annotations
 
 from kubernetes_autoscaler_tpu.models import resources as res
 from kubernetes_autoscaler_tpu.models.api import (
+    HOSTNAME_KEY,
     NO_EXECUTE,
     NO_SCHEDULE,
     TO_BE_DELETED_TAINT,
+    ZONE_KEY,
+    ZONE_KEY_BETA,
+    AffinityTerm,
     Node,
     Pod,
 )
@@ -37,26 +49,46 @@ def resources_fit(pod: Pod, node: Node,
     return bool((req.astype(int) <= cap).all())
 
 
+def _as_int(s: str) -> int | None:
+    try:
+        return int(s)
+    except (TypeError, ValueError):
+        return None
+
+
+def requirement_matches(req, labels: dict[str, str]) -> bool:
+    """One NodeSelectorRequirement vs a label map (k8s v1.NodeSelectorRequirement
+    semantics, Gt/Lt included: both sides must parse as integers)."""
+    val = labels.get(req.key)
+    if req.operator == "In":
+        return val is not None and val in req.values
+    if req.operator == "NotIn":
+        return val not in req.values
+    if req.operator == "Exists":
+        return req.key in labels
+    if req.operator == "DoesNotExist":
+        return req.key not in labels
+    if req.operator in ("Gt", "Lt"):
+        lhs = _as_int(val) if val is not None else None
+        rhs = _as_int(req.values[0]) if req.values else None
+        if lhs is None or rhs is None:
+            return False
+        return lhs > rhs if req.operator == "Gt" else lhs < rhs
+    raise NotImplementedError(f"operator {req.operator}")
+
+
 def selector_matches(pod: Pod, node: Node) -> bool:
+    """nodeSelector AND required node affinity (OR over nodeSelectorTerms,
+    AND within a term — k8s NodeAffinity semantics)."""
     for k, v in pod.node_selector.items():
         if node.labels.get(k) != v:
             return False
-    for r in pod.required_node_affinity:
-        if r.operator == "In":
-            if node.labels.get(r.key) not in r.values:
-                return False
-        elif r.operator == "NotIn":
-            if node.labels.get(r.key) in r.values:
-                return False
-        elif r.operator == "Exists":
-            if r.key not in node.labels:
-                return False
-        elif r.operator == "DoesNotExist":
-            if r.key in node.labels:
-                return False
-        else:
-            raise NotImplementedError(f"operator {r.operator}")
-    return True
+    terms = pod.affinity_node_terms()
+    if not terms:
+        return True
+    return any(
+        all(requirement_matches(r, node.labels) for r in term) for term in terms
+    )
 
 
 def taints_tolerated(pod: Pod, node: Node) -> bool:
@@ -96,13 +128,163 @@ def node_schedulable(node: Node) -> bool:
     return all(t.key != TO_BE_DELETED_TAINT for t in node.taints)
 
 
+# ---- topology helpers ----------------------------------------------------
+
+
+def topology_value(node: Node, key: str) -> str | None:
+    """The node's domain value for a topology key (None = key absent).
+
+    The GA zone key falls back to the beta key; the hostname key falls back to
+    the node name (kubelet always sets it; lightweight fixtures may not)."""
+    if key == ZONE_KEY:
+        return node.labels.get(ZONE_KEY, node.labels.get(ZONE_KEY_BETA))
+    if key == HOSTNAME_KEY:
+        return node.labels.get(HOSTNAME_KEY, node.name)
+    return node.labels.get(key)
+
+
+def labels_match(selector: dict[str, str], labels: dict[str, str]) -> bool:
+    """match_labels subset test. An EMPTY selector matches no pods — both the
+    spread and affinity encodings here treat {} as 'selects nothing'."""
+    if not selector:
+        return False
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def _term_namespaces(term: AffinityTerm, pod: Pod) -> tuple[str, ...]:
+    return term.namespaces or (pod.namespace,)
+
+
+def _term_matches_pod(term: AffinityTerm, pod: Pod, other: Pod) -> bool:
+    return other.namespace in _term_namespaces(term, pod) and labels_match(
+        term.match_labels, other.labels
+    )
+
+
+# ---- cluster-wide constraints -------------------------------------------
+
+
+def spread_ok(
+    pod: Pod,
+    node: Node,
+    nodes: list[Node],
+    pods_by_node: dict[str, list[Pod]],
+) -> bool:
+    """PodTopologySpread DoNotSchedule check (vendored plugin semantics):
+    for each constraint, skew after placing = count(node's domain) + 1 -
+    min(count over eligible domains) must stay <= max_skew. Eligible domains
+    are values present on nodes matching the pod's nodeSelector/affinity;
+    matching pods are counted in the pod's namespace across ALL nodes holding
+    the topology key."""
+    for c in pod.spread_constraints():
+        v_here = topology_value(node, c.topology_key)
+        if v_here is None:
+            return False  # node without the key cannot satisfy the constraint
+        counts: dict[str, int] = {}
+        eligible: set[str] = set()
+        for nd in nodes:
+            v = topology_value(nd, c.topology_key)
+            if v is None:
+                continue
+            counts.setdefault(v, 0)
+            if selector_matches(pod, nd):
+                eligible.add(v)
+            for q in pods_by_node.get(nd.name, []):
+                if q.namespace == pod.namespace and labels_match(c.match_labels, q.labels):
+                    counts[v] += 1
+        eligible.add(v_here)  # the candidate node itself is an eligible domain
+        min_count = min((counts.get(v, 0) for v in eligible), default=0)
+        if counts.get(v_here, 0) + 1 - min_count > c.max_skew:
+            return False
+    return True
+
+
+def pod_affinity_ok(
+    pod: Pod,
+    node: Node,
+    nodes: list[Node],
+    pods_by_node: dict[str, list[Pod]],
+) -> bool:
+    """Required inter-pod affinity: each term needs >=1 matching pod in the
+    candidate node's topology domain. First-pod exception (vendored
+    InterPodAffinity): a term with NO matching pod anywhere is satisfied if
+    the incoming pod matches its own selector+namespaces."""
+    for term in pod.pod_affinity:
+        v_here = topology_value(node, term.topology_key)
+        if v_here is None:
+            return False
+        matched_here = False
+        matched_anywhere = False
+        for nd in nodes:
+            v = topology_value(nd, term.topology_key)
+            for q in pods_by_node.get(nd.name, []):
+                if _term_matches_pod(term, pod, q):
+                    matched_anywhere = True
+                    if v == v_here:
+                        matched_here = True
+        if matched_here:
+            continue
+        if not matched_anywhere and _term_matches_pod(term, pod, pod):
+            continue  # first-pod exception
+        return False
+    return True
+
+
+def anti_affinity_ok(
+    pod: Pod,
+    node: Node,
+    nodes: list[Node],
+    pods_by_node: dict[str, list[Pod]],
+) -> bool:
+    """Required inter-pod anti-affinity: no matching pod may share the
+    candidate node's topology domain. A node without the key has no domain,
+    so the term cannot be violated there (vendored plugin behavior)."""
+    for term in pod.anti_affinity:
+        v_here = topology_value(node, term.topology_key)
+        if v_here is None:
+            continue
+        for nd in nodes:
+            if topology_value(nd, term.topology_key) != v_here:
+                continue
+            for q in pods_by_node.get(nd.name, []):
+                if _term_matches_pod(term, pod, q):
+                    return False
+    return True
+
+
+# ---- verdict entries -----------------------------------------------------
+
+
+def group_pods_by_node(pods: list[Pod]) -> dict[str, list[Pod]]:
+    by_node: dict[str, list[Pod]] = {}
+    for p in pods:
+        if p.node_name and p.phase not in ("Succeeded", "Failed"):
+            by_node.setdefault(p.node_name, []).append(p)
+    return by_node
+
+
 def check_pod_on_node(
     pod: Pod,
     node: Node,
     pods_on_node: list[Pod],
     registry: res.ExtendedResourceRegistry | None = None,
 ) -> bool:
-    """Exact verdict: can `pod` schedule on `node` given its resident pods?"""
+    """Single-node verdict: plain predicates plus the cluster constraints
+    evaluated in a one-node world (exact when the pod has no cluster-wide
+    constraints; call check_pod_in_cluster when it does)."""
+    return check_pod_in_cluster(
+        pod, node, [node], {node.name: list(pods_on_node)}, registry
+    )
+
+
+def check_pod_in_cluster(
+    pod: Pod,
+    node: Node,
+    nodes: list[Node],
+    pods_by_node: dict[str, list[Pod]],
+    registry: res.ExtendedResourceRegistry | None = None,
+) -> bool:
+    """Exact verdict with full cluster context: can `pod` schedule on `node`?"""
     registry = registry or res.ExtendedResourceRegistry()
     if not node_schedulable(node):
         return False
@@ -110,6 +292,7 @@ def check_pod_on_node(
         return False
     if not taints_tolerated(pod, node):
         return False
+    pods_on_node = pods_by_node.get(node.name, [])
     if not ports_free(pod, pods_on_node):
         return False
     cap = node_capacity_vector(node, registry).astype(int)
@@ -120,9 +303,37 @@ def check_pod_on_node(
     req, _ = pod_request_vector(pod, registry)
     if not bool((req.astype(int) <= cap - used).all()):
         return False
-    for term in pod.anti_affinity:
-        if term.topology_key == "kubernetes.io/hostname":
-            for q in pods_on_node:
-                if all(q.labels.get(k) == v for k, v in term.match_labels.items()):
-                    return False
+    if pod.anti_affinity and not anti_affinity_ok(pod, node, nodes, pods_by_node):
+        return False
+    if pod.pod_affinity and not pod_affinity_ok(pod, node, nodes, pods_by_node):
+        return False
+    if not spread_ok(pod, node, nodes, pods_by_node):
+        return False
     return True
+
+
+def check_pod_on_new_node(
+    pod: Pod,
+    template: Node,
+    nodes: list[Node],
+    pods_by_node: dict[str, list[Pod]],
+    registry: res.ExtendedResourceRegistry | None = None,
+    fresh_name: str = "template-fresh-node",
+) -> bool:
+    """Can `pod` schedule on a FRESH node stamped from `template`, given the
+    current cluster? This is the scale-up winner-verification question
+    (reference: the estimator schedules against a sanitized template NodeInfo
+    added to the forked snapshot, binpacking_estimator.go:330)."""
+    fresh = Node(
+        name=fresh_name,
+        labels={**template.labels, HOSTNAME_KEY: fresh_name},
+        annotations=dict(template.annotations),
+        capacity=dict(template.capacity),
+        allocatable=dict(template.allocatable),
+        taints=list(template.taints),
+        ready=True,
+        unschedulable=False,
+    )
+    return check_pod_in_cluster(
+        pod, fresh, list(nodes) + [fresh], pods_by_node, registry
+    )

@@ -1,0 +1,207 @@
+"""Exact-oracle semantics for the cluster-wide constraints: topology spread,
+positive/negative inter-pod affinity, OR-of-terms node affinity, Gt/Lt.
+
+Reference analog: the vendored kube-scheduler plugin unit tests
+(PodTopologySpread/InterPodAffinity/NodeAffinity filter tests) that back
+simulator/clustersnapshot/predicate/plugin_runner.go:54-143.
+"""
+
+from kubernetes_autoscaler_tpu.models.api import (
+    AffinityTerm,
+    NodeSelectorRequirement,
+    TopologySpreadConstraint,
+)
+from kubernetes_autoscaler_tpu.utils import oracle
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def _cluster(zones=("a", "a", "b", "c")):
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192, zone=z)
+             for i, z in enumerate(zones)]
+    return nodes
+
+
+def _resident(name, node, labels):
+    p = build_test_pod(name, cpu_milli=10, mem_mib=10, labels=labels)
+    p.node_name = node
+    p.phase = "Running"
+    return p
+
+
+def test_spread_zone_skew():
+    nodes = _cluster(zones=("a", "b", "c"))
+    # app=web residents: 2 in zone a, 1 in zone b, 0 in zone c
+    pods = [
+        _resident("w1", "n0", {"app": "web"}),
+        _resident("w2", "n0", {"app": "web"}),
+        _resident("w3", "n1", {"app": "web"}),
+    ]
+    by_node = oracle.group_pods_by_node(pods)
+    incoming = build_test_pod("w4", cpu_milli=10, mem_mib=10, labels={"app": "web"})
+    incoming.topology_spread = [TopologySpreadConstraint(
+        max_skew=1, topology_key="topology.kubernetes.io/zone",
+        match_labels={"app": "web"})]
+    # counts: a=2, b=1, c=0; min=0 -> only zone c keeps skew<=1
+    assert not oracle.check_pod_in_cluster(incoming, nodes[0], nodes, by_node)
+    assert not oracle.check_pod_in_cluster(incoming, nodes[1], nodes, by_node)
+    assert oracle.check_pod_in_cluster(incoming, nodes[2], nodes, by_node)
+
+
+def test_spread_min_over_eligible_domains_only():
+    # zone c is excluded by the pod's node selector -> min computed over a,b
+    nodes = [build_test_node("n0", zone="a", labels={"pool": "x"}),
+             build_test_node("n1", zone="b", labels={"pool": "x"}),
+             build_test_node("n2", zone="c")]
+    pods = [_resident("w1", "n0", {"app": "web"})]
+    by_node = oracle.group_pods_by_node(pods)
+    incoming = build_test_pod("w2", cpu_milli=10, mem_mib=10, labels={"app": "web"},
+                              node_selector={"pool": "x"})
+    incoming.topology_spread = [TopologySpreadConstraint(
+        max_skew=1, topology_key="topology.kubernetes.io/zone",
+        match_labels={"app": "web"})]
+    # counts: a=1, b=0 (c's 0 is NOT eligible but min is 0 anyway via b);
+    # placing in a -> 2-0=2 > 1; placing in b -> 1-0=1 ok
+    assert not oracle.check_pod_in_cluster(incoming, nodes[0], nodes, by_node)
+    assert oracle.check_pod_in_cluster(incoming, nodes[1], nodes, by_node)
+
+
+def test_spread_node_without_key_rejected():
+    nodes = [build_test_node("n0", zone="a"), build_test_node("n1")]  # n1: no zone
+    incoming = build_test_pod("p", cpu_milli=10, mem_mib=10, labels={"app": "w"})
+    incoming.topology_spread = [TopologySpreadConstraint(
+        max_skew=1, topology_key="topology.kubernetes.io/zone",
+        match_labels={"app": "w"})]
+    assert oracle.check_pod_in_cluster(incoming, nodes[0], nodes, {})
+    assert not oracle.check_pod_in_cluster(incoming, nodes[1], nodes, {})
+
+
+def test_spread_hostname_legacy_sugar():
+    nodes = [build_test_node("n0"), build_test_node("n1")]
+    pods = [_resident("w1", "n0", {"app": "web"})]
+    by_node = oracle.group_pods_by_node(pods)
+    incoming = build_test_pod("w2", cpu_milli=10, mem_mib=10, labels={"app": "web"})
+    incoming.topology_spread_max_skew = 1
+    incoming.topology_spread_key = "kubernetes.io/hostname"
+    # counts: n0=1, n1=0; min=0 -> n0 would make skew 2
+    assert not oracle.check_pod_in_cluster(incoming, nodes[0], nodes, by_node)
+    assert oracle.check_pod_in_cluster(incoming, nodes[1], nodes, by_node)
+
+
+def test_positive_affinity_zone():
+    nodes = _cluster(zones=("a", "a", "b", "c"))
+    pods = [_resident("db", "n0", {"app": "db"})]
+    by_node = oracle.group_pods_by_node(pods)
+    incoming = build_test_pod("web", cpu_milli=10, mem_mib=10)
+    incoming.pod_affinity = [AffinityTerm(
+        match_labels={"app": "db"}, topology_key="topology.kubernetes.io/zone")]
+    assert oracle.check_pod_in_cluster(incoming, nodes[0], nodes, by_node)
+    assert oracle.check_pod_in_cluster(incoming, nodes[1], nodes, by_node)  # same zone a
+    assert not oracle.check_pod_in_cluster(incoming, nodes[2], nodes, by_node)
+    assert not oracle.check_pod_in_cluster(incoming, nodes[3], nodes, by_node)
+
+
+def test_positive_affinity_first_pod_exception():
+    nodes = _cluster(zones=("a", "b"))
+    incoming = build_test_pod("w", cpu_milli=10, mem_mib=10, labels={"app": "w"})
+    incoming.pod_affinity = [AffinityTerm(
+        match_labels={"app": "w"}, topology_key="topology.kubernetes.io/zone")]
+    # no matching pod anywhere + self-matching selector -> allowed anywhere
+    assert oracle.check_pod_in_cluster(incoming, nodes[0], nodes, {})
+    # a non-self selector with no match anywhere -> blocked
+    other = build_test_pod("x", cpu_milli=10, mem_mib=10, labels={"app": "x"})
+    other.pod_affinity = [AffinityTerm(
+        match_labels={"app": "db"}, topology_key="topology.kubernetes.io/zone")]
+    assert not oracle.check_pod_in_cluster(other, nodes[0], nodes, {})
+
+
+def test_positive_affinity_namespace_scoped():
+    nodes = _cluster(zones=("a",))
+    q = _resident("db", "n0", {"app": "db"})
+    q.namespace = "prod"
+    by_node = oracle.group_pods_by_node([q])
+    incoming = build_test_pod("w", cpu_milli=10, mem_mib=10)  # namespace default
+    incoming.pod_affinity = [AffinityTerm(
+        match_labels={"app": "db"}, topology_key="topology.kubernetes.io/zone")]
+    assert not oracle.check_pod_in_cluster(incoming, nodes[0], nodes, by_node)
+    incoming.pod_affinity = [AffinityTerm(
+        match_labels={"app": "db"}, topology_key="topology.kubernetes.io/zone",
+        namespaces=("prod",))]
+    assert oracle.check_pod_in_cluster(incoming, nodes[0], nodes, by_node)
+
+
+def test_anti_affinity_zone_scoped():
+    nodes = _cluster(zones=("a", "a", "b"))
+    pods = [_resident("w1", "n0", {"app": "web"})]
+    by_node = oracle.group_pods_by_node(pods)
+    incoming = build_test_pod("w2", cpu_milli=10, mem_mib=10, labels={"app": "web"})
+    incoming.anti_affinity = [AffinityTerm(
+        match_labels={"app": "web"}, topology_key="topology.kubernetes.io/zone")]
+    assert not oracle.check_pod_in_cluster(incoming, nodes[0], nodes, by_node)
+    assert not oracle.check_pod_in_cluster(incoming, nodes[1], nodes, by_node)  # zone a
+    assert oracle.check_pod_in_cluster(incoming, nodes[2], nodes, by_node)
+
+
+def test_node_affinity_or_of_terms():
+    n_ssd = build_test_node("n0", labels={"disk": "ssd"})
+    n_big = build_test_node("n1", labels={"size": "big"})
+    n_none = build_test_node("n2")
+    nodes = [n_ssd, n_big, n_none]
+    p = build_test_pod("p", cpu_milli=10, mem_mib=10)
+    p.node_affinity_terms = [
+        [NodeSelectorRequirement(key="disk", operator="In", values=("ssd",))],
+        [NodeSelectorRequirement(key="size", operator="In", values=("big",))],
+    ]
+    assert oracle.check_pod_in_cluster(p, n_ssd, nodes, {})
+    assert oracle.check_pod_in_cluster(p, n_big, nodes, {})
+    assert not oracle.check_pod_in_cluster(p, n_none, nodes, {})
+
+
+def test_node_affinity_gt_lt():
+    n8 = build_test_node("n0", labels={"cores": "8"})
+    n32 = build_test_node("n1", labels={"cores": "32"})
+    n_bad = build_test_node("n2", labels={"cores": "lots"})
+    nodes = [n8, n32, n_bad]
+    p = build_test_pod("p", cpu_milli=10, mem_mib=10)
+    p.required_node_affinity = [
+        NodeSelectorRequirement(key="cores", operator="Gt", values=("10",))]
+    assert not oracle.check_pod_in_cluster(p, n8, nodes, {})
+    assert oracle.check_pod_in_cluster(p, n32, nodes, {})
+    assert not oracle.check_pod_in_cluster(p, n_bad, nodes, {})  # unparseable
+    p.required_node_affinity = [
+        NodeSelectorRequirement(key="cores", operator="Lt", values=("10",))]
+    assert oracle.check_pod_in_cluster(p, n8, nodes, {})
+    assert not oracle.check_pod_in_cluster(p, n32, nodes, {})
+
+
+def test_check_pod_on_new_node_topology():
+    # scale-up verification: fresh node from a zone-b template satisfies
+    # affinity to a zone-b resident, not a zone-a one
+    nodes = [build_test_node("n0", zone="a")]
+    db_a = _resident("db", "n0", {"app": "db"})
+    by_node = oracle.group_pods_by_node([db_a])
+    tmpl_b = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192, zone="b")
+    incoming = build_test_pod("w", cpu_milli=10, mem_mib=10)
+    incoming.pod_affinity = [AffinityTerm(
+        match_labels={"app": "db"}, topology_key="topology.kubernetes.io/zone")]
+    assert not oracle.check_pod_on_new_node(incoming, tmpl_b, nodes, by_node)
+    tmpl_a = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192, zone="a")
+    assert oracle.check_pod_on_new_node(incoming, tmpl_a, nodes, by_node)
+
+
+def test_anti_affinity_on_new_node_hostname_ok():
+    # hostname anti-affinity never blocks a FRESH node (new domain)
+    nodes = [build_test_node("n0")]
+    w1 = _resident("w1", "n0", {"app": "web"})
+    by_node = oracle.group_pods_by_node([w1])
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    incoming = build_test_pod("w2", cpu_milli=10, mem_mib=10, labels={"app": "web"})
+    incoming.anti_affinity = [AffinityTerm(match_labels={"app": "web"})]
+    assert oracle.check_pod_on_new_node(incoming, tmpl, nodes, by_node)
+    # but a zone-scoped term does block a fresh node in an occupied zone
+    nodes_z = [build_test_node("n0", zone="a")]
+    w1z = _resident("w1", "n0", {"app": "web"})
+    tmpl_z = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192, zone="a")
+    incoming.anti_affinity = [AffinityTerm(
+        match_labels={"app": "web"}, topology_key="topology.kubernetes.io/zone")]
+    assert not oracle.check_pod_on_new_node(
+        incoming, tmpl_z, nodes_z, oracle.group_pods_by_node([w1z]))
