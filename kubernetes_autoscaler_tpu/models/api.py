@@ -154,6 +154,20 @@ class Pod:
         return out
 
 
+def labels_match(selector: dict[str, str], labels: dict[str, str]) -> bool:
+    """match_labels subset test. An EMPTY selector matches no pods — both the
+    spread and affinity encodings treat {} as 'selects nothing'."""
+    if not selector:
+        return False
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def term_matches_pod(term: AffinityTerm, pod: "Pod", other: "Pod") -> bool:
+    """Does `other` match `term` of `pod` (selector + namespace scoping)?"""
+    namespaces = term.namespaces or (pod.namespace,)
+    return other.namespace in namespaces and labels_match(term.match_labels, other.labels)
+
+
 @dataclass
 class Workload:
     """A replica-controller-shaped object (Deployment/ReplicaSet/Job/...).

@@ -44,6 +44,9 @@ class Dims:
     max_pod_ports: int = 4     # hostPorts per pod group
     max_node_ports: int = 16   # occupied hostPort slots per node
     max_aff_terms: int = 2     # (anti-)affinity terms per pod group
+    max_zones: int = 16        # topology-zone slots (id 0 = "no zone"); more
+                               # zones than this routes zone-scoped constraints
+                               # through the host-check tier
 
 
 DEFAULT_DIMS = Dims()
@@ -86,6 +89,16 @@ class PodGroupTensors(struct.PyTreeNode):
     anti_affinity_self: jax.Array  # bool[G] pod has self-anti-affinity on hostname
     valid: jax.Array         # bool[G]
     needs_host_check: jax.Array  # bool[G] encoding was lossy; verify winner on host
+    # Topology-coupled constraints (None = encoded before these existed /
+    # produced by a lowering path without them; kernels treat None as
+    # unconstrained). Kinds: 0 = none, 1 = hostname-domain, 2 = zone-domain.
+    spread_kind: jax.Array | None = None    # i32[G] topologySpreadConstraint kind
+    max_skew: jax.Array | None = None       # i32[G]
+    spread_self: jax.Array | None = None    # bool[G] spread selector matches own labels
+    aff_kind: jax.Array | None = None       # i32[G] required pod-affinity kind
+    aff_self: jax.Array | None = None       # bool[G] affinity selector matches self
+    aff_match_any: jax.Array | None = None  # bool[G] >=1 resident matches the selector
+    anti_self_zone: jax.Array | None = None  # bool[G] zone-scoped self anti-affinity
 
     @property
     def g(self) -> int:
@@ -159,6 +172,27 @@ class NodeGroupTensors(struct.PyTreeNode):
         )
 
 
+class AffinityPlanes(struct.PyTreeNode):
+    """Resident-derived cross planes for the topology-coupled constraints.
+
+    Counts of RESIDENT pods matching each pending group's selectors, per node.
+    Computed once at encode time (models/encode.py) — the device aggregates
+    zones from these on the fly (ops/constrained.py). The reference gets the
+    same information by walking NodeInfo.Pods inside the vendored
+    InterPodAffinity/PodTopologySpread plugins per (pod, node) check.
+    """
+
+    aff_cnt: jax.Array        # i32[G, N] residents matching g's pod-affinity term
+    anti_host_cnt: jax.Array  # i32[G, N] matching g's hostname-scoped anti terms
+    anti_zone_cnt: jax.Array  # i32[G, N] matching g's zone-scoped anti terms
+    spread_cnt: jax.Array     # i32[G, N] matching g's spread selector
+
+    @classmethod
+    def zeros(cls, g: int, n: int) -> "AffinityPlanes":
+        z = jnp.zeros((g, n), jnp.int32)
+        return cls(aff_cnt=z, anti_host_cnt=z, anti_zone_cnt=z, spread_cnt=z)
+
+
 class ClusterTensors(struct.PyTreeNode):
     """The full device-resident snapshot: one immutable pytree.
 
@@ -170,6 +204,7 @@ class ClusterTensors(struct.PyTreeNode):
     pending: PodGroupTensors
     scheduled: ScheduledPodTensors
     groups: NodeGroupTensors
+    planes: AffinityPlanes | None = None
 
 
 def pad_to(n: int, bucket: int = 64) -> int:

@@ -31,14 +31,21 @@ import numpy as np
 
 from kubernetes_autoscaler_tpu.models import resources as res
 from kubernetes_autoscaler_tpu.models.api import (
+    HOSTNAME_KEY,
     NO_EXECUTE,
     NO_SCHEDULE,
     TO_BE_DELETED_TAINT,
+    ZONE_KEY,
+    ZONE_KEY_BETA,
+    AffinityTerm,
     Node,
     Pod,
+    labels_match,
+    term_matches_pod,
 )
 from kubernetes_autoscaler_tpu.models.cluster_state import (
     DEFAULT_DIMS,
+    AffinityPlanes,
     Dims,
     NodeGroupTensors,
     NodeTensors,
@@ -153,6 +160,27 @@ class _PodSpecEncoding:
     port_hash: np.ndarray
     anti_affinity_self: bool
     lossy: bool
+    # topology-coupled constraints (kinds: 0 none, 1 hostname, 2 zone)
+    spread_kind: int = 0
+    max_skew: int = 0
+    spread_self: bool = False
+    spread_selector: dict[str, str] | None = None
+    aff_kind: int = 0
+    aff_self: bool = False
+    aff_term: AffinityTerm | None = None
+    anti_self_zone: bool = False
+    anti_host_terms: list[AffinityTerm] = field(default_factory=list)
+    anti_zone_terms: list[AffinityTerm] = field(default_factory=list)
+    exemplar: Pod | None = None
+
+
+def _domain_kind(topology_key: str) -> int:
+    """1 = hostname domain, 2 = zone domain, 0 = not dense-encodable."""
+    if topology_key == HOSTNAME_KEY:
+        return 1
+    if topology_key in (ZONE_KEY, ZONE_KEY_BETA):
+        return 2
+    return 0
 
 
 def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
@@ -165,7 +193,15 @@ def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
     sel_neg = np.zeros((dims.max_neg_terms,), dtype=np.int32)
     terms: list[list[int]] = [[fold32(f"{k}={v}")] for k, v in sorted(pod.node_selector.items())]
     negs: list[int] = []
-    for r in pod.required_node_affinity:
+    # NodeAffinity is OR-of-AND (nodeSelectorTerms); the dense AND-of-OR shape
+    # carries a single term exactly. Multi-term OR is dropped from the dense
+    # mask (over-admits — never silently blocks) and flagged host-check; the
+    # oracle (utils/oracle.selector_matches) is the exact truth there.
+    affinity_terms = pod.affinity_node_terms()
+    if len(affinity_terms) > 1:
+        lossy = True
+        affinity_terms = []
+    for r in (affinity_terms[0] if affinity_terms else []):
         if r.operator == "In":
             terms.append([fold32(f"{r.key}={v}") for v in r.values])
         elif r.operator == "Exists":
@@ -174,7 +210,7 @@ def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
             negs.append(fold32(r.key + _KEY_MARK))
         elif r.operator == "NotIn":
             negs.extend(fold32(f"{r.key}={v}") for v in r.values)
-        else:  # Gt/Lt and friends: not dense-encodable yet
+        else:  # Gt/Lt: numeric label compare — host-check tier (oracle exact)
             lossy = True
     if len(terms) > dims.max_sel_terms or len(negs) > dims.max_neg_terms:
         lossy = True
@@ -209,23 +245,54 @@ def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
     if not _fill(port_hash, [fold32(f"{p}/{proto or 'TCP'}") for p, proto in pod.host_ports]):
         lossy = True
 
-    # --- anti-affinity: dense path covers the common self-anti-affinity-on-hostname
-    #     shape; richer terms go through the host-check tier (SURVEY.md §7 hard part:
-    #     inter-pod affinity breaks pods×nodes independence). ---
-    anti_self = False
+    # --- inter-pod (anti-)affinity + topology spread: the dense path covers
+    #     hostname- and zone-domain terms via resident-count planes
+    #     (AffinityPlanes) and placement-coupled waves (ops/constrained.py);
+    #     other topology keys / extra terms go through the host-check tier
+    #     (SURVEY.md §7 hard part: these break pods×nodes independence). ---
+    enc = _PodSpecEncoding(
+        sel_req, sel_neg, tol_exact, tol_key, tolerate_all, port_hash,
+        anti_affinity_self=False, lossy=lossy, exemplar=pod,
+    )
     for term in pod.anti_affinity:
-        if (
-            term.topology_key == "kubernetes.io/hostname"
-            and term.match_labels
-            and all(pod.labels.get(k) == v for k, v in term.match_labels.items())
-        ):
-            anti_self = True
+        kind = _domain_kind(term.topology_key)
+        if kind == 0:
+            enc.lossy = True
+            continue
+        self_match = term_matches_pod(term, pod, pod)
+        if kind == 1:
+            enc.anti_affinity_self = enc.anti_affinity_self or self_match
+            enc.anti_host_terms.append(term)
         else:
-            lossy = True
-    if pod.pod_affinity or pod.topology_spread_max_skew:
-        lossy = True
+            enc.anti_self_zone = enc.anti_self_zone or self_match
+            enc.anti_zone_terms.append(term)
 
-    return _PodSpecEncoding(sel_req, sel_neg, tol_exact, tol_key, tolerate_all, port_hash, anti_self, lossy)
+    if pod.pod_affinity:
+        if len(pod.pod_affinity) > 1:
+            enc.lossy = True
+        term = pod.pod_affinity[0]
+        kind = _domain_kind(term.topology_key)
+        if kind == 0:
+            enc.lossy = True
+        else:
+            enc.aff_kind = kind
+            enc.aff_term = term
+            enc.aff_self = term_matches_pod(term, pod, pod)
+
+    spreads = pod.spread_constraints()
+    if spreads:
+        if len(spreads) > 1:
+            enc.lossy = True  # first constraint enforced densely; rest host-checked
+        c = spreads[0]
+        kind = _domain_kind(c.topology_key)
+        if kind == 0:
+            enc.lossy = True
+        else:
+            enc.spread_kind = kind
+            enc.max_skew = max(int(c.max_skew), 1)
+            enc.spread_selector = dict(c.match_labels)
+            enc.spread_self = labels_match(c.match_labels, pod.labels)
+    return enc
 
 
 def equivalence_key(pod: Pod) -> int:
@@ -234,12 +301,21 @@ def equivalence_key(pod: Pod) -> int:
     fields spec hash). We hash the predicate-relevant spec directly."""
     parts = [
         pod.namespace,
+        # labels matter to equivalence now: they are the targets of affinity/
+        # spread selectors and decide self-matching
+        repr(sorted(pod.labels.items())),
         repr(sorted(pod.requests.items())),
         repr(sorted(pod.node_selector.items())),
-        repr([(r.key, r.operator, tuple(r.values)) for r in pod.required_node_affinity]),
+        repr([[(r.key, r.operator, tuple(r.values)) for r in term]
+              for term in pod.affinity_node_terms()]),
         repr([(t.key, t.operator, t.value, t.effect) for t in pod.tolerations]),
         repr(pod.host_ports),
-        repr([(sorted(t.match_labels.items()), t.topology_key) for t in pod.anti_affinity]),
+        repr([(sorted(t.match_labels.items()), t.topology_key, t.namespaces)
+              for t in pod.anti_affinity]),
+        repr([(sorted(t.match_labels.items()), t.topology_key, t.namespaces)
+              for t in pod.pod_affinity]),
+        repr([(c.max_skew, c.topology_key, sorted(c.match_labels.items()))
+              for c in pod.spread_constraints()]),
         pod.owner.uid if pod.owner else pod.name,
     ]
     return fold32("|".join(parts))
@@ -306,6 +382,18 @@ class EncodedCluster:
     group_pods: list[list[int]]     # specs row → indices into `pending_pods`
     pending_pods: list[Pod]
     scheduled_pods: list[Pod]
+    planes: AffinityPlanes | None = None
+    has_constraints: bool = False   # any group carries a topology-coupled
+                                    # constraint (selects the constrained
+                                    # kernel variants — a STATIC choice)
+    node_objs: list[Node] = field(default_factory=list)
+
+    def all_nodes_and_pods(self) -> tuple[list[Node], dict[str, list[Pod]]]:
+        """Host view for the exact oracle (utils/oracle.check_pod_in_cluster)."""
+        by_node: dict[str, list[Pod]] = {}
+        for p in self.scheduled_pods:
+            by_node.setdefault(p.node_name, []).append(p)
+        return list(self.node_objs), by_node
 
 
 def encode_cluster(
@@ -429,6 +517,18 @@ def encode_cluster(
     g_anti_self = np.zeros((g_pad,), bool)
     g_valid = np.zeros((g_pad,), bool)
     g_hostcheck = np.zeros((g_pad,), bool)
+    g_spread_kind = np.zeros((g_pad,), np.int32)
+    g_max_skew = np.zeros((g_pad,), np.int32)
+    g_spread_self = np.zeros((g_pad,), bool)
+    g_aff_kind = np.zeros((g_pad,), np.int32)
+    g_aff_self = np.zeros((g_pad,), bool)
+    g_aff_any = np.zeros((g_pad,), bool)
+    g_anti_self_zone = np.zeros((g_pad,), bool)
+
+    # Zone-scoped constraints need every zone to fit the static Z dim; when
+    # the cluster has more zones, those groups fall back to host-check (the
+    # oracle is exact) and the device drops the zone coupling.
+    zones_fit = len(zone_table.ids) + 1 <= dims.max_zones
 
     for row, (req, enc) in enumerate(row_encodings):
         g_req[row] = req
@@ -441,7 +541,89 @@ def encode_cluster(
         g_ports[row] = enc.port_hash
         g_anti_self[row] = enc.anti_affinity_self
         g_valid[row] = True
+        uses_zones = (enc.spread_kind == 2 or enc.aff_kind == 2
+                      or enc.anti_self_zone or enc.anti_zone_terms)
+        if uses_zones and not zones_fit:
+            enc.lossy = True
+            if enc.spread_kind == 2:
+                enc.spread_kind = 0
+            if enc.aff_kind == 2:
+                enc.aff_kind = 0
+            enc.anti_self_zone = False
+            enc.anti_zone_terms = []
+        g_spread_kind[row] = enc.spread_kind
+        g_max_skew[row] = enc.max_skew
+        g_spread_self[row] = enc.spread_self
+        g_aff_kind[row] = enc.aff_kind
+        g_aff_self[row] = enc.aff_self
+        g_anti_self_zone[row] = enc.anti_self_zone
         g_hostcheck[row] = enc.lossy
+
+    # ---- cross-group coupling: a selector of group g matching pods of a
+    # DIFFERENT pending group is not modeled on device (placements of h would
+    # change g's constraint state mid-pack) -> host-check tier. ----
+    pending_rows = [row for row in range(len(row_encodings))
+                    if row_pending_count[row] > 0]
+    for grow in pending_rows:
+        enc_g = row_encodings[grow][1]
+        ex_g = enc_g.exemplar
+        if ex_g is None:
+            continue
+        selectors: list[tuple[AffinityTerm | None, dict[str, str] | None]] = []
+        if enc_g.spread_kind:
+            selectors.append((None, enc_g.spread_selector))
+        selectors.extend((t, None) for t in enc_g.anti_host_terms + enc_g.anti_zone_terms)
+        if not selectors:
+            continue
+        for hrow in pending_rows:
+            if hrow == grow:
+                continue
+            ex_h = row_encodings[hrow][1].exemplar
+            if ex_h is None:
+                continue
+            for term, sel in selectors:
+                if term is not None:
+                    hit = term_matches_pod(term, ex_g, ex_h)
+                else:
+                    hit = (ex_h.namespace == ex_g.namespace
+                           and labels_match(sel or {}, ex_h.labels))
+                if hit:
+                    g_hostcheck[grow] = True
+                    break
+            if g_hostcheck[grow]:
+                break
+
+    # ---- resident-derived constraint planes ----
+    constrained_rows = [
+        row for row, (_, enc) in enumerate(row_encodings)
+        if (enc.spread_kind or enc.aff_kind or enc.anti_host_terms
+            or enc.anti_zone_terms)
+    ]
+    p_aff = np.zeros((g_pad, n_pad), np.int32)
+    p_anti_host = np.zeros((g_pad, n_pad), np.int32)
+    p_anti_zone = np.zeros((g_pad, n_pad), np.int32)
+    p_spread = np.zeros((g_pad, n_pad), np.int32)
+    if constrained_rows:
+        for q in resident:
+            ni = node_index[q.node_name]
+            for row in constrained_rows:
+                enc_row = row_encodings[row][1]
+                ex = enc_row.exemplar
+                if ex is None:
+                    continue
+                if enc_row.aff_term is not None and term_matches_pod(
+                        enc_row.aff_term, ex, q):
+                    p_aff[row, ni] += 1
+                if any(term_matches_pod(t, ex, q) for t in enc_row.anti_host_terms):
+                    p_anti_host[row, ni] += 1
+                if any(term_matches_pod(t, ex, q) for t in enc_row.anti_zone_terms):
+                    p_anti_zone[row, ni] += 1
+                if (enc_row.spread_selector is not None
+                        and q.namespace == ex.namespace
+                        and labels_match(enc_row.spread_selector, q.labels)):
+                    p_spread[row, ni] += 1
+        g_aff_any[:] = p_aff.sum(axis=1) > 0
+    has_constraints = bool(constrained_rows)
 
     return EncodedCluster(
         nodes=_device(NodeTensors(
@@ -454,6 +636,9 @@ def encode_cluster(
             tol_exact=g_tol_exact, tol_key=g_tol_key, tolerate_all=g_tol_all,
             port_hash=g_ports, anti_affinity_self=g_anti_self, valid=g_valid,
             needs_host_check=g_hostcheck,
+            spread_kind=g_spread_kind, max_skew=g_max_skew,
+            spread_self=g_spread_self, aff_kind=g_aff_kind, aff_self=g_aff_self,
+            aff_match_any=g_aff_any, anti_self_zone=g_anti_self_zone,
         )),
         scheduled=_device(ScheduledPodTensors(
             req=s_req, node_idx=s_node, group_ref=s_group, movable=s_movable,
@@ -467,6 +652,12 @@ def encode_cluster(
         group_pods=group_pods,
         pending_pods=pending,
         scheduled_pods=resident,
+        planes=_device(AffinityPlanes(
+            aff_cnt=p_aff, anti_host_cnt=p_anti_host,
+            anti_zone_cnt=p_anti_zone, spread_cnt=p_spread,
+        )),
+        has_constraints=has_constraints,
+        node_objs=list(nodes),
     )
 
 

@@ -1,0 +1,284 @@
+"""Topology-coupled placement: spread skew + inter-pod affinity on device.
+
+Reference counterpart: the vendored PodTopologySpread and InterPodAffinity
+Filter plugins run per (pod, node) by SchedulerPluginRunner
+(simulator/clustersnapshot/predicate/plugin_runner.go:54-143), with Reserve
+side effects making each placement visible to the next pod's check. These are
+the constraints SURVEY.md §7 calls out as breaking pods×nodes independence —
+the FAQ.md:178 predicates that slow the reference ~3 orders of magnitude.
+
+TPU re-design: constraint state lives in small per-domain count tensors.
+Resident pods contribute via encode-time planes (models/cluster_state.py
+AffinityPlanes); the group's OWN placements are tracked inside a bounded
+`lax.while_loop` of placement WAVES:
+
+  each wave computes, per domain, the remaining allowance
+      spread:    min(count over eligible domains) + max_skew - count[d]
+      anti-self: 1 - placed[d]
+  clips the per-node first-fit counts by a segmented within-domain prefix sum,
+  places globally in node-index order, updates the counts, and repeats until
+  no progress. A fixed point of the wave loop admits exactly the placements a
+  serial one-pod-at-a-time greedy (the reference's order) would admit; waves
+  only batch the order.
+
+Positive affinity satisfaction comes from the resident planes, plus — for a
+self-matching selector — domains opened by the group's own placements, with
+the first-pod exception (no match anywhere + self-match => first placement
+unconstrained) bootstrapping a single seed node.
+
+Everything is static-shaped; the wave count is capped (placements beyond the
+cap are conservatively dropped — under-admission never fabricates capacity).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from kubernetes_autoscaler_tpu.models.cluster_state import (
+    AffinityPlanes,
+    NodeTensors,
+    PodGroupTensors,
+)
+from kubernetes_autoscaler_tpu.ops.pack import fit_count
+
+BIG = jnp.int32(1 << 28)
+MAX_WAVES = 128
+
+
+def _zcl(zone_id: jnp.ndarray, max_zones: int) -> jnp.ndarray:
+    return jnp.clip(zone_id, 0, max_zones - 1)
+
+
+def zone_onehot(zone_id: jnp.ndarray, max_zones: int) -> jnp.ndarray:
+    """bool[N, Z]; nodes without a zone label (id 0) contribute to no zone."""
+    oh = _zcl(zone_id, max_zones)[:, None] == jnp.arange(max_zones)[None, :]
+    return oh & (zone_id > 0)[:, None]
+
+
+def zone_agg(plane_gn: jnp.ndarray, zone_id: jnp.ndarray, max_zones: int) -> jnp.ndarray:
+    """i32[G, Z]: per-zone totals of a per-node count plane."""
+    oh = zone_onehot(zone_id, max_zones).astype(jnp.int32)
+    return plane_gn.astype(jnp.int32) @ oh
+
+
+def planes_static_mask(
+    specs: PodGroupTensors,
+    planes: AffinityPlanes,
+    node_zone_id: jnp.ndarray,
+    max_zones: int,
+) -> jnp.ndarray:
+    """bool[G, N]: the resident-derived (placement-independent) part of the
+    topology constraints — anti-affinity blocks, non-self positive-affinity
+    satisfaction, and domain-presence requirements."""
+    n = node_zone_id.shape[0]
+    zcl = _zcl(node_zone_id, max_zones)
+    has_zone = (node_zone_id > 0)[None, :]
+    anti_zone_z = zone_agg(planes.anti_zone_cnt, node_zone_id, max_zones)
+    aff_zone_z = zone_agg(planes.aff_cnt, node_zone_id, max_zones)
+
+    ok = planes.anti_host_cnt == 0
+    ok &= ~(has_zone & (anti_zone_z[:, zcl] > 0))
+    kind = specs.aff_kind
+    aff_ok = jnp.where((kind == 1)[:, None], planes.aff_cnt > 0,
+                       has_zone & (aff_zone_z[:, zcl] > 0))
+    need_static = (kind > 0) & ~specs.aff_self
+    ok &= jnp.where(need_static[:, None], aff_ok, True)
+    # zone-domain constraints need the node to HAVE a zone
+    zone_kinds = (specs.spread_kind == 2) | (kind == 2)
+    ok &= jnp.where(zone_kinds[:, None], has_zone, jnp.ones((1, n), bool))
+    return ok
+
+
+class GroupConstraints(struct.PyTreeNode):
+    """Per-group topology-constraint state over one destination node set.
+
+    Built by `constraints_for_nodes` (real nodes) or inside the estimator
+    (fresh template bins). Leading dim G everywhere; node planes [G, N]."""
+
+    s_kind: jax.Array         # i32[G] 0 none / 1 hostname / 2 zone
+    s_skew: jax.Array         # i32[G]
+    s_self: jax.Array         # bool[G] own placements count toward spread
+    s_cnt_node: jax.Array     # i32[G, N] resident matching counts per node
+    s_elig: jax.Array         # bool[G, N] node's domain eligible for the min
+    a_kind: jax.Array         # i32[G]
+    a_self: jax.Array         # bool[G]
+    a_any: jax.Array          # bool[G] >=1 resident matches (first-pod gate)
+    a_ok_node: jax.Array      # bool[G, N] satisfied-by-residents per node
+    anti_self_zone: jax.Array  # bool[G] at most one of the group per zone
+    cnt_zone_base: jax.Array  # i32[G, Z] spread counts per zone (residents)
+    elig_zone_base: jax.Array  # bool[G, Z] zones eligible for the min
+    min_host_base: jax.Array  # i32[G] min hostname-domain count OUTSIDE this
+                              # node set (BIG when the set covers the world)
+    zone_cl: jax.Array        # i32[N] clipped zone id per node (shared)
+    zone_valid: jax.Array     # bool[N] node has a zone label
+
+    def is_constrained(self) -> jax.Array:
+        return (self.s_kind > 0) | (self.a_kind > 0) | self.anti_self_zone
+
+
+def constraints_for_nodes(
+    specs: PodGroupTensors,
+    planes: AffinityPlanes,
+    nodes: NodeTensors,
+    max_zones: int,
+    sel_mask: jnp.ndarray | None = None,
+) -> GroupConstraints:
+    """Constraint state for packing onto the REAL node set."""
+    from kubernetes_autoscaler_tpu.ops import predicates
+
+    sel = (predicates.selector_match(nodes.label_hash, specs)
+           if sel_mask is None else sel_mask)
+    zval = nodes.zone_id > 0
+    zcl = _zcl(nodes.zone_id, max_zones)
+    elig_host = sel & nodes.valid[None, :]
+    s_elig = jnp.where((specs.spread_kind == 2)[:, None],
+                       elig_host & zval[None, :], elig_host)
+    oh = zone_onehot(nodes.zone_id, max_zones).astype(jnp.int32)
+    aff_zone_z = zone_agg(planes.aff_cnt, nodes.zone_id, max_zones)
+    a_ok = jnp.where((specs.aff_kind == 1)[:, None], planes.aff_cnt > 0,
+                     zval[None, :] & (aff_zone_z[:, zcl] > 0))
+    g = specs.g
+    return GroupConstraints(
+        s_kind=specs.spread_kind, s_skew=specs.max_skew, s_self=specs.spread_self,
+        s_cnt_node=planes.spread_cnt,
+        s_elig=s_elig,
+        a_kind=specs.aff_kind, a_self=specs.aff_self, a_any=specs.aff_match_any,
+        a_ok_node=a_ok,
+        anti_self_zone=specs.anti_self_zone,
+        cnt_zone_base=planes.spread_cnt.astype(jnp.int32) @ oh,
+        elig_zone_base=(s_elig.astype(jnp.int32) @ oh) > 0,
+        min_host_base=jnp.full((g,), BIG, jnp.int32),
+        zone_cl=zcl,
+        zone_valid=zval,
+    )
+
+
+def place_group_constrained(
+    free: jnp.ndarray,       # i32[N, R]
+    feas_n: jnp.ndarray,     # bool[N] full feasibility for this group
+    req: jnp.ndarray,        # i32[R]
+    want: jnp.ndarray,       # i32 scalar
+    limit_one: jnp.ndarray,  # bool scalar
+    cons: GroupConstraints,  # gathered to one group (leading G dim removed)
+    max_zones: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Wave-greedy placement of one constrained group; returns (free', place[N])."""
+    n = feas_n.shape[0]
+    oh = (cons.zone_cl[:, None] == jnp.arange(max_zones)[None, :]) & cons.zone_valid[:, None]
+    ohi = oh.astype(jnp.int32)
+
+    def cond(st):
+        _, _, rem, it, done = st
+        return (rem > 0) & ~done & (it < MAX_WAVES)
+
+    def body(st):
+        free_c, placed, rem, it, _ = st
+        fit = jnp.minimum(fit_count(free_c, req), rem)
+        fit = jnp.where(feas_n, fit, 0)
+        fit = jnp.where(limit_one,
+                        jnp.clip(1 - (placed > 0).astype(jnp.int32), 0, fit), fit)
+
+        # --- positive affinity: resident-satisfied, self-opened, or bootstrap
+        zone_placed = (placed[:, None] * ohi).sum(axis=0)          # i32[Z]
+        open_host = placed > 0
+        open_zone = cons.zone_valid & (zone_placed[cons.zone_cl] > 0)
+        dom_open = jnp.where(cons.a_kind == 1, open_host, open_zone)
+        aff_ok = cons.a_ok_node | (cons.a_self & dom_open)
+        can = feas_n & (fit > 0)
+        bootstrap = (cons.a_kind > 0) & cons.a_self & ~cons.a_any & (placed.sum() == 0)
+        first = jnp.argmax(can)
+        boot_mask = (jnp.arange(n) == first) & can.any()
+        aff_ok = jnp.where(bootstrap, boot_mask,
+                           jnp.where(cons.a_kind > 0, aff_ok, True))
+        fit = jnp.where(aff_ok, fit, 0)
+
+        # --- hostname-domain spread: per-node allowance
+        cnt_n = cons.s_cnt_node + jnp.where(cons.s_self, placed, 0)
+        elig_cnt = jnp.where(cons.s_elig, cnt_n, BIG)
+        min_h = jnp.minimum(jnp.min(elig_cnt), cons.min_host_base)
+        min_h = jnp.where(min_h >= BIG, 0, min_h)
+        allow_h = jnp.clip(min_h + cons.s_skew - cnt_n, 0, None)
+        fit = jnp.where(cons.s_kind == 1, jnp.minimum(fit, allow_h), fit)
+
+        # --- zone-domain caps: spread allowance and/or anti-self 1-per-zone
+        cnt_z = cons.cnt_zone_base + jnp.where(cons.s_self, zone_placed, 0)
+        min_z = jnp.min(jnp.where(cons.elig_zone_base, cnt_z, BIG))
+        min_z = jnp.where(min_z >= BIG, 0, min_z)
+        allow_z = jnp.clip(min_z + cons.s_skew - cnt_z, 0, None)
+        zone_cap = jnp.where(cons.s_kind == 2, allow_z, BIG)
+        zone_cap = jnp.where(cons.anti_self_zone,
+                             jnp.minimum(zone_cap, jnp.clip(1 - zone_placed, 0, None)),
+                             zone_cap)
+        # keyless nodes have no zone domain: uncapped by zone constraints
+        # (zone-domain kinds already excluded them via the static mask)
+        excl = ((jnp.cumsum(fit[:, None] * ohi, axis=0) - fit[:, None] * ohi) * ohi).sum(axis=1)
+        capped = jnp.clip(zone_cap[cons.zone_cl] - excl, 0, None)
+        fit_z = jnp.where(cons.zone_valid, jnp.minimum(fit, capped), fit)
+
+        # --- global first-fit in node-index order
+        cum = jnp.cumsum(fit_z)
+        place = jnp.clip(rem - (cum - fit_z), 0, fit_z)
+        n_placed = place.sum()
+        return (free_c - place[:, None] * req[None, :], placed + place,
+                rem - n_placed, it + 1, n_placed == 0)
+
+    init = (free, jnp.zeros((n,), jnp.int32), want.astype(jnp.int32),
+            jnp.int32(0), jnp.bool_(False))
+    free_out, placed, _, _, _ = jax.lax.while_loop(cond, body, init)
+    return free_out, placed
+
+
+def pack_groups_constrained(
+    free: jnp.ndarray,       # i32[N, R]
+    mask: jnp.ndarray,       # bool[G, N] full static feasibility (planes included)
+    req: jnp.ndarray,        # i32[G, R]
+    count: jnp.ndarray,      # i32[G]
+    order: jnp.ndarray,      # i32[G]
+    limit_one: jnp.ndarray,  # bool[G]
+    cons: GroupConstraints,
+    max_zones: int,
+):
+    """First-fit-decreasing pack with topology-coupled groups handled by the
+    wave placer; unconstrained groups take the one-shot fast path (identical
+    to ops/pack.pack_groups)."""
+    from kubernetes_autoscaler_tpu.ops.pack import PackResult
+
+    is_con = cons.is_constrained()
+
+    def step(free_c, g):
+        reqg = req[g]
+
+        def fast(fr):
+            c = fit_count(fr, reqg)
+            c = jnp.where(mask[g], c, 0)
+            c = jnp.where(limit_one[g], jnp.minimum(c, 1), c)
+            c = jnp.minimum(c, count[g])
+            cum = jnp.cumsum(c)
+            place = jnp.clip(count[g] - (cum - c), 0, c)
+            return fr - place[:, None] * reqg[None, :], place
+
+        def slow(fr):
+            cg = GroupConstraints(
+                s_kind=cons.s_kind[g], s_skew=cons.s_skew[g], s_self=cons.s_self[g],
+                s_cnt_node=cons.s_cnt_node[g], s_elig=cons.s_elig[g],
+                a_kind=cons.a_kind[g], a_self=cons.a_self[g], a_any=cons.a_any[g],
+                a_ok_node=cons.a_ok_node[g],
+                anti_self_zone=cons.anti_self_zone[g],
+                cnt_zone_base=cons.cnt_zone_base[g],
+                elig_zone_base=cons.elig_zone_base[g],
+                min_host_base=cons.min_host_base[g],
+                zone_cl=cons.zone_cl, zone_valid=cons.zone_valid,
+            )
+            return place_group_constrained(
+                fr, mask[g], reqg, count[g], limit_one[g], cg, max_zones
+            )
+
+        free_c, place = jax.lax.cond(is_con[g], slow, fast, free_c)
+        return free_c, place
+
+    free_after, placed_in_order = jax.lax.scan(step, free, order)
+    placed = jnp.zeros_like(placed_in_order).at[order].set(placed_in_order)
+    return PackResult(free_after=free_after, placed=placed,
+                      scheduled=placed.sum(axis=-1))

@@ -33,6 +33,8 @@ from kubernetes_autoscaler_tpu.models.api import (
     AffinityTerm,
     Node,
     Pod,
+    labels_match,
+    term_matches_pod,
 )
 from kubernetes_autoscaler_tpu.models.encode import (
     node_capacity_vector,
@@ -143,22 +145,7 @@ def topology_value(node: Node, key: str) -> str | None:
     return node.labels.get(key)
 
 
-def labels_match(selector: dict[str, str], labels: dict[str, str]) -> bool:
-    """match_labels subset test. An EMPTY selector matches no pods — both the
-    spread and affinity encodings here treat {} as 'selects nothing'."""
-    if not selector:
-        return False
-    return all(labels.get(k) == v for k, v in selector.items())
-
-
-def _term_namespaces(term: AffinityTerm, pod: Pod) -> tuple[str, ...]:
-    return term.namespaces or (pod.namespace,)
-
-
-def _term_matches_pod(term: AffinityTerm, pod: Pod, other: Pod) -> bool:
-    return other.namespace in _term_namespaces(term, pod) and labels_match(
-        term.match_labels, other.labels
-    )
+_term_matches_pod = term_matches_pod  # canonical impl lives in models/api.py
 
 
 # ---- cluster-wide constraints -------------------------------------------

@@ -1,0 +1,217 @@
+"""Property tests: the constrained pack (ops/constrained.py) vs the exact
+serial oracle (utils/oracle.py) — topology spread, inter-pod affinity and
+anti-affinity placements must agree with a one-pod-at-a-time greedy that asks
+the oracle before every placement.
+
+Reference analog: predicate_snapshot_test.go exercising the vendored
+PodTopologySpread/InterPodAffinity plugins through SchedulePod.
+"""
+
+import copy
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.api import (
+    AffinityTerm,
+    TopologySpreadConstraint,
+)
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.ops import constrained, predicates
+from kubernetes_autoscaler_tpu.ops.pack import ffd_order
+from kubernetes_autoscaler_tpu.utils import oracle
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+ZONE = "topology.kubernetes.io/zone"
+HOST = "kubernetes.io/hostname"
+
+
+def _pack(nodes, pods, max_zones=16):
+    enc = encode_cluster(nodes, pods)
+    mask = predicates.feasibility_mask(enc.nodes, enc.specs, check_resources=False)
+    mask = mask & constrained.planes_static_mask(
+        enc.specs, enc.planes, enc.nodes.zone_id, max_zones)
+    cons = constrained.constraints_for_nodes(
+        enc.specs, enc.planes, enc.nodes, max_zones)
+    order = ffd_order(enc.specs.req, enc.specs.valid & (enc.specs.count > 0))
+    count = jnp.where(enc.specs.valid, enc.specs.count, 0)
+    res = constrained.pack_groups_constrained(
+        enc.nodes.free(), mask, enc.specs.req, count, order,
+        enc.specs.one_per_node(), cons, max_zones)
+    return enc, np.asarray(res.placed), np.asarray(order)
+
+
+def _serial_greedy(enc, nodes, order):
+    """One-pod-at-a-time first-fit greedy asking the oracle for every
+    placement, in the pack's group order — the reference's serial semantics."""
+    by_node = {}
+    for p in enc.scheduled_pods:
+        by_node.setdefault(p.node_name, []).append(p)
+    placed = np.zeros((enc.specs.g, len(nodes)), dtype=np.int64)
+    for g in order:
+        if g >= len(enc.group_pods) or not enc.group_pods[g]:
+            continue
+        for pi in enc.group_pods[g]:
+            pod = enc.pending_pods[pi]
+            for ni, nd in enumerate(nodes):
+                if oracle.check_pod_in_cluster(pod, nd, nodes, by_node):
+                    clone = copy.deepcopy(pod)
+                    clone.node_name = nd.name
+                    clone.phase = "Running"
+                    by_node.setdefault(nd.name, []).append(clone)
+                    placed[g, ni] += 1
+                    break
+    return placed
+
+
+def _check_match(nodes, pods):
+    enc, placed, order = _pack(nodes, pods)
+    want = _serial_greedy(enc, nodes, order)
+    got = placed[:, : len(nodes)]
+    np.testing.assert_array_equal(
+        got[: want.shape[0]], want,
+        err_msg=f"pack={got[:want.shape[0]].tolist()} oracle={want.tolist()}")
+
+
+def test_spread_zone_pack_matches_oracle():
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192, zone=z)
+             for i, z in enumerate(["a", "a", "b", "c"])]
+    res = build_test_pod("r0", cpu_milli=10, mem_mib=10, labels={"app": "w"},
+                         node_name="n0")
+    res.phase = "Running"
+    pending = []
+    for i in range(6):
+        p = build_test_pod(f"p{i}", cpu_milli=10, mem_mib=10, labels={"app": "w"},
+                           owner_name="w-rs")
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE, match_labels={"app": "w"})]
+        pending.append(p)
+    _check_match(nodes, [res] + pending)
+
+
+def test_spread_hostname_pack_matches_oracle():
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192)
+             for i in range(4)]
+    pending = []
+    for i in range(7):
+        p = build_test_pod(f"p{i}", cpu_milli=10, mem_mib=10, labels={"app": "h"},
+                           owner_name="h-rs")
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=2, topology_key=HOST, match_labels={"app": "h"})]
+        pending.append(p)
+    _check_match(nodes, pending)
+
+
+def test_positive_affinity_zone_pack():
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192, zone=z)
+             for i, z in enumerate(["a", "b", "b"])]
+    db = build_test_pod("db", cpu_milli=10, mem_mib=10, labels={"app": "db"},
+                        node_name="n1")
+    db.phase = "Running"
+    pending = []
+    for i in range(3):
+        p = build_test_pod(f"w{i}", cpu_milli=10, mem_mib=10, labels={"app": "w"},
+                           owner_name="w-rs")
+        p.pod_affinity = [AffinityTerm(match_labels={"app": "db"}, topology_key=ZONE)]
+        pending.append(p)
+    _check_match(nodes, [db] + pending)
+
+
+def test_self_affinity_gang_on_hostname():
+    # all replicas demand co-location on one host (self-affinity, hostname):
+    # first-pod exception seeds a node, the rest must follow or fail
+    nodes = [build_test_node(f"n{i}", cpu_milli=1000, mem_mib=8192, pods=100)
+             for i in range(3)]
+    pending = []
+    for i in range(4):
+        p = build_test_pod(f"g{i}", cpu_milli=300, mem_mib=10, labels={"app": "gang"},
+                           owner_name="gang-rs")
+        p.pod_affinity = [AffinityTerm(match_labels={"app": "gang"}, topology_key=HOST)]
+        pending.append(p)
+    # 1000m cpu / 300m -> 3 per node; 4th pod cannot co-locate and must fail
+    _check_match(nodes, pending)
+
+
+def test_anti_affinity_zone_self_pack():
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192, zone=z)
+             for i, z in enumerate(["a", "a", "b"])]
+    pending = []
+    for i in range(3):
+        p = build_test_pod(f"a{i}", cpu_milli=10, mem_mib=10, labels={"app": "za"},
+                           owner_name="za-rs")
+        p.anti_affinity = [AffinityTerm(match_labels={"app": "za"}, topology_key=ZONE)]
+        pending.append(p)
+    # 2 zones -> only 2 of 3 place, one per zone
+    _check_match(nodes, pending)
+
+
+def test_unconstrained_groups_identical_to_fast_path():
+    from kubernetes_autoscaler_tpu.ops.pack import pack_groups
+
+    nodes = [build_test_node(f"n{i}", cpu_milli=2000, mem_mib=4096, zone="a")
+             for i in range(5)]
+    pods = [build_test_pod(f"p{i}", cpu_milli=700, mem_mib=512, owner_name="rs")
+            for i in range(9)]
+    enc = encode_cluster(nodes, pods)
+    mask = predicates.feasibility_mask(enc.nodes, enc.specs, check_resources=False)
+    maskp = mask & constrained.planes_static_mask(
+        enc.specs, enc.planes, enc.nodes.zone_id, 16)
+    cons = constrained.constraints_for_nodes(enc.specs, enc.planes, enc.nodes, 16)
+    order = ffd_order(enc.specs.req, enc.specs.valid & (enc.specs.count > 0))
+    count = jnp.where(enc.specs.valid, enc.specs.count, 0)
+    a = constrained.pack_groups_constrained(
+        enc.nodes.free(), maskp, enc.specs.req, count, order,
+        enc.specs.one_per_node(), cons, 16)
+    b = pack_groups(enc.nodes.free(), mask, enc.specs.req, count, order,
+                    enc.specs.one_per_node())
+    np.testing.assert_array_equal(np.asarray(a.placed), np.asarray(b.placed))
+
+
+def test_randomized_topology_pack_matches_oracle():
+    rng = random.Random(7)
+    for trial in range(6):
+        zones = ["a", "b", "c"][: rng.randint(1, 3)]
+        nodes = [
+            build_test_node(f"n{i}", cpu_milli=rng.choice([500, 1000, 2000]),
+                            mem_mib=4096, zone=rng.choice(zones))
+            for i in range(rng.randint(2, 6))
+        ]
+        pods = []
+        # residents
+        for i in range(rng.randint(0, 4)):
+            q = build_test_pod(f"r{i}", cpu_milli=100, mem_mib=32,
+                               labels={"app": rng.choice(["w", "db"])},
+                               node_name=rng.choice(nodes).name)
+            q.phase = "Running"
+            pods.append(q)
+        # pending constrained groups
+        for gi in range(rng.randint(1, 3)):
+            kind = rng.choice(["spread", "aff", "anti"])
+            app = rng.choice(["w", "db"])
+            n_pods = rng.randint(1, 5)
+            for i in range(n_pods):
+                p = build_test_pod(f"g{gi}p{i}", cpu_milli=100, mem_mib=32,
+                                   labels={"app": app, "grp": str(gi)},
+                                   owner_name=f"rs-{gi}")
+                if kind == "spread":
+                    p.topology_spread = [TopologySpreadConstraint(
+                        max_skew=rng.randint(1, 2), topology_key=ZONE,
+                        match_labels={"app": app, "grp": str(gi)})]
+                elif kind == "aff":
+                    p.pod_affinity = [AffinityTerm(
+                        match_labels={"app": app, "grp": str(gi)},
+                        topology_key=rng.choice([ZONE, HOST]))]
+                else:
+                    p.anti_affinity = [AffinityTerm(
+                        match_labels={"app": app, "grp": str(gi)},
+                        topology_key=rng.choice([ZONE, HOST]))]
+                pods.append(p)
+        enc, placed, order = _pack(nodes, pods)
+        flagged = np.asarray(enc.specs.needs_host_check)
+        if flagged[np.asarray(enc.specs.count) > 0].any():
+            continue  # cross-group coupling -> host-check tier, not the kernel
+        want = _serial_greedy(enc, nodes, order)
+        np.testing.assert_array_equal(
+            placed[:, : len(nodes)][: want.shape[0]], want,
+            err_msg=f"trial {trial}")
