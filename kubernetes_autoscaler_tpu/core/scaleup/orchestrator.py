@@ -128,6 +128,9 @@ class ScaleUpOrchestrator:
                 ClusterCapacityThresholdLimiter(self.options.max_nodes_total),
                 SngCapacityThresholdLimiter(),
             ],
+            planes=enc.planes,
+            nodes=enc.nodes,
+            with_constraints=enc.has_constraints,
         )
         templates = [
             (g.template_node_info(), g.max_size() - g.target_size(),
@@ -187,12 +190,15 @@ class ScaleUpOrchestrator:
         pods masked out so node_count/waste/price reflect only pods that will
         actually schedule. Plays the role of the reference's real scheduler
         framework run — predicate truth always comes from exact semantics
-        before actuation."""
+        before actuation. The oracle sees the FULL cluster (nodes + resident
+        pods), so topology spread / inter-pod affinity / multi-term node
+        affinity are all evaluated exactly (check_pod_on_new_node)."""
         import jax.numpy as jnp
 
         flagged = np.asarray(enc.specs.needs_host_check)
         if not flagged.any():
             return options
+        all_nodes, pods_by_node = enc.all_nodes_and_pods()
         scheduled = np.asarray(est.scheduled)  # [NG, G]
         out = []
         for opt in options:
@@ -203,7 +209,9 @@ class ScaleUpOrchestrator:
                     continue
                 if gi < len(enc.group_pods) and enc.group_pods[gi]:
                     exemplar = enc.pending_pods[enc.group_pods[gi][0]]
-                    if not oracle.check_pod_on_node(exemplar, g_t, []):
+                    if not oracle.check_pod_on_new_node(
+                            exemplar, g_t, all_nodes, pods_by_node,
+                            registry=enc.registry):
                         refuted.append(int(gi))
             if not refuted:
                 out.append(opt)

@@ -75,10 +75,16 @@ class BinpackingEstimator:
     """Per-node-group Estimate() parity wrapper over the batched kernel."""
 
     def __init__(self, dims: Dims, max_new_nodes_static: int = 1024,
-                 limiters: list[EstimationLimiter] | None = None):
+                 limiters: list[EstimationLimiter] | None = None,
+                 planes=None, nodes=None, with_constraints: bool = False):
         self.dims = dims
         self.max_new_nodes_static = max_new_nodes_static
         self.limiters = limiters or [StaticThresholdLimiter()]
+        # topology-coupled constraint context (ops/constrained.py): the real
+        # cluster's resident planes + node tensors, threaded into estimate_all
+        self.planes = planes
+        self.nodes = nodes
+        self.with_constraints = with_constraints
 
     def estimate(
         self,
@@ -96,7 +102,9 @@ class BinpackingEstimator:
         capped = group_tensors.replace(
             max_new=group_tensors.max_new.at[group_index].min(limit)
         )
-        result = estimate_all(specs, capped, self.dims, self.max_new_nodes_static)
+        result = estimate_all(specs, capped, self.dims, self.max_new_nodes_static,
+                              planes=self.planes, nodes=self.nodes,
+                              with_constraints=self.with_constraints)
         return int(result.node_count[group_index]), np.asarray(result.scheduled[group_index])
 
     def estimate_all_groups(
@@ -114,7 +122,9 @@ class BinpackingEstimator:
         capped = group_tensors.replace(
             max_new=jnp.minimum(group_tensors.max_new, jnp.asarray(caps, jnp.int32))
         )
-        return estimate_all(specs, capped, self.dims, self.max_new_nodes_static)
+        return estimate_all(specs, capped, self.dims, self.max_new_nodes_static,
+                            planes=self.planes, nodes=self.nodes,
+                            with_constraints=self.with_constraints)
 
 
 def build_estimator(name: str, dims: Dims, **kw) -> BinpackingEstimator:

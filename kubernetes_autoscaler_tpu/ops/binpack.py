@@ -64,14 +64,29 @@ def estimate_all(
     groups: NodeGroupTensors,
     dims: Dims,
     max_new_nodes: int,
+    planes=None,
+    nodes=None,
+    with_constraints: bool = False,
 ) -> EstimateResult:
-    """Compute every node group's expansion option for the pending pod set."""
+    """Compute every node group's expansion option for the pending pod set.
+
+    `with_constraints` (STATIC) routes through the topology-coupled pack:
+    fresh template bins inherit the template's zone, so zone-level spread
+    counts / affinity satisfaction from the REAL cluster (planes over `nodes`)
+    carry into the estimate — the reference gets this for free because its
+    estimator schedules against the forked real snapshot
+    (binpacking_estimator.go:126)."""
     tmpl_nodes = groups.as_node_tensors(dims)
     # bool[G, NG]: placement-independent predicates vs each template
     # (capacity is enforced by the packer against the empty bins).
     mask_gt = predicates.feasibility_mask(tmpl_nodes, specs, check_resources=False)
     order = ffd_order(specs.req, specs.valid & (specs.count > 0))
     count = jnp.where(specs.valid, specs.count, 0)
+
+    if with_constraints and planes is not None and nodes is not None:
+        return _estimate_constrained(
+            specs, groups, dims, max_new_nodes, planes, nodes,
+            mask_gt, order, count)
 
     if pack_backend() == "pallas":
         from kubernetes_autoscaler_tpu.ops.pallas.pack_kernel import (
@@ -109,6 +124,112 @@ def estimate_all(
 
     node_count, scheduled, pods_per_node, free_after = jax.vmap(one_group)(
         groups.cap, groups.max_new, mask_gt.T
+    )
+    node_count = jnp.where(groups.valid, node_count, 0)
+    scheduled = scheduled * groups.valid[:, None]
+    return EstimateResult(
+        node_count=node_count,
+        scheduled=scheduled,
+        pods_per_node=pods_per_node,
+        free_after=free_after,
+        template_fits=mask_gt.T,
+    )
+
+
+def _estimate_constrained(
+    specs: PodGroupTensors,
+    groups: NodeGroupTensors,
+    dims: Dims,
+    max_new_nodes: int,
+    planes,
+    nodes,
+    mask_gt: jax.Array,   # bool[G, NG]
+    order: jax.Array,
+    count: jax.Array,
+) -> EstimateResult:
+    """Topology-aware expansion options: every fresh bin carries the template's
+    zone; resident-derived zone state comes from the real cluster."""
+    from kubernetes_autoscaler_tpu.ops import constrained
+    from kubernetes_autoscaler_tpu.ops.constrained import (
+        BIG,
+        GroupConstraints,
+        zone_agg,
+    )
+
+    z_dim = dims.max_zones
+    g = specs.g
+    m = max_new_nodes
+
+    # cluster-wide aggregates over REAL nodes
+    sel_real = predicates.selector_match(nodes.label_hash, specs)       # [G, N]
+    zval_real = nodes.zone_id > 0
+    elig_host_real = sel_real & nodes.valid[None, :]
+    s_elig_real = jnp.where((specs.spread_kind == 2)[:, None],
+                            elig_host_real & zval_real[None, :], elig_host_real)
+    cnt_zone = zone_agg(planes.spread_cnt, nodes.zone_id, z_dim)        # [G, Z]
+    elig_zone = zone_agg(s_elig_real.astype(jnp.int32), nodes.zone_id, z_dim) > 0
+    aff_zone = zone_agg(planes.aff_cnt, nodes.zone_id, z_dim)
+    anti_zone = zone_agg(planes.anti_zone_cnt, nodes.zone_id, z_dim)
+    min_host = jnp.min(
+        jnp.where(s_elig_real, planes.spread_cnt, BIG), axis=1
+    ).astype(jnp.int32)                                                 # [G]
+
+    # template-level static gates (fresh node in the template's zone)
+    tzc = jnp.clip(groups.zone_id, 0, z_dim - 1)                        # [NG]
+    tval = groups.zone_id > 0
+    anti_at_t = jnp.where(tval[None, :], anti_zone[:, tzc], 0)
+    gate = anti_at_t == 0
+    aff_ok_t = tval[None, :] & (aff_zone[:, tzc] > 0)
+    need_static = (specs.aff_kind > 0) & ~specs.aff_self
+    # hostname-affinity (kind 1) can never be resident-satisfied on a fresh
+    # node; zone-affinity needs a matching resident in the template's zone
+    aff_gate = jnp.where((specs.aff_kind == 2)[:, None], aff_ok_t, False)
+    gate &= jnp.where(need_static[:, None], aff_gate, True)
+    zone_kinds = (specs.spread_kind == 2) | (specs.aff_kind == 2)
+    gate &= jnp.where(zone_kinds[:, None], tval[None, :],
+                      jnp.ones_like(tval)[None, :])
+    mask_gt = mask_gt & gate
+    sel_t = predicates.selector_match(
+        groups.as_node_tensors(dims).label_hash, specs)                 # [G, NG]
+
+    limit_one = specs.one_per_node()
+
+    def one_group(cap_row, max_new, feas_col, sel_col, tzc_s, tval_s):
+        r = cap_row.shape[0]
+        free0 = jnp.broadcast_to(cap_row[None, :], (m, r))
+        bin_open = jnp.arange(m, dtype=jnp.int32) < max_new
+        mask = feas_col[:, None] & bin_open[None, :]                    # [G, M]
+        s_elig_bins = sel_col[:, None] & bin_open[None, :]
+        s_elig_bins &= jnp.where((specs.spread_kind == 2)[:, None], tval_s, True)
+        a_ok_bins = jnp.broadcast_to(
+            (((specs.aff_kind == 2) & tval_s) & (aff_zone[:, tzc_s] > 0))[:, None],
+            (g, m))
+        elig_zone_bins = elig_zone | (
+            (jnp.arange(z_dim) == tzc_s)[None, :]
+            & (sel_col & tval_s)[:, None])
+        cons = GroupConstraints(
+            s_kind=specs.spread_kind, s_skew=specs.max_skew,
+            s_self=specs.spread_self,
+            s_cnt_node=jnp.zeros((g, m), jnp.int32),
+            s_elig=s_elig_bins,
+            a_kind=specs.aff_kind, a_self=specs.aff_self,
+            a_any=specs.aff_match_any,
+            a_ok_node=a_ok_bins,
+            anti_self_zone=specs.anti_self_zone,
+            cnt_zone_base=cnt_zone,
+            elig_zone_base=elig_zone_bins,
+            min_host_base=min_host,
+            zone_cl=jnp.full((m,), tzc_s, jnp.int32),
+            zone_valid=jnp.full((m,), tval_s, bool),
+        )
+        res = constrained.pack_groups_constrained(
+            free0, mask, specs.req, count, order, limit_one, cons, z_dim)
+        pods_per_node = res.placed.sum(axis=0)
+        node_cnt = (pods_per_node > 0).sum().astype(jnp.int32)
+        return node_cnt, res.scheduled, pods_per_node, res.free_after
+
+    node_count, scheduled, pods_per_node, free_after = jax.vmap(one_group)(
+        groups.cap, groups.max_new, mask_gt.T, sel_t.T, tzc, tval
     )
     node_count = jnp.where(groups.valid, node_count, 0)
     scheduled = scheduled * groups.valid[:, None]

@@ -40,18 +40,33 @@ def schedule_pending_on_existing(
     nodes: NodeTensors,
     specs: PodGroupTensors,
     scheduled: ScheduledPodTensors | None = None,
+    planes=None,
+    max_zones: int = 16,
+    with_constraints: bool = False,
 ) -> PackResult:
     """First-fit all pending groups onto current free capacity.
 
     Returns a PackResult whose `scheduled` says how many pods of each group fit
     the existing cluster — those are removed from the scale-up problem, exactly
-    the role of filter-out-schedulable in RunOnce (static_autoscaler.go:530)."""
+    the role of filter-out-schedulable in RunOnce (static_autoscaler.go:530).
+
+    `with_constraints` (STATIC) selects the topology-coupled pack
+    (ops/constrained.py) when the snapshot carries spread/affinity groups."""
     mask = predicates.feasibility_mask(nodes, specs, check_resources=False)
     if scheduled is not None:
         resident = resident_group_counts(scheduled, specs.g, nodes.n)
         mask = mask & ~(specs.anti_affinity_self[:, None] & (resident > 0))
     order = ffd_order(specs.req, specs.valid & (specs.count > 0))
     count = jnp.where(specs.valid, specs.count, 0)
+    if with_constraints and planes is not None:
+        from kubernetes_autoscaler_tpu.ops import constrained
+
+        mask = mask & constrained.planes_static_mask(
+            specs, planes, nodes.zone_id, max_zones)
+        cons = constrained.constraints_for_nodes(specs, planes, nodes, max_zones)
+        return constrained.pack_groups_constrained(
+            nodes.free(), mask, specs.req, count, order,
+            specs.one_per_node(), cons, max_zones)
     return pack_groups(
         nodes.free(), mask, specs.req, count, order, specs.one_per_node()
     )

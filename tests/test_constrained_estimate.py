@@ -1,0 +1,118 @@
+"""Topology-aware expansion options: estimate_all with constraints routes
+spread/affinity state from the REAL cluster into fresh template bins.
+
+Reference analog: BinpackingNodeEstimator's topology-spread special case
+(estimator/binpacking_estimator.go:212-227) and estimating against the forked
+real snapshot (:126), which makes zone state visible to new nodes.
+"""
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.api import (
+    AffinityTerm,
+    TopologySpreadConstraint,
+)
+from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster, encode_node_groups
+from kubernetes_autoscaler_tpu.ops.binpack import estimate_all
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+ZONE = "topology.kubernetes.io/zone"
+HOST = "kubernetes.io/hostname"
+
+
+def _estimate(nodes, pods, templates, max_new=8):
+    enc = encode_cluster(nodes, pods)
+    groups = encode_node_groups(
+        [(t, m, 1.0) for t, m in templates], enc.registry, enc.zone_table,
+        enc.dims)
+    est = estimate_all(enc.specs, groups, enc.dims, max_new,
+                       planes=enc.planes, nodes=enc.nodes,
+                       with_constraints=enc.has_constraints)
+    return enc, est
+
+
+def test_zone_spread_estimate_prefers_empty_zone():
+    # zone a holds 2 matching residents; zone b none. A zone-a template can
+    # accept NO spread pod (skew would hit 3); a zone-b template takes 3
+    # (counts equalize at min+skew with min tracking zone b's growth).
+    nodes = [
+        build_test_node("a0", cpu_milli=100, mem_mib=256, zone="a"),
+        build_test_node("b0", cpu_milli=100, mem_mib=256, zone="b"),
+    ]
+    residents = []
+    for i in range(2):
+        q = build_test_pod(f"r{i}", cpu_milli=10, mem_mib=10,
+                           labels={"app": "w"}, node_name="a0")
+        q.phase = "Running"
+        residents.append(q)
+    pending = []
+    for i in range(4):
+        p = build_test_pod(f"p{i}", cpu_milli=100, mem_mib=64,
+                           labels={"app": "w"}, owner_name="w-rs")
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE, match_labels={"app": "w"})]
+        pending.append(p)
+    tmpl_a = build_test_node("tmpl-a", cpu_milli=4000, mem_mib=8192, zone="a")
+    tmpl_b = build_test_node("tmpl-b", cpu_milli=4000, mem_mib=8192, zone="b")
+    enc, est = _estimate(nodes, residents + pending, [(tmpl_a, 8), (tmpl_b, 8)])
+    g = next(i for i, idxs in enumerate(enc.group_pods) if idxs)
+    scheduled = np.asarray(est.scheduled)
+    assert scheduled[0, g] == 0, "zone-a option must refuse all spread pods"
+    assert scheduled[1, g] == 3, "zone-b option equalizes to min+skew"
+
+
+def test_zone_affinity_estimate_needs_matching_zone():
+    nodes = [build_test_node("b0", cpu_milli=4000, mem_mib=8192, zone="b")]
+    db = build_test_pod("db", cpu_milli=10, mem_mib=10, labels={"app": "db"},
+                        node_name="b0")
+    db.phase = "Running"
+    pending = []
+    for i in range(3):
+        p = build_test_pod(f"w{i}", cpu_milli=100, mem_mib=64,
+                           labels={"app": "w"}, owner_name="w-rs")
+        p.pod_affinity = [AffinityTerm(match_labels={"app": "db"},
+                                       topology_key=ZONE)]
+        pending.append(p)
+    tmpl_a = build_test_node("tmpl-a", cpu_milli=4000, mem_mib=8192, zone="a")
+    tmpl_b = build_test_node("tmpl-b", cpu_milli=4000, mem_mib=8192, zone="b")
+    enc, est = _estimate(nodes, [db] + pending, [(tmpl_a, 4), (tmpl_b, 4)])
+    g = next(i for i, idxs in enumerate(enc.group_pods) if idxs)
+    scheduled = np.asarray(est.scheduled)
+    assert scheduled[0, g] == 0, "zone a has no matching db pod"
+    assert scheduled[1, g] == 3, "zone b satisfies the affinity term"
+
+
+def test_self_affinity_gang_colocates_on_one_fresh_node():
+    # no residents anywhere: first-pod exception seeds ONE bin; the gang
+    # co-locates there up to its capacity, the rest stay pending
+    pending = []
+    for i in range(5):
+        p = build_test_pod(f"g{i}", cpu_milli=1000, mem_mib=64,
+                           labels={"app": "gang"}, owner_name="gang-rs")
+        p.pod_affinity = [AffinityTerm(match_labels={"app": "gang"},
+                                       topology_key=HOST)]
+        pending.append(p)
+    tmpl = build_test_node("tmpl", cpu_milli=3000, mem_mib=8192)
+    enc, est = _estimate([], pending, [(tmpl, 8)])
+    g = next(i for i, idxs in enumerate(enc.group_pods) if idxs)
+    assert int(np.asarray(est.scheduled)[0, g]) == 3   # 3000m / 1000m per pod
+    assert int(np.asarray(est.node_count)[0]) == 1     # all on one node
+
+
+def test_hostname_spread_estimate_spreads_across_fresh_bins():
+    pending = []
+    for i in range(6):
+        p = build_test_pod(f"h{i}", cpu_milli=100, mem_mib=64,
+                           labels={"app": "h"}, owner_name="h-rs")
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=HOST, match_labels={"app": "h"})]
+        pending.append(p)
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    enc, est = _estimate([], pending, [(tmpl, 4)])
+    g = next(i for i, idxs in enumerate(enc.group_pods) if idxs)
+    assert int(np.asarray(est.scheduled)[0, g]) == 6
+    per_node = np.asarray(est.pods_per_node)[0]
+    # 6 pods over 4 bins with skew<=1: no bin may exceed ceil(6/4)=2
+    assert per_node.max() <= 2
+    assert int(np.asarray(est.node_count)[0]) == 4
