@@ -144,6 +144,9 @@ class Planner:
             jnp.asarray(cand), jnp.asarray(dest_allowed),
             max_pods_per_node=self.options.max_pods_per_node,
             chunk=self.options.drain_chunk,
+            planes=enc.planes,
+            max_zones=enc.dims.max_zones,
+            with_constraints=enc.has_constraints,
         )
         drainable = np.asarray(removal.drainable)
         unneeded = []
@@ -193,6 +196,18 @@ class Planner:
         group_ref = np.asarray(enc.scheduled.group_ref)
         movable_f = np.asarray(enc.scheduled.movable)
         limit_g = np.asarray(enc.specs.one_per_node())
+        # Groups whose dense feasibility row is not the whole truth — lossy
+        # encodings and topology-coupled constraints — get every destination
+        # double-checked by the exact oracle during confirmation (the analog
+        # of the reference running real scheduler plugins for each move).
+        need_exact = np.asarray(enc.specs.needs_host_check).copy()
+        if enc.specs.spread_kind is not None:
+            need_exact |= (np.asarray(enc.specs.spread_kind) > 0)
+            need_exact |= (np.asarray(enc.specs.aff_kind) > 0)
+            need_exact |= np.asarray(enc.specs.anti_self_zone)
+        if enc.planes is not None:
+            need_exact |= np.asarray(enc.planes.anti_host_cnt).sum(axis=1) > 0
+            need_exact |= np.asarray(enc.planes.anti_zone_cnt).sum(axis=1) > 0
         # same destination gates the device sweep applies (ops/drain.py):
         # valid & ready & schedulable — a cordoned or unready node must not
         # absorb paper capacity during confirmation
@@ -252,9 +267,17 @@ class Planner:
         excluded_gids: set[str] = set()
 
         def attempt(names: list[str]) -> tuple[list[NodeToRemove], dict[int, int], set[str]]:
+            import copy as _copy
+
+            from kubernetes_autoscaler_tpu.utils import oracle as _oracle
+
             free = (np.asarray(enc.nodes.cap)
                     - np.asarray(enc.nodes.alloc)).astype(np.int64)
             deleted_mask = np.zeros((enc.nodes.n,), dtype=bool)
+            # oracle world for exact-checked moves (rebuilt per attempt)
+            by_node: dict[str, list] = {}
+            for q in enc.scheduled_pods:
+                by_node.setdefault(q.node_name, []).append(q)
             received_slots: dict[int, list[int]] = {}
             moved_marks: set[tuple[int, int]] = set()
             final_dest: dict[int, int] = {}
@@ -332,6 +355,7 @@ class Planner:
                 # tie-break — over live free capacity and this round's state.
                 moves: dict[int, int] = {}
                 local_marks: set[tuple[int, int]] = set()
+                local_pod_moves: list[tuple[object, str, object]] = []
                 ok = True
                 for slot in victim_slots:
                     g_ref = int(group_ref[slot])
@@ -343,10 +367,36 @@ class Planner:
                         for (gm, dm) in moved_marks | local_marks:
                             if gm == g_ref:
                                 fits[dm] = False
-                    d = int(np.argmax(fits))
-                    if not fits[d]:
-                        ok = False
-                        break
+                    pod_obj = (enc.scheduled_pods[slot]
+                               if slot < len(enc.scheduled_pods) else None)
+                    if need_exact[g_ref] and pod_obj is not None:
+                        # unschedule from the oracle world, then exact-check
+                        # each dense-feasible destination in index order
+                        src_list = by_node.get(pod_obj.node_name, [])
+                        if pod_obj in src_list:
+                            src_list.remove(pod_obj)
+                        alive = [nd for k, nd in enumerate(nodes)
+                                 if not deleted_mask[k]]
+                        d = -1
+                        for cand_d in np.nonzero(fits)[0]:
+                            if _oracle.check_pod_in_cluster(
+                                    pod_obj, nodes[int(cand_d)], alive, by_node,
+                                    registry=enc.registry):
+                                d = int(cand_d)
+                                break
+                        if d < 0:
+                            src_list.append(pod_obj)  # restore the world
+                            ok = False
+                            break
+                        clone = _copy.deepcopy(pod_obj)
+                        clone.node_name = nodes[d].name
+                        by_node.setdefault(nodes[d].name, []).append(clone)
+                        local_pod_moves.append((pod_obj, pod_obj.node_name, clone))
+                    else:
+                        d = int(np.argmax(fits))
+                        if not fits[d]:
+                            ok = False
+                            break
                     free[d] -= req
                     moves[slot] = d
                     if limit_g[g_ref]:
@@ -356,6 +406,11 @@ class Planner:
                     # by an earlier candidate this round)
                     for slot, d in moves.items():
                         free[d] += reqs[slot]
+                    for pod_obj, src_name, clone in local_pod_moves:
+                        dst = by_node.get(clone.node_name, [])
+                        if clone in dst:
+                            dst.remove(clone)
+                        by_node.setdefault(src_name, []).append(pod_obj)
                     self._mark(name, "NoPlaceToMovePods", now)
                     continue
 
@@ -372,6 +427,7 @@ class Planner:
                 else:
                     drain_budget -= 1
                 deleted_mask[i] = True
+                by_node.pop(nd.name, None)  # node gone: daemonset leftovers vanish
                 for slot, d in moves.items():
                     received_slots.setdefault(d, []).append(slot)
                     final_dest[slot] = d

@@ -46,7 +46,8 @@ class ScaleDownSim(struct.PyTreeNode):
     utilization: jax.Array      # f32[N]
 
 
-@partial(jax.jit, static_argnames=("dims", "max_new_nodes", "strategy"))
+@partial(jax.jit, static_argnames=("dims", "max_new_nodes", "strategy",
+                                   "with_constraints"))
 def scale_up_sim(
     nodes: NodeTensors,
     specs: PodGroupTensors,
@@ -55,12 +56,18 @@ def scale_up_sim(
     dims: Dims,
     max_new_nodes: int = 256,
     strategy: str = "least-waste",
+    planes=None,
+    with_constraints: bool = False,
 ) -> ScaleUpSim:
     """Loops A+B of the reference hot path as one program."""
-    packed = schedule.schedule_pending_on_existing(nodes, specs, scheduled)
+    packed = schedule.schedule_pending_on_existing(
+        nodes, specs, scheduled, planes=planes, max_zones=dims.max_zones,
+        with_constraints=with_constraints)
     remaining = jnp.maximum(specs.count - packed.scheduled, 0)
     pending = specs.replace(count=remaining)
-    est = estimate_all(pending, groups, dims, max_new_nodes)
+    est = estimate_all(pending, groups, dims, max_new_nodes,
+                       planes=planes, nodes=nodes,
+                       with_constraints=with_constraints)
     sc = scoring.score_options(est, groups)
     best = scoring.best_option(sc, strategy)
     return ScaleUpSim(
@@ -72,7 +79,8 @@ def scale_up_sim(
     )
 
 
-@partial(jax.jit, static_argnames=("max_pods_per_node", "chunk"))
+@partial(jax.jit, static_argnames=("max_pods_per_node", "chunk",
+                                   "max_zones", "with_constraints"))
 def scale_down_sim(
     nodes: NodeTensors,
     specs: PodGroupTensors,
@@ -80,6 +88,9 @@ def scale_down_sim(
     threshold: float = 0.5,
     max_pods_per_node: int = 128,
     chunk: int = 32,
+    planes=None,
+    max_zones: int = 16,
+    with_constraints: bool = False,
 ) -> ScaleDownSim:
     """Loop C of the reference hot path: eligibility + full drain sweep.
 
@@ -101,11 +112,15 @@ def scale_down_sim(
         dest_allowed=jnp.ones((nodes.n,), bool),
         max_pods_per_node=max_pods_per_node,
         chunk=chunk,
+        planes=planes,
+        max_zones=max_zones,
+        with_constraints=with_constraints,
     )
     return ScaleDownSim(eligible=eligible, removal=removal, utilization=util)
 
 
-@partial(jax.jit, static_argnames=("dims", "max_new_nodes", "strategy", "max_pods_per_node"))
+@partial(jax.jit, static_argnames=("dims", "max_new_nodes", "strategy",
+                                   "max_pods_per_node", "with_constraints"))
 def run_once_sim(
     cluster: ClusterTensors,
     dims: Dims,
@@ -113,14 +128,16 @@ def run_once_sim(
     strategy: str = "least-waste",
     threshold: float = 0.5,
     max_pods_per_node: int = 128,
+    with_constraints: bool = False,
 ) -> tuple[ScaleUpSim, ScaleDownSim]:
     """Full RunOnce simulation content in a single dispatch."""
+    planes = cluster.planes if with_constraints else None
     up = scale_up_sim.__wrapped__(
         cluster.nodes, cluster.pending, cluster.scheduled, cluster.groups,
-        dims, max_new_nodes, strategy,
+        dims, max_new_nodes, strategy, planes, with_constraints,
     )
     down = scale_down_sim.__wrapped__(
         cluster.nodes, cluster.pending, cluster.scheduled, threshold,
-        max_pods_per_node, 32,
+        max_pods_per_node, 32, planes, dims.max_zones, with_constraints,
     )
     return up, down

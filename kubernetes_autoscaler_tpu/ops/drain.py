@@ -70,8 +70,18 @@ def simulate_removals(
     max_pods_per_node: int = 128,
     chunk: int = 256,
     max_groups_per_node: int = 16,
+    planes=None,
+    max_zones: int = 16,
+    with_constraints: bool = False,
 ) -> RemovalResult:
-    """Simulate removing every candidate node independently."""
+    """Simulate removing every candidate node independently.
+
+    `with_constraints` (STATIC) makes re-placement topology-aware: the
+    candidate's own residents are subtracted from the zone-level constraint
+    state (the analog of the reference's ghost-node trick,
+    simulator/cluster.go:230-238 — the drained node stops being a domain
+    member before its pods are re-placed), and constrained groups re-place
+    through the wave placer (ops/constrained.py)."""
     n = nodes.n
     g_total = specs.g
     mpn = max_pods_per_node
@@ -85,6 +95,32 @@ def simulate_removals(
     feas_gn = feas_gn & ~anti_block
     limit_g = specs.one_per_node()   # bool[G]
     free0 = nodes.free()
+
+    if with_constraints and planes is not None:
+        from kubernetes_autoscaler_tpu.ops import constrained as con
+        from kubernetes_autoscaler_tpu.ops import predicates as preds
+
+        z_dim = max_zones
+        zval = nodes.zone_id > 0
+        zcl_n = jnp.clip(nodes.zone_id, 0, z_dim - 1)
+        # host-level (candidate-independent) gates
+        feas_gn &= planes.anti_host_cnt == 0
+        feas_gn &= jnp.where(((specs.aff_kind == 1) & ~specs.aff_self)[:, None],
+                             planes.aff_cnt > 0, True)
+        zone_kinds = (specs.spread_kind == 2) | (specs.aff_kind == 2)
+        feas_gn &= jnp.where(zone_kinds[:, None], zval[None, :],
+                             jnp.ones((1, n), bool))
+        # zone-level aggregates, adjusted per candidate below
+        anti_zone_z = con.zone_agg(planes.anti_zone_cnt, nodes.zone_id, z_dim)
+        aff_zone_z = con.zone_agg(planes.aff_cnt, nodes.zone_id, z_dim)
+        cnt_zone = con.zone_agg(planes.spread_cnt, nodes.zone_id, z_dim)
+        sel_real = preds.selector_match(nodes.label_hash, specs)
+        elig_host = sel_real & nodes.valid[None, :]
+        s_elig = jnp.where((specs.spread_kind == 2)[:, None],
+                           elig_host & zval[None, :], elig_host)
+        elig_zone_cnt = con.zone_agg(s_elig.astype(jnp.int32), nodes.zone_id, z_dim)
+        is_con = ((specs.spread_kind > 0) | (specs.aff_kind > 0)
+                  | specs.anti_self_zone)
 
     # Sort resident pods by node so each candidate's pods are one contiguous
     # window — the device-side equivalent of NodeInfo.Pods lists.
@@ -122,18 +158,72 @@ def simulate_removals(
         dest = dest_allowed & nodes.valid & nodes.ready & nodes.schedulable
         dest = dest & (jnp.arange(n) != c)
 
-        # --- K-step first-fit of whole groups onto destinations ---
-        def step(free_c, j):
-            gi = gidx[j]
-            want = cnt_k[j]
-            fit = fit_count(free_c, specs.req[gi])
-            fit = jnp.where(feas_gn[gi] & dest, fit, 0)
-            fit = jnp.where(limit_g[gi], jnp.minimum(fit, 1), fit)
-            fit = jnp.minimum(fit, want)
-            cum = jnp.cumsum(fit)
-            place = jnp.clip(want - (cum - fit), 0, fit)
-            free_c = free_c - place[:, None] * specs.req[gi][None, :]
-            return free_c, (place.sum(), jnp.cumsum(place))
+        if with_constraints and planes is not None:
+            # ghost-node analog: the candidate's residents leave its domain
+            # before re-placement — subtract its column from the zone state
+            zc = zcl_n[c]
+            dz = ((jnp.arange(z_dim) == zc) & zval[c]).astype(jnp.int32)  # [Z]
+            anti_adj = anti_zone_z - dz[None, :] * planes.anti_zone_cnt[:, c][:, None]
+            aff_adj = aff_zone_z - dz[None, :] * planes.aff_cnt[:, c][:, None]
+            cnt_adj = cnt_zone - dz[None, :] * planes.spread_cnt[:, c][:, None]
+            elig_adj = (elig_zone_cnt
+                        - dz[None, :] * s_elig[:, c].astype(jnp.int32)[:, None]) > 0
+            zone_gate = ~(zval[None, :] & (anti_adj[:, zcl_n] > 0))      # [G, N]
+            aff2 = (specs.aff_kind == 2) & ~specs.aff_self
+            zone_gate &= jnp.where(aff2[:, None],
+                                   zval[None, :] & (aff_adj[:, zcl_n] > 0), True)
+            s_elig_c = s_elig & (jnp.arange(n) != c)[None, :]
+
+            def step(free_c, j):
+                gi = gidx[j]
+                want = cnt_k[j]
+                reqg = specs.req[gi]
+                feas_row = feas_gn[gi] & zone_gate[gi] & dest
+
+                def fast(fr):
+                    fit = fit_count(fr, reqg)
+                    fit = jnp.where(feas_row, fit, 0)
+                    fit = jnp.where(limit_g[gi], jnp.minimum(fit, 1), fit)
+                    fit = jnp.minimum(fit, want)
+                    cum = jnp.cumsum(fit)
+                    place = jnp.clip(want - (cum - fit), 0, fit)
+                    return fr - place[:, None] * reqg[None, :], place
+
+                def slow(fr):
+                    cg = con.GroupConstraints(
+                        s_kind=specs.spread_kind[gi], s_skew=specs.max_skew[gi],
+                        s_self=specs.spread_self[gi],
+                        s_cnt_node=planes.spread_cnt[gi],
+                        s_elig=s_elig_c[gi],
+                        a_kind=specs.aff_kind[gi], a_self=specs.aff_self[gi],
+                        a_any=specs.aff_match_any[gi],
+                        a_ok_node=jnp.where(
+                            specs.aff_kind[gi] == 1, planes.aff_cnt[gi] > 0,
+                            zval & (aff_adj[gi, zcl_n] > 0)),
+                        anti_self_zone=specs.anti_self_zone[gi],
+                        cnt_zone_base=cnt_adj[gi],
+                        elig_zone_base=elig_adj[gi],
+                        min_host_base=con.BIG,
+                        zone_cl=zcl_n, zone_valid=zval,
+                    )
+                    return con.place_group_constrained(
+                        fr, feas_row, reqg, want, limit_g[gi], cg, z_dim)
+
+                free_c, place = jax.lax.cond(is_con[gi], slow, fast, free_c)
+                return free_c, (place.sum(), jnp.cumsum(place))
+        else:
+            # --- K-step first-fit of whole groups onto destinations ---
+            def step(free_c, j):
+                gi = gidx[j]
+                want = cnt_k[j]
+                fit = fit_count(free_c, specs.req[gi])
+                fit = jnp.where(feas_gn[gi] & dest, fit, 0)
+                fit = jnp.where(limit_g[gi], jnp.minimum(fit, 1), fit)
+                fit = jnp.minimum(fit, want)
+                cum = jnp.cumsum(fit)
+                place = jnp.clip(want - (cum - fit), 0, fit)
+                free_c = free_c - place[:, None] * specs.req[gi][None, :]
+                return free_c, (place.sum(), jnp.cumsum(place))
 
         _, (placed_k, cumplace_k) = jax.lax.scan(
             step, free0, jnp.arange(kk, dtype=jnp.int32))

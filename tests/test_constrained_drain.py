@@ -1,0 +1,150 @@
+"""Drain simulation preserves topology semantics (ghost-node analog):
+re-placed pods respect spread skew and affinity, and the candidate's own
+residents leave their domain before re-placement.
+
+Reference analog: simulator/cluster.go:230-238 — the drained node is replaced
+by a tainted ghost so PodTopologySpread sees the domain without its pods.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.api import (
+    AffinityTerm,
+    TopologySpreadConstraint,
+)
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.ops.drain import simulate_removals
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _spread_pod(name, node, skew=1):
+    p = build_test_pod(name, cpu_milli=100, mem_mib=64, labels={"app": "w"},
+                       owner_name="w-rs", node_name=node)
+    p.phase = "Running"
+    p.topology_spread = [TopologySpreadConstraint(
+        max_skew=skew, topology_key=ZONE, match_labels={"app": "w"})]
+    return p
+
+
+def _drain(nodes, pods, cand_names):
+    enc = encode_cluster(nodes, pods)
+    movable = np.zeros((enc.scheduled.p,), bool)
+    movable[: len(enc.scheduled_pods)] = True
+    enc.scheduled = enc.scheduled.replace(
+        movable=jnp.asarray(movable),
+        blocks=jnp.zeros((enc.scheduled.p,), bool))
+    idx = [enc.node_index[n] for n in cand_names]
+    res = simulate_removals(
+        enc.nodes, enc.specs, enc.scheduled,
+        jnp.asarray(idx, jnp.int32), jnp.ones((enc.nodes.n,), bool),
+        max_pods_per_node=16, chunk=8,
+        planes=enc.planes, max_zones=enc.dims.max_zones,
+        with_constraints=enc.has_constraints)
+    return enc, res
+
+
+def test_drain_spread_pod_lands_in_own_zone_only():
+    # zones a/b hold 1 matching pod each on big nodes; candidate c0 (zone c)
+    # holds one; c2 is an empty zone-c node. Moving c0's pod anywhere but
+    # zone c would make skew 2 with zone c at 0 (still an eligible domain).
+    nodes = [
+        build_test_node("a0", cpu_milli=4000, mem_mib=8192, zone="a"),
+        build_test_node("b0", cpu_milli=4000, mem_mib=8192, zone="b"),
+        build_test_node("c0", cpu_milli=4000, mem_mib=8192, zone="c"),
+        build_test_node("c2", cpu_milli=4000, mem_mib=8192, zone="c"),
+    ]
+    pods = [_spread_pod("wa", "a0"), _spread_pod("wb", "b0"),
+            _spread_pod("wc", "c0")]
+    for p in pods:
+        p.owner = p.owner  # keep replicated owner for drainability
+    enc, res = _drain(nodes, pods, ["c0"])
+    assert bool(np.asarray(res.drainable)[0])
+    slots = np.asarray(res.pod_slot)[0]
+    dests = np.asarray(res.dest_node)[0]
+    moved = {int(s): int(d) for s, d in zip(slots, dests) if s >= 0 and d >= 0}
+    assert list(moved.values()) == [enc.node_index["c2"]], (
+        f"spread pod must stay in zone c, moved={moved}")
+
+
+def test_drain_spread_fails_when_own_zone_full():
+    nodes = [
+        build_test_node("a0", cpu_milli=4000, mem_mib=8192, zone="a"),
+        build_test_node("b0", cpu_milli=4000, mem_mib=8192, zone="b"),
+        build_test_node("c0", cpu_milli=4000, mem_mib=8192, zone="c"),
+        build_test_node("c2", cpu_milli=50, mem_mib=8192, zone="c"),  # no room
+    ]
+    pods = [_spread_pod("wa", "a0"), _spread_pod("wb", "b0"),
+            _spread_pod("wc", "c0")]
+    enc, res = _drain(nodes, pods, ["c0"])
+    assert not bool(np.asarray(res.drainable)[0])
+
+
+def test_drain_candidate_domain_exit_allows_move():
+    # only TWO zone domains exist via eligible nodes once c0 drains: a and b.
+    # c0's pod moving to b keeps skew: a=1, b=0->1. The candidate's own
+    # resident must be subtracted from zone c's count (ghost-node analog) —
+    # and zone c must stop being an eligible domain (its only node is gone).
+    nodes = [
+        build_test_node("a0", cpu_milli=4000, mem_mib=8192, zone="a"),
+        build_test_node("b0", cpu_milli=4000, mem_mib=8192, zone="b"),
+        build_test_node("c0", cpu_milli=4000, mem_mib=8192, zone="c"),
+    ]
+    pods = [_spread_pod("wa", "a0"), _spread_pod("wc", "c0")]
+    enc, res = _drain(nodes, pods, ["c0"])
+    assert bool(np.asarray(res.drainable)[0])
+    slots = np.asarray(res.pod_slot)[0]
+    dests = np.asarray(res.dest_node)[0]
+    moved = {int(s): int(d) for s, d in zip(slots, dests) if s >= 0 and d >= 0}
+    assert list(moved.values()) == [enc.node_index["b0"]]
+
+
+def test_drain_zone_anti_affinity_blocks_occupied_zone():
+    nodes = [
+        build_test_node("a0", cpu_milli=4000, mem_mib=8192, zone="a"),
+        build_test_node("a1", cpu_milli=4000, mem_mib=8192, zone="a"),
+        build_test_node("b0", cpu_milli=4000, mem_mib=8192, zone="b"),
+        build_test_node("c0", cpu_milli=4000, mem_mib=8192, zone="c"),
+    ]
+
+    def anti(name, node):
+        p = build_test_pod(name, cpu_milli=100, mem_mib=64, labels={"app": "za"},
+                           owner_name="za-rs", node_name=node)
+        p.phase = "Running"
+        p.anti_affinity = [AffinityTerm(match_labels={"app": "za"},
+                                        topology_key=ZONE)]
+        return p
+
+    # one anti pod in zone a (a0) and the candidate's own in zone c
+    pods = [anti("p-a", "a0"), anti("p-c", "c0")]
+    enc, res = _drain(nodes, pods, ["c0"])
+    assert bool(np.asarray(res.drainable)[0])
+    slots = np.asarray(res.pod_slot)[0]
+    dests = np.asarray(res.dest_node)[0]
+    moved = {int(s): int(d) for s, d in zip(slots, dests) if s >= 0 and d >= 0}
+    # zone a is occupied by a matching pod -> only zone b is legal
+    assert list(moved.values()) == [enc.node_index["b0"]]
+
+
+def test_drain_zone_affinity_keeps_pod_near_target():
+    nodes = [
+        build_test_node("a0", cpu_milli=4000, mem_mib=8192, zone="a"),
+        build_test_node("a1", cpu_milli=4000, mem_mib=8192, zone="a"),
+        build_test_node("b0", cpu_milli=4000, mem_mib=8192, zone="b"),
+    ]
+    db = build_test_pod("db", cpu_milli=100, mem_mib=64, labels={"app": "db"},
+                        owner_name="db-rs", node_name="a1")
+    db.phase = "Running"
+    w = build_test_pod("w", cpu_milli=100, mem_mib=64, labels={"app": "w"},
+                       owner_name="w-rs", node_name="a0")
+    w.phase = "Running"
+    w.pod_affinity = [AffinityTerm(match_labels={"app": "db"}, topology_key=ZONE)]
+    enc, res = _drain(nodes, [db, w], ["a0"])
+    assert bool(np.asarray(res.drainable)[0])
+    slots = np.asarray(res.pod_slot)[0]
+    dests = np.asarray(res.dest_node)[0]
+    moved = {int(s): int(d) for s, d in zip(slots, dests) if s >= 0 and d >= 0}
+    # w must follow the db pod's zone: a1 is the only legal destination
+    assert list(moved.values()) == [enc.node_index["a1"]]
