@@ -101,12 +101,16 @@ class Actuator:
         if self.on_taint:
             self.on_taint(node, TO_BE_DELETED_TAINT)
 
-    def taint_deletion_candidate(self, node: Node) -> None:
+    def taint_deletion_candidate(self, node: Node, since: float | None = None) -> None:
         """Soft taint marking scale-down intent — the crash-recovery WAL
-        (reference: softtaint.go + planner LoadFromExistingTaints)."""
+        (reference: softtaint.go + planner LoadFromExistingTaints). The taint
+        value records when the node's unneeded CLOCK started, so a restarted
+        process resumes the clock rather than restarting it."""
         if all(t.key != DELETION_CANDIDATE_TAINT for t in node.taints):
             node.taints.append(Taint(DELETION_CANDIDATE_TAINT,
-                                     str(int(time.time())), "PreferNoSchedule"))
+                                     str(int(since if since is not None
+                                             else time.time())),
+                                     "PreferNoSchedule"))
         if self.on_taint:
             self.on_taint(node, DELETION_CANDIDATE_TAINT)
 

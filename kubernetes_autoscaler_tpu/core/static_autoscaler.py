@@ -129,6 +129,9 @@ class StaticAutoscaler:
                                  latency_tracker=self.latency_tracker)
         self.last_scale_down_delete: float = 0.0
         self.last_scale_down_fail: float = 0.0
+        # one-time crash recovery on the first loop (reference:
+        # cleanUpIfRequired static_autoscaler.go:258 + planner.go:91-93)
+        self._startup_recovery_done = False
 
         # ProvisioningRequest wiring (reference: builder/autoscaler.go wraps
         # the scale-up orchestrator when ProvReq support is on) — active when
@@ -169,6 +172,13 @@ class StaticAutoscaler:
                 status.ran = False
                 status.aborted_reason = "no nodes"
                 return status
+
+            # crash recovery (first loop only): resume unneeded clocks from
+            # DeletionCandidate soft taints — the scale-down WAL — and clear
+            # stale ToBeDeleted taints a crashed predecessor left behind
+            if not self._startup_recovery_done:
+                self._recover_scale_down_state(nodes)
+                self._startup_recovery_done = True
             self.processors.custom_resources.filter_ready(nodes)
 
             self.cluster_state.update_nodes(nodes, now)
@@ -318,6 +328,10 @@ class StaticAutoscaler:
                 with self.metrics.time_function("scale_down_update"):
                     self.planner.update(enc, nodes, now)
                 status.unneeded_nodes = list(self.planner.state.unneeded)
+                # persist scale-down intent as soft taints (reference:
+                # actuation/softtaint.go UpdateSoftDeletionTaints) so a
+                # restart resumes the unneeded clocks instead of zeroing them
+                self._sync_soft_taints(nodes)
                 self.metrics.gauge("unneeded_nodes_count").set(
                     len(status.unneeded_nodes)
                 )
@@ -452,6 +466,45 @@ class StaticAutoscaler:
             if g is not None:
                 out[nd.name] = group_ids.get(g.id(), -1)
         return out
+
+    def _recover_scale_down_state(self, nodes: list[Node]) -> None:
+        """First-loop WAL replay: DeletionCandidate taint values are the
+        epoch timestamps the clocks started at (actuator writes them);
+        leftover ToBeDeleted taints from a crashed run are removed so the
+        nodes become schedulable again (reference: cleanUpIfRequired)."""
+        from kubernetes_autoscaler_tpu.models.api import (
+            DELETION_CANDIDATE_TAINT,
+            TO_BE_DELETED_TAINT,
+        )
+
+        tainted_since: dict[str, float] = {}
+        for nd in nodes:
+            for t in nd.taints:
+                if t.key == DELETION_CANDIDATE_TAINT:
+                    try:
+                        tainted_since[nd.name] = float(t.value)
+                    except ValueError:
+                        pass
+            if any(t.key == TO_BE_DELETED_TAINT for t in nd.taints):
+                self.actuator.untaint(nd, TO_BE_DELETED_TAINT)
+        if tainted_since:
+            self.planner.unneeded_nodes.load_from_taints(tainted_since)
+
+    def _sync_soft_taints(self, nodes: list[Node]) -> None:
+        """Make DeletionCandidate taints mirror the unneeded set: taint newly
+        unneeded nodes, clean taints off nodes that became needed again."""
+        from kubernetes_autoscaler_tpu.models.api import DELETION_CANDIDATE_TAINT
+
+        unneeded = set(self.planner.state.unneeded)
+        for nd in nodes:
+            has = any(t.key == DELETION_CANDIDATE_TAINT for t in nd.taints)
+            if nd.name in unneeded and not has:
+                self.actuator.taint_deletion_candidate(
+                    nd, since=self.planner.unneeded_nodes.since.get(nd.name))
+            elif has and nd.name not in unneeded:
+                self.actuator.untaint(nd, DELETION_CANDIDATE_TAINT)
+                if self.actuator.on_taint:
+                    self.actuator.on_taint(nd, "")
 
     def _scale_down_allowed(self, now: float) -> bool:
         o = self.options

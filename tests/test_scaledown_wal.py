@@ -1,0 +1,81 @@
+"""Scale-down intent WAL: DeletionCandidate soft taints persist unneeded
+clocks across a process restart.
+
+Reference analog: core/scaledown/actuation/softtaint.go (apply) +
+planner.go:91-93 LoadFromExistingTaints (replay) +
+static_autoscaler.go:258 cleanUpIfRequired (stale ToBeDeleted cleanup).
+"""
+
+from kubernetes_autoscaler_tpu.config.options import NodeGroupDefaults
+from kubernetes_autoscaler_tpu.models.api import (
+    DELETION_CANDIDATE_TAINT,
+    TO_BE_DELETED_TAINT,
+    Taint,
+)
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+from test_runonce import autoscaler_for
+
+
+def _idle_world(n_idle=2):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    for i in range(n_idle):
+        fake.add_existing_node(
+            "ng1", build_test_node(f"idle-{i}", cpu_milli=4000, mem_mib=8192))
+    return fake
+
+
+DEFAULTS = NodeGroupDefaults(scale_down_unneeded_time_s=600.0,
+                             scale_down_unready_time_s=600.0)
+
+
+def test_soft_taints_applied_and_cleaned():
+    fake = _idle_world(2)
+    a = autoscaler_for(fake, node_group_defaults=DEFAULTS)
+    a.run_once(now=1000.0)
+    for nd in fake.nodes.values():
+        assert any(t.key == DELETION_CANDIDATE_TAINT for t in nd.taints), nd.name
+        val = next(t.value for t in nd.taints if t.key == DELETION_CANDIDATE_TAINT)
+        assert float(val) == 1000.0  # clock start recorded, not taint time
+    # make one node needed again -> its soft taint must be cleaned
+    fake.add_pod(build_test_pod("busy", cpu_milli=3500, mem_mib=512,
+                                owner_name="rs", node_name="idle-0"))
+    a.run_once(now=1010.0)
+    n0 = fake.nodes["idle-0"]
+    assert not any(t.key == DELETION_CANDIDATE_TAINT for t in n0.taints)
+
+
+def test_restart_resumes_clocks_from_taints():
+    fake = _idle_world(2)
+    a1 = autoscaler_for(fake, node_group_defaults=DEFAULTS)
+    a1.run_once(now=1000.0)  # clocks start at 1000, taints written
+
+    # --- simulated crash: a brand-new process with empty in-memory state ---
+    a2 = autoscaler_for(fake, node_group_defaults=DEFAULTS)
+    # 650s later: past the 600s unneeded time ONLY if the clock survived
+    status = a2.run_once(now=1650.0)
+    assert status.scale_down_deleted, (
+        "restart must resume unneeded clocks from DeletionCandidate taints")
+
+
+def test_fresh_process_without_taints_restarts_clocks():
+    fake = _idle_world(2)
+    a = autoscaler_for(fake, node_group_defaults=DEFAULTS)
+    # no prior soft taints: 650s of claimed idleness means nothing
+    status = a.run_once(now=1650.0)
+    assert not status.scale_down_deleted
+    assert status.unneeded_nodes  # tracked, clocks started fresh
+
+
+def test_stale_to_be_deleted_taint_cleaned_on_startup():
+    fake = _idle_world(1)
+    nd = fake.nodes["idle-0"]
+    nd.taints.append(Taint(TO_BE_DELETED_TAINT, "999", "NoSchedule"))
+    a = autoscaler_for(fake, node_group_defaults=DEFAULTS)
+    a.run_once(now=1000.0)
+    assert not any(t.key == TO_BE_DELETED_TAINT for t in nd.taints), (
+        "crashed predecessor's hard taint must be removed so the node "
+        "schedules again")
