@@ -49,6 +49,9 @@ class AutoscalingOptions:
     # --max-autoprovisioned-node-group-count)
     node_autoprovisioning_enabled: bool = False
     max_autoprovisioned_node_group_count: int = 15
+    # async creation (reference: CreateNodeGroupAsync orchestrator.go:453 +
+    # async_initializer.go — the loop never blocks on slow cloud creation)
+    async_node_group_creation: bool = False
 
     # scale-down
     scale_down_enabled: bool = True

@@ -72,6 +72,7 @@ class ScaleUpOrchestrator:
         quota: QuotaTracker | None = None,
         node_group_list_processor=None,
         node_group_manager=None,
+        async_creator=None,
     ):
         from kubernetes_autoscaler_tpu.processors.nodegroups import (
             IdentityNodeGroupListProcessor,
@@ -87,6 +88,9 @@ class ScaleUpOrchestrator:
             node_group_list_processor or IdentityNodeGroupListProcessor()
         )
         self.node_group_manager = node_group_manager or NodeGroupManager()
+        # AsyncNodeGroupCreator when --async-node-group-creation is on
+        # (reference: CreateNodeGroupAsync orchestrator.go:453)
+        self.async_creator = async_creator
 
     # ---- node-group validity (reference: filterValidScaleUpNodeGroups :152) ----
 
@@ -117,6 +121,11 @@ class ScaleUpOrchestrator:
         groups = self.node_group_list_processor.process(
             self.provider, groups, enc.pending_pods
         )
+        if self.async_creator is not None:
+            # a group whose creation is still in flight must not be
+            # re-proposed (reference: AsyncNodeGroupStateChecker gating)
+            groups = [g for g in groups
+                      if not self.async_creator.is_upcoming(g.id())]
         if not groups:
             return ScaleUpResult(scaled_up=False, pods_remaining=pending_total)
 
@@ -296,6 +305,13 @@ class ScaleUpOrchestrator:
         def one(gid: str, delta: int):
             g = by_id[gid]
             if not g.exist():
+                if (self.async_creator is not None
+                        and self.options.async_node_group_creation):
+                    # fire-and-track: creation + initial scale-up happen off
+                    # the loop thread; capacity counts as upcoming meanwhile
+                    # (reference: CreateNodeGroupAsync + async_initializer.go)
+                    self.async_creator.create_async(g, delta, now)
+                    return gid, delta, True
                 # winner is an auto-provisioning candidate: create first
                 # (reference: orchestrator CreateNodeGroup before IncreaseSize)
                 self.node_group_manager.create_node_group(g)
@@ -303,16 +319,19 @@ class ScaleUpOrchestrator:
                 g.atomic_increase_size(delta)
             else:
                 g.increase_size(delta)
-            return gid, delta
+            return gid, delta, False
 
         with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
             futures = {ex.submit(one, gid, d): gid for gid, d in plan.items()}
             for fut in concurrent.futures.as_completed(futures):
                 gid = futures[fut]
                 try:
-                    _, delta = fut.result()
+                    _, delta, async_pending = fut.result()
                     result.increases[gid] = delta
-                    self.cluster_state.register_scale_up(by_id[gid], delta, now)
+                    if not async_pending:
+                        # async creations register with the CSR when the
+                        # creator's pipeline completes, not here
+                        self.cluster_state.register_scale_up(by_id[gid], delta, now)
                     result.scaled_up = True
                 except NodeGroupError as e:
                     result.errors[gid] = str(e)

@@ -112,10 +112,20 @@ class StaticAutoscaler:
             )
             if self.options.node_autoprovisioning_enabled else None
         )
+        # async group creation (reference: CreateNodeGroupAsync + the
+        # AsyncNodeGroupStateChecker processor row)
+        self.async_creator = None
+        if self.options.async_node_group_creation:
+            from kubernetes_autoscaler_tpu.core.scaleup.async_groups import (
+                AsyncNodeGroupCreator,
+            )
+
+            self.async_creator = AsyncNodeGroupCreator(self.cluster_state)
         self.scale_up_orchestrator = ScaleUpOrchestrator(
             provider, self.options, self.cluster_state, expander, None,
             node_group_list_processor=ng_list_proc,
             node_group_manager=self.node_group_manager,
+            async_creator=self.async_creator,
         )
         # shared scale-down trackers (reference: planner & actuator share one
         # RemainingPdbTracker; latency spans plan→delete)
@@ -261,6 +271,13 @@ class StaticAutoscaler:
             upcoming = self.cluster_state.upcoming_nodes()
             for gid, count in upcoming.items():
                 self._inject_template_nodes(snapshot, gid, count, "upcoming")
+            # capacity promised on still-creating groups (reference:
+            # AsyncNodeGroupStateChecker → upcoming accounting)
+            if self.async_creator is not None:
+                for gid, st in self.async_creator.upcoming().items():
+                    self._inject_template_nodes(
+                        snapshot, gid, st.initial_delta, "async-upcoming",
+                        template=st.template)
 
             # debugging snapshot collection (reference:
             # static_autoscaler.go:299-300,404 — only when /snapshotz armed)
@@ -445,13 +462,17 @@ class StaticAutoscaler:
     # ---- helpers ----
 
     def _inject_template_nodes(self, snapshot, gid: str, count: int,
-                               prefix: str) -> int:
+                               prefix: str, template: Node | None = None) -> int:
         """Add `count` sanitized template nodes of group `gid` to the
-        snapshot (upcoming-node and salvo re-injection share this)."""
-        g = next((x for x in self.provider.node_groups() if x.id() == gid), None)
-        if g is None:
-            return 0
-        tmpl = g.template_node_info()
+        snapshot (upcoming-node, async-creation and salvo re-injection share
+        this). `template` overrides the provider lookup for groups that do
+        not exist yet (async creation in flight)."""
+        tmpl = template
+        if tmpl is None:
+            g = next((x for x in self.provider.node_groups() if x.id() == gid), None)
+            if g is None:
+                return 0
+            tmpl = g.template_node_info()
         for k in range(count):
             t = self.processors.template_node_info_provider.sanitize(tmpl, gid)
             t.name = f"{prefix}-{gid}-{k}"

@@ -1,0 +1,98 @@
+"""Async node-group creation: a slow-creating group never blocks the loop,
+its promised capacity counts as upcoming, and the initial scale-up lands when
+creation completes.
+
+Reference analog: core/scaleup/orchestrator/orchestrator.go:453
+CreateNodeGroupAsync + async_initializer.go + AsyncNodeGroupStateChecker.
+"""
+
+import threading
+import time
+
+from kubernetes_autoscaler_tpu.cloudprovider.test_provider import TestNodeGroup
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+from test_runonce import autoscaler_for
+
+
+class SlowCreateGroup(TestNodeGroup):
+    """TestNodeGroup whose create() blocks until the test releases it."""
+
+    gate: threading.Event = threading.Event()
+    create_calls: int = 0
+
+    def create(self):
+        type(self).create_calls += 1
+        assert self.gate.wait(timeout=30), "test never released the gate"
+        return super().create()
+
+
+def _world():
+    SlowCreateGroup.gate = threading.Event()
+    SlowCreateGroup.create_calls = 0
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.provider.add_machine_type("m-slow", tmpl)
+
+    orig = fake.provider.new_node_group
+
+    def slow_new_node_group(machine_type, max_size=1000):
+        g = orig(machine_type, max_size)
+        slow = SlowCreateGroup(g._id, 0, max_size, 0, g._template,
+                               fake.provider, None, g.price_per_node)
+        slow._exists = False
+        slow._autoprovisioned = True
+        return slow
+
+    fake.provider.new_node_group = slow_new_node_group
+    # a tiny seed group so the cluster is actionable; pods don't fit it
+    seed = build_test_node("seed-tmpl", cpu_milli=100, mem_mib=256)
+    fake.add_node_group("ng-seed", seed, min_size=1, max_size=1)
+    fake.add_existing_node(
+        "ng-seed", build_test_node("seed-0", cpu_milli=100, mem_mib=256))
+    for i in range(4):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1500, mem_mib=512,
+                                    owner_name="rs"))
+    return fake
+
+
+def test_slow_creation_does_not_block_loop_and_counts_upcoming():
+    fake = _world()
+    a = autoscaler_for(fake, node_autoprovisioning_enabled=True,
+                       async_node_group_creation=True)
+    t0 = time.monotonic()
+    status = a.run_once(now=1000.0)
+    loop_s = time.monotonic() - t0
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    assert loop_s < 15, f"loop blocked on slow creation ({loop_s:.1f}s)"
+    assert SlowCreateGroup.create_calls == 1
+    gid = next(iter(status.scale_up.increases))
+    assert a.async_creator.is_upcoming(gid)
+
+    # second loop while creation is STILL in flight: the promised capacity is
+    # injected as upcoming, so the same pods must not trigger another
+    # scale-up or another create
+    status2 = a.run_once(now=1010.0)
+    assert status2.pending_pods == 0, "upcoming capacity must absorb the pods"
+    assert status2.scale_up is None
+    assert SlowCreateGroup.create_calls == 1
+
+    # release the gate: creation completes, initial scale-up lands
+    SlowCreateGroup.gate.set()
+    a.async_creator.wait_idle()
+    assert not a.async_creator.is_upcoming(gid)
+    g = next(x for x in fake.provider.node_groups() if x.id() == gid)
+    assert g.exist()
+    assert g.target_size() == status.scale_up.increases[gid]
+    assert len(fake.provider.nodes_of(gid)) == g.target_size()
+
+
+def test_sync_creation_still_works_when_flag_off():
+    fake = _world()
+    SlowCreateGroup.gate.set()  # don't block the synchronous path
+    a = autoscaler_for(fake, node_autoprovisioning_enabled=True)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    assert a.async_creator is None
+    assert len(fake.nodes) > 0
