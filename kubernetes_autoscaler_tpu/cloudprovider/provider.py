@@ -149,6 +149,11 @@ class CloudProvider(abc.ABC):
     def gpu_label(self) -> str:
         return "cloud.google.com/gke-accelerator"
 
+    def gpu_resource_name(self) -> str:
+        """The extended-resource name GPUs are requested under (reference:
+        gpu.ResourceNvidiaGPU in utils/gpu)."""
+        return "nvidia.com/gpu"
+
     def refresh(self) -> None:
         """Called before every RunOnce loop (reference Refresh)."""
 

@@ -190,7 +190,15 @@ class TestCloudProvider(CloudProvider):
         return self.resource_limiter
 
     def pricing(self):
-        return {gid: g.price_per_node for gid, g in self._groups.items()}
+        """A linear PricingModel (reference: testprovider's PricingModel).
+        Per-group flat prices remain visible through group_price_per_node."""
+        from kubernetes_autoscaler_tpu.cloudprovider.pricing import (
+            SimplePricingModel,
+        )
+
+        return SimplePricingModel(group_price_per_node={
+            gid: g.price_per_node for gid, g in self._groups.items()
+        })
 
     # ---- machine catalog for auto-provisioning (reference:
     # GetAvailableMachineTypes + NewNodeGroup, cloud_provider.go:128-131) ----

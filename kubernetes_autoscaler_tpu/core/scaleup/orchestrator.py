@@ -150,8 +150,10 @@ class ScaleUpOrchestrator:
             templates, enc.registry, enc.zone_table, enc.dims
         )
         est = estimator.estimate_all_groups(enc.specs, group_tensors, nodes_count)
-        scores = scoring.score_options(est, group_tensors)
-        options = options_from_scores(scores, [g.id() for g in groups])
+        scores = scoring.score_options(est, group_tensors, specs=enc.specs)
+        gpu_slot = enc.registry.try_slot_for(self.provider.gpu_resource_name())
+        options = options_from_scores(scores, [g.id() for g in groups],
+                                      groups=groups, gpu_slot=gpu_slot)
         options = self._verify_lossy_winners(
             options, est, enc, groups, estimator, group_tensors, nodes_count
         )
@@ -159,6 +161,12 @@ class ScaleUpOrchestrator:
             return ScaleUpResult(scaled_up=False, pods_remaining=pending_total,
                                  considered_options=[])
 
+        # per-loop context for filters that need it (price expander's
+        # preferred-node heuristic scales with cluster size)
+        for f in self.expander.filters:
+            set_ctx = getattr(f, "set_loop_context", None)
+            if set_ctx is not None:
+                set_ctx(nodes_count)
         best = self.expander.best_option(options)
         if best is None:
             return ScaleUpResult(scaled_up=False, pods_remaining=pending_total,
@@ -230,13 +238,20 @@ class ScaleUpOrchestrator:
             count[refuted] = 0
             masked = enc.specs.replace(count=jnp.asarray(count))
             redo = estimator.estimate_all_groups(masked, group_tensors, nodes_count)
-            sc = scoring.score_options(redo, group_tensors)
+            sc = scoring.score_options(redo, group_tensors, specs=masked)
             i = opt.group_index
             if bool(sc.valid[i]):
+                helped = np.asarray(sc.helped_req)
+                from kubernetes_autoscaler_tpu.models.resources import CPU, MEMORY
+
                 out.append(Option(
                     group_index=i, group_id=opt.group_id,
                     node_count=int(sc.nodes[i]), pod_count=int(sc.pods[i]),
                     waste=float(sc.waste[i]), price=float(sc.price[i]),
+                    template=opt.template, exists=opt.exists,
+                    helped_cpu_milli=float(helped[i, CPU]),
+                    helped_mem_mib=float(helped[i, MEMORY]),
+                    helped_gpus=opt.helped_gpus,
                 ))
         return out
 

@@ -97,7 +97,8 @@ class StaticAutoscaler:
         self.node_group_change_observers = NodeGroupChangeObserverList()
         self.cluster_state = ClusterStateRegistry(provider, self.options)
         self.quota = QuotaTracker(provider.get_resource_limiter(), None)  # registry set per loop
-        expander = build_expander(self.options.expander, expander_priorities)
+        expander = build_expander(self.options.expander, expander_priorities,
+                                  pricing=provider.pricing())
         # auto-provisioning wiring (reference: builder picks the
         # autoprovisioning NodeGroupListProcessor when the flag is on)
         from kubernetes_autoscaler_tpu.processors.nodegroups import (

@@ -33,12 +33,29 @@ class Option:
     pod_count: int
     waste: float
     price: float
+    # price-expander context (reference passes NodeInfos + option.Pods into
+    # priceBased.BestOptions; here the template + helped-request reductions
+    # ride along on the option)
+    template: object | None = None     # models.api.Node of the group
+    exists: bool = True
+    helped_cpu_milli: float = 0.0
+    helped_mem_mib: float = 0.0
+    helped_gpus: float = 0.0
 
 
-def options_from_scores(scores: OptionScores, group_ids: list[str]) -> list[Option]:
+def options_from_scores(scores: OptionScores, group_ids: list[str],
+                        groups: list | None = None,
+                        gpu_slot: int | None = None) -> list[Option]:
     valid = np.asarray(scores.valid)
-    return [
-        Option(
+    helped = (np.asarray(scores.helped_req)
+              if scores.helped_req is not None else None)
+    from kubernetes_autoscaler_tpu.models.resources import CPU, MEMORY
+
+    out = []
+    for i in range(valid.shape[0]):
+        if not valid[i]:
+            continue
+        o = Option(
             group_index=i,
             group_id=group_ids[i] if i < len(group_ids) else str(i),
             node_count=int(scores.nodes[i]),
@@ -46,9 +63,16 @@ def options_from_scores(scores: OptionScores, group_ids: list[str]) -> list[Opti
             waste=float(scores.waste[i]),
             price=float(scores.price[i]),
         )
-        for i in range(valid.shape[0])
-        if valid[i]
-    ]
+        if groups is not None and i < len(groups):
+            o.template = groups[i].template_node_info()
+            o.exists = groups[i].exist()
+        if helped is not None:
+            o.helped_cpu_milli = float(helped[i, CPU])
+            o.helped_mem_mib = float(helped[i, MEMORY])
+            if gpu_slot is not None:
+                o.helped_gpus = float(helped[i, gpu_slot])
+        out.append(o)
+    return out
 
 
 class Filter(Protocol):
@@ -168,15 +192,24 @@ class ChainStrategy:
 
 
 def build_expander(spec: str, priorities: dict[int, list[str]] | None = None,
-                   grpc_call=None, seed: int | None = 0) -> ChainStrategy:
+                   grpc_call=None, seed: int | None = 0,
+                   pricing=None) -> ChainStrategy:
     """reference: factory/expander_factory.go:55-82 — comma-separated names
-    compose into a chain. Deterministic seed by default (testability)."""
+    compose into a chain. Deterministic seed by default (testability).
+
+    `pricing` (a cloudprovider PricingModel) upgrades the 'price' name to the
+    full reference-formula expander (expander/price.py); without a model the
+    flat min-total-cost filter is used."""
     filters = []
     for name in [s for s in spec.split(",") if s]:
         if name == "priority":
             filters.append(PriorityFilter(priorities or {}))
         elif name == "grpc":
             filters.append(GrpcFilter(grpc_call))
+        elif name == "price" and pricing is not None:
+            from kubernetes_autoscaler_tpu.expander.price import PriceBasedFilter
+
+            filters.append(PriceBasedFilter(pricing))
         elif name in _REGISTRY:
             f = _REGISTRY[name]
             filters.append(f(seed) if f is RandomFilter else f())

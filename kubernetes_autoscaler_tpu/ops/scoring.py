@@ -27,12 +27,19 @@ class OptionScores(struct.PyTreeNode):
     nodes: jax.Array        # i32[NG] new nodes (least-nodes minimizes)
     waste: jax.Array        # f32[NG] leftover cpu+mem fraction (least-waste minimizes)
     price: jax.Array        # f32[NG] node_count × price_per_node (price minimizes)
+    helped_req: jax.Array | None = None  # f32[NG, R] Σ_g scheduled × req — the
+                                         # price expander's pod-cost input
 
 
-def score_options(est: EstimateResult, groups: NodeGroupTensors) -> OptionScores:
+def score_options(est: EstimateResult, groups: NodeGroupTensors,
+                  specs=None) -> OptionScores:
     pods = est.scheduled.sum(axis=-1)
     nodes = est.node_count
     valid = groups.valid & (nodes > 0) & (pods > 0)
+    helped_req = None
+    if specs is not None:
+        helped_req = (est.scheduled.astype(jnp.float32)
+                      @ specs.req.astype(jnp.float32))        # [NG, R]
 
     used = (est.pods_per_node > 0).astype(jnp.float32)            # f32[NG, M]
     cap_cpu = groups.cap[:, CPU].astype(jnp.float32)
@@ -45,7 +52,8 @@ def score_options(est: EstimateResult, groups: NodeGroupTensors) -> OptionScores
     waste = waste + jnp.where(total_mem > 0, free_mem / jnp.maximum(total_mem, 1.0), 1.0)
 
     price = nodes.astype(jnp.float32) * groups.price_per_node
-    return OptionScores(valid=valid, pods=pods, nodes=nodes, waste=waste, price=price)
+    return OptionScores(valid=valid, pods=pods, nodes=nodes, waste=waste,
+                        price=price, helped_req=helped_req)
 
 
 def best_option(scores: OptionScores, strategy: str = "least-waste") -> jax.Array:

@@ -10,8 +10,8 @@ assertions (core/bench/benchmark_runonce_test.go):
     utilization must consolidate — 60% of the nodes drain onto the other
     40% in one RunOnce (the reference asserts 240 of 400 tainted).
 
-Scaled to CPU-mesh-friendly sizes by default; the proportions and the
-assertions are the reference's. KA_TPU_BENCH_FULL=1 runs reference scale.
+REFERENCE scale runs by DEFAULT (each scenario is seconds on the virtual CPU
+mesh); KA_TPU_BENCH_FULL=0 opts down to reduced shapes for tiny machines.
 """
 
 import os
@@ -24,7 +24,7 @@ from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
 from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
 from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
 
-FULL = os.environ.get("KA_TPU_BENCH_FULL") == "1"
+FULL = os.environ.get("KA_TPU_BENCH_FULL", "1") == "1"
 
 
 def test_runonce_scale_up_benchmark_scenario():

@@ -1,0 +1,94 @@
+"""Real price expander: the reference formula from expander/price/price.go —
+priceSubScore × suppressed unfitness, GPU override, not-exist penalty,
+preferred-node scaling with cluster size.
+"""
+
+from kubernetes_autoscaler_tpu.cloudprovider.pricing import SimplePricingModel
+from kubernetes_autoscaler_tpu.expander.price import (
+    PriceBasedFilter,
+    node_unfitness,
+    preferred_node_cpu_milli,
+)
+from kubernetes_autoscaler_tpu.expander.strategies import Option, build_expander
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+_MIB = 1024 * 1024
+
+
+def _opt(gid, idx, cpu_milli, mem_mib, node_count, helped_cpu, gpus=0,
+         exists=True):
+    tmpl = build_test_node(f"{gid}-tmpl", cpu_milli=cpu_milli, mem_mib=mem_mib,
+                           gpus=gpus)
+    return Option(group_index=idx, group_id=gid, node_count=node_count,
+                  pod_count=10, waste=0.0, price=0.0, template=tmpl,
+                  exists=exists, helped_cpu_milli=helped_cpu,
+                  helped_mem_mib=1024.0)
+
+
+def test_preferred_node_tiers():
+    assert preferred_node_cpu_milli(2) == 1000
+    assert preferred_node_cpu_milli(20) == 4000
+    assert preferred_node_cpu_milli(1000) == 32000
+
+
+def test_unfitness_symmetric_ratio():
+    assert node_unfitness(4000, 1000) == 4.0
+    assert node_unfitness(1000, 4000) == 4.0
+    assert node_unfitness(4000, 4000) == 1.0
+
+
+def test_price_prefers_cheaper_fitting_group():
+    f = PriceBasedFilter(SimplePricingModel())
+    f.set_loop_context(cluster_size=10)   # preferred: 4-CPU nodes
+    # same work helped; big node costs ~4x and is also less "fit"
+    small = _opt("small", 0, 4000, 15000, node_count=4, helped_cpu=8000)
+    big = _opt("big", 1, 32000, 120000, node_count=1, helped_cpu=8000)
+    out = f.best_options([small, big])
+    assert [o.group_id for o in out] == ["small"]
+
+
+def test_gpu_groups_unattractive_for_cpu_pods():
+    f = PriceBasedFilter(SimplePricingModel())
+    f.set_loop_context(cluster_size=10)
+    plain = _opt("plain", 0, 4000, 15000, node_count=2, helped_cpu=4000)
+    gpu = _opt("gpu", 1, 4000, 15000, node_count=2, helped_cpu=4000, gpus=8)
+    out = f.best_options([plain, gpu])
+    assert [o.group_id for o in out] == ["plain"]
+
+
+def test_not_exist_penalty():
+    f = PriceBasedFilter(SimplePricingModel())
+    f.set_loop_context(cluster_size=10)
+    existing = _opt("existing", 0, 4000, 15000, node_count=2, helped_cpu=4000)
+    candidate = _opt("cand", 1, 4000, 15000, node_count=2, helped_cpu=4000,
+                     exists=False)
+    out = f.best_options([existing, candidate])
+    assert [o.group_id for o in out] == ["existing"]
+
+
+def test_build_expander_upgrades_price_with_model():
+    chain = build_expander("price", pricing=SimplePricingModel())
+    assert isinstance(chain.filters[0], PriceBasedFilter)
+    chain_flat = build_expander("price")
+    assert not isinstance(chain_flat.filters[0], PriceBasedFilter)
+
+
+def test_runonce_price_expander_end_to_end():
+    from test_runonce import autoscaler_for
+
+    fake = FakeCluster()
+    small = build_test_node("small-tmpl", cpu_milli=4000, mem_mib=15000)
+    huge = build_test_node("huge-tmpl", cpu_milli=64000, mem_mib=240000)
+    fake.add_node_group("ng-small", small, max_size=20)
+    fake.add_node_group("ng-huge", huge, max_size=20)
+    fake.add_existing_node(
+        "ng-small", build_test_node("seed", cpu_milli=4000, mem_mib=15000))
+    for i in range(6):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1500, mem_mib=512,
+                                    owner_name="rs"))
+    a = autoscaler_for(fake, expander="price")
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    # for a small cluster the 64-CPU monster is wildly unfit and expensive
+    assert list(status.scale_up.increases) == ["ng-small"]
