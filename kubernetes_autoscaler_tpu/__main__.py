@@ -75,9 +75,32 @@ def make_mux(autoscaler: StaticAutoscaler, snapshotter: DebuggingSnapshotter):
                 self._send(200, st.to_json() if st else "{}",
                            "application/json")
             elif self.path == "/snapshotz":
+                if snapshotter is None:
+                    self._send(404, "debugging snapshots disabled "
+                                    "(--debugging-snapshot-enabled=false)")
+                    return
                 handle = snapshotter.request_snapshot()
                 payload = handle.wait(timeout=120.0)
                 self._send(200 if payload else 504, payload or "timed out",
+                           "application/json")
+            elif self.path == "/profilez":
+                # --profiling consumer (reference: net/http/pprof behind
+                # --profiling, main.go:264-266): per-phase wall-time stats
+                # from the function_duration histograms as JSON
+                if not autoscaler.options.profiling:
+                    self._send(404, "profiling disabled (--profiling=false)")
+                    return
+                import json as _json
+
+                h = default_registry.histogram("function_duration_seconds")
+                out = {}
+                for key in list(h._sums):
+                    label = dict(key).get("function", "?")
+                    out[label] = {
+                        "count": int(sum(h._counts.get(key, []))),
+                        "sum_seconds": h._sums.get(key, 0.0),
+                    }
+                self._send(200, _json.dumps(out, indent=2),
                            "application/json")
             else:
                 self._send(404, "not found")
@@ -93,7 +116,8 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     fake = cluster_from_scenario(args.scenario)
-    snapshotter = DebuggingSnapshotter()
+    snapshotter = (DebuggingSnapshotter()
+                   if options.debugging_snapshot_enabled else None)
     autoscaler = StaticAutoscaler(
         fake.provider, fake, options=options, eviction_sink=fake,
         debugging_snapshotter=snapshotter,

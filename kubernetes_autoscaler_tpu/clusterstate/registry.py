@@ -85,6 +85,13 @@ class ClusterStateRegistry:
         self.last_scale_up_time = max(self.last_scale_up_time, now)
 
     def register_failed_scale_up(self, group: NodeGroup, now: float) -> None:
+        from kubernetes_autoscaler_tpu.metrics.metrics import default_registry
+
+        default_registry.counter("failed_scale_ups_total").inc()
+        tmpl = group.template_node_info()
+        if any(r not in ("cpu", "memory", "pods", "ephemeral-storage")
+               for r in tmpl.alloc_or_cap()):
+            default_registry.counter("failed_gpu_scale_ups_total").inc()
         """reference: RegisterFailedScaleUp → backoff the group."""
         self.failed_scale_ups[group.id()] = now
         self.backoff.backoff(group.id(), now)

@@ -66,10 +66,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="min:max cluster cores (reference --cores-total)")
     p.add_argument("--memory-total", default="0:6400000",
                    help="min:max cluster memory in GiB")
+    p.add_argument("--gpu-total", default="0:0",
+                   help="min:max cluster GPUs (0:0 = unlimited)")
     p.add_argument("--balance-similar-node-groups", type=_bool, default=False)
+    p.add_argument("--balancing-label", action="append", default=[])
+    p.add_argument("--balancing-ignore-label", action="append", default=[])
+    p.add_argument("--max-allocatable-difference-ratio", type=float, default=0.05)
+    p.add_argument("--max-free-difference-ratio", type=float, default=0.05)
+    p.add_argument("--memory-difference-ratio", type=float, default=0.015)
     p.add_argument("--new-pod-scale-up-delay", type=dur, default=0.0)
     p.add_argument("--expendable-pods-priority-cutoff", type=int, default=-10)
     p.add_argument("--max-binpacking-time", type=dur, default=300.0)
+    p.add_argument("--enforce-node-group-min-size", type=_bool, default=False)
+    p.add_argument("--parallel-scale-up", type=_bool, default=True)
+    p.add_argument("--scale-up-from-zero", type=_bool, default=True)
+    p.add_argument("--scale-from-unschedulable", type=_bool, default=False)
+    p.add_argument("--async-node-groups", type=_bool, default=False)
+    p.add_argument("--salvo-scale-up", type=_bool, default=False)
+    p.add_argument("--salvo-scale-up-budget", type=dur, default=2.0)
+    p.add_argument("--node-autoprovisioning-enabled", type=_bool, default=False)
+    p.add_argument("--max-autoprovisioned-node-group-count", type=int, default=15)
+    p.add_argument("--pod-injection-limit", type=int, default=5000)
 
     # scale-down
     p.add_argument("--scale-down-enabled", type=_bool, default=True)
@@ -91,6 +108,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--skip-nodes-with-custom-controller-pods", type=_bool,
                    default=False)
     p.add_argument("--min-replica-count", type=int, default=0)
+    p.add_argument("--scale-down-unready-enabled", type=_bool, default=True)
+    p.add_argument("--scale-down-non-empty-candidates-count", type=int, default=0,
+                   help="0 = unlimited (device sweep is exhaustive; the "
+                        "reference's 30 guards a serial simulator)")
+    p.add_argument("--max-bulk-soft-taint-count", type=int, default=10)
+    p.add_argument("--max-bulk-soft-taint-time", type=dur, default=3.0)
+    p.add_argument("--node-deletion-candidate-ttl", type=dur, default=1800.0)
+    p.add_argument("--unremovable-node-recheck-timeout", type=dur, default=300.0)
+    p.add_argument("--cordon-node-before-terminating", type=_bool, default=False)
+    p.add_argument("--daemonset-eviction-for-empty-nodes", type=_bool, default=False)
+    p.add_argument("--daemonset-eviction-for-occupied-nodes", type=_bool, default=True)
+    p.add_argument("--ignore-mirror-pods-utilization", type=_bool, default=False)
 
     # cluster health
     p.add_argument("--max-total-unready-percentage", type=float, default=45.0)
@@ -111,6 +140,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--leader-elect-lease-file", default="/tmp/ka-tpu-leader.lock")
     p.add_argument("--profiling", type=_bool, default=False)
     p.add_argument("--ignore-daemonsets-utilization", type=_bool, default=False)
+    p.add_argument("--emit-per-nodegroup-metrics", type=_bool, default=False)
+    p.add_argument("--debugging-snapshot-enabled", type=_bool, default=False)
+    p.add_argument("--write-status-configmap", type=_bool, default=True)
+    p.add_argument("--status-config-map-name", default="cluster-autoscaler-status")
+    p.add_argument("--max-inactivity", type=dur, default=600.0)
+    p.add_argument("--max-failing-time", type=dur, default=900.0)
+    p.add_argument("--max-startup-time", type=dur, default=1200.0)
+    p.add_argument("--grpc-expander-url", default="")
+    p.add_argument("--grpc-expander-cert", default="")
+
+    # subsystem gates
+    p.add_argument("--enable-provisioning-requests", type=_bool, default=True)
+    p.add_argument("--capacity-buffer-controller-enabled", type=_bool, default=True)
+    p.add_argument("--capacity-buffer-pod-injection-enabled", type=_bool, default=True)
+    p.add_argument("--capacity-quotas-enabled", type=_bool, default=True)
+    p.add_argument("--enable-dynamic-resource-allocation", type=_bool, default=True)
+    p.add_argument("--enable-csi-node-aware-scheduling", type=_bool, default=True)
+    p.add_argument("--node-removal-latency-tracking-enabled", type=_bool, default=True)
 
     # TPU data plane (no reference analog — Go has no tracing/compile cache)
     p.add_argument("--node-shape-bucket", type=int, default=256)
@@ -135,7 +182,52 @@ def _min_max(text: str) -> tuple[int, int]:
 def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
     _, max_cores = _min_max(args.cores_total)
     _, max_mem_gib = _min_max(args.memory_total)
+    _, max_gpus = _min_max(args.gpu_total)
     return AutoscalingOptions(
+        enforce_node_group_min_size=args.enforce_node_group_min_size,
+        parallel_scale_up=args.parallel_scale_up,
+        scale_up_from_zero=args.scale_up_from_zero,
+        scale_from_unschedulable=args.scale_from_unschedulable,
+        async_node_group_creation=args.async_node_groups,
+        scale_up_salvo_enabled=args.salvo_scale_up,
+        salvo_time_budget_s=args.salvo_scale_up_budget,
+        node_autoprovisioning_enabled=args.node_autoprovisioning_enabled,
+        max_autoprovisioned_node_group_count=args.max_autoprovisioned_node_group_count,
+        max_gpu_total=max_gpus,
+        max_allocatable_difference_ratio=args.max_allocatable_difference_ratio,
+        max_free_difference_ratio=args.max_free_difference_ratio,
+        memory_difference_ratio=args.memory_difference_ratio,
+        balancing_labels=list(args.balancing_label),
+        balancing_ignore_labels=list(args.balancing_ignore_label),
+        pod_injection_limit=args.pod_injection_limit,
+        scale_down_unready_enabled=args.scale_down_unready_enabled,
+        scale_down_non_empty_candidates_count=args.scale_down_non_empty_candidates_count,
+        max_bulk_soft_taint_count=args.max_bulk_soft_taint_count,
+        max_bulk_soft_taint_time_s=args.max_bulk_soft_taint_time,
+        node_deletion_candidate_ttl_s=args.node_deletion_candidate_ttl,
+        unremovable_node_recheck_timeout_s=args.unremovable_node_recheck_timeout,
+        cordon_node_before_terminating=args.cordon_node_before_terminating,
+        daemonset_eviction_for_empty_nodes=args.daemonset_eviction_for_empty_nodes,
+        daemonset_eviction_for_occupied_nodes=args.daemonset_eviction_for_occupied_nodes,
+        ignore_mirror_pods_utilization=args.ignore_mirror_pods_utilization,
+        emit_per_nodegroup_metrics=args.emit_per_nodegroup_metrics,
+        debugging_snapshot_enabled=args.debugging_snapshot_enabled,
+        write_status_configmap=args.write_status_configmap,
+        status_config_map_name=args.status_config_map_name,
+        max_inactivity_s=args.max_inactivity,
+        max_failing_time_s=args.max_failing_time,
+        max_startup_time_s=args.max_startup_time,
+        profiling=args.profiling,
+        grpc_expander_url=args.grpc_expander_url,
+        grpc_expander_cert=args.grpc_expander_cert,
+        enable_provisioning_requests=args.enable_provisioning_requests,
+        capacity_buffer_controller_enabled=(
+            args.capacity_buffer_controller_enabled
+            and args.capacity_buffer_pod_injection_enabled),
+        capacity_quotas_enabled=args.capacity_quotas_enabled,
+        enable_dynamic_resource_allocation=args.enable_dynamic_resource_allocation,
+        enable_csi_node_aware_scheduling=args.enable_csi_node_aware_scheduling,
+        node_removal_latency_tracking_enabled=args.node_removal_latency_tracking_enabled,
         scan_interval_s=args.scan_interval,
         estimator=args.estimator,
         expander=args.expander,
@@ -186,6 +278,23 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
 
 def parse_options(argv: list[str] | None = None
                   ) -> tuple[AutoscalingOptions, argparse.Namespace]:
+    from kubernetes_autoscaler_tpu.config.flag_parity import REJECTED
+
     args, unknown = build_parser().parse_known_args(argv)
-    # unknown flags: parity-accepted, ignored (see module docstring)
+    # Unknown flags: if the reference defines them and this framework
+    # deliberately rejects them (flag_parity.REJECTED), log the reason and
+    # continue — operator flag soups keep working. Anything else is an error:
+    # a typo'd or truly unknown flag must never become a silent no-op.
+    for tok in unknown:
+        if not tok.startswith("--"):
+            continue
+        name = tok[2:].split("=", 1)[0]
+        if name in REJECTED:
+            import sys
+
+            print(f"[flags] --{name} accepted without effect: {REJECTED[name]}",
+                  file=sys.stderr)
+        else:
+            raise SystemExit(f"unknown flag --{name} (not a reference flag "
+                             "this framework implements or rejects)")
     return options_from_args(args), args

@@ -34,8 +34,8 @@ class AutoscalingOptions:
     expander: str = "least-waste"                  # comma-separated chain, reference flags.go
     max_nodes_per_scaleup: int = 1000              # FAQ.md:1086
     max_nodes_total: int = 0                       # 0 = unlimited
-    max_cores_total: int = 320000
-    max_memory_total_mib: int = 32 * 10**6
+    max_cores_total: int = 320000                  # reference --cores-total max
+    max_memory_total_mib: int = 6400000 * 1024     # reference --memory-total max (GiB→MiB)
     balance_similar_node_groups: bool = False
     new_pod_scale_up_delay_s: float = 0.0
     expendable_pods_priority_cutoff: int = -10
@@ -53,6 +53,30 @@ class AutoscalingOptions:
     # async_initializer.go — the loop never blocks on slow cloud creation)
     async_node_group_creation: bool = False
 
+    # scale-up extras
+    enforce_node_group_min_size: bool = False      # --enforce-node-group-min-size
+    parallel_scale_up: bool = True                 # --parallel-scale-up (executor threads)
+    scale_up_from_zero: bool = True                # --scale-up-from-zero
+    scale_from_unschedulable: bool = False         # --scale-from-unschedulable
+    max_gpu_total: int = 0                         # --gpu-total (0 = unlimited)
+    # similar-nodegroup balancing knobs (reference:
+    # processors/nodegroupset/compare_nodegroups.go + --balancing-*-label)
+    max_allocatable_difference_ratio: float = 0.05
+    max_free_difference_ratio: float = 0.05
+    memory_difference_ratio: float = 0.015
+    balancing_labels: list[str] = field(default_factory=list)
+    balancing_ignore_labels: list[str] = field(default_factory=list)
+    pod_injection_limit: int = 5000                # --pod-injection-limit
+
+    # subsystem gates (reference feature flags)
+    enable_provisioning_requests: bool = True
+    capacity_buffer_controller_enabled: bool = True
+    capacity_quotas_enabled: bool = True
+    enable_dynamic_resource_allocation: bool = True
+    enable_csi_node_aware_scheduling: bool = True
+    node_removal_latency_tracking_enabled: bool = True
+    max_startup_time_s: float = 20 * 60.0          # --max-startup-time (liveness)
+
     # scale-down
     scale_down_enabled: bool = True
     scale_down_delay_after_add_s: float = 600.0
@@ -60,6 +84,12 @@ class AutoscalingOptions:
     scale_down_delay_after_failure_s: float = 180.0
     scale_down_candidates_pool_ratio: float = 1.0
     scale_down_candidates_pool_min_count: int = 50
+    scale_down_unready_enabled: bool = True        # --scale-down-unready-enabled
+    # --scale-down-non-empty-candidates-count: the reference defaults to 30
+    # because its per-candidate drain simulation is serial and slow; the
+    # device sweep evaluates every candidate in one dispatch, so the default
+    # here is 0 (unlimited). Setting the flag still caps the pool.
+    scale_down_non_empty_candidates_count: int = 0
     max_scale_down_parallelism: int = 10
     max_drain_parallelism: int = 1
     max_empty_bulk_delete: int = 10
@@ -68,6 +98,28 @@ class AutoscalingOptions:
     skip_nodes_with_local_storage: bool = True
     skip_nodes_with_custom_controller_pods: bool = False
     min_replica_count: int = 0
+    # soft-taint WAL budgets (reference: --max-bulk-soft-taint-count/-time)
+    max_bulk_soft_taint_count: int = 10
+    max_bulk_soft_taint_time_s: float = 3.0
+    # DeletionCandidate taints older than this are stale on recovery
+    # (reference: --node-deletion-candidate-ttl)
+    node_deletion_candidate_ttl_s: float = 30 * 60.0
+    unremovable_node_recheck_timeout_s: float = 5 * 60.0  # --unremovable-node-recheck-timeout
+    cordon_node_before_terminating: bool = False   # --cordon-node-before-terminating
+    daemonset_eviction_for_empty_nodes: bool = False
+    daemonset_eviction_for_occupied_nodes: bool = True
+    ignore_mirror_pods_utilization: bool = False
+
+    # observability / process
+    emit_per_nodegroup_metrics: bool = False       # --emit-per-nodegroup-metrics
+    debugging_snapshot_enabled: bool = False       # --debugging-snapshot-enabled
+    write_status_configmap: bool = True            # --write-status-configmap
+    status_config_map_name: str = "cluster-autoscaler-status"
+    max_inactivity_s: float = 10 * 60.0            # --max-inactivity (liveness)
+    max_failing_time_s: float = 15 * 60.0          # --max-failing-time (liveness)
+    profiling: bool = False                        # --profiling (pprof analog)
+    grpc_expander_url: str = ""                    # --grpc-expander-url
+    grpc_expander_cert: str = ""                   # --grpc-expander-cert
 
     # cluster health (reference: clusterstate config)
     max_total_unready_percentage: float = 45.0

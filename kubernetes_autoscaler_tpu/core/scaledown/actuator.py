@@ -130,8 +130,24 @@ class Actuator:
         drain = [r for r in to_remove if not r.is_empty]
 
         for r in to_remove:
+            if self.options.cordon_node_before_terminating:
+                # reference: --cordon-node-before-terminating marks the node
+                # unschedulable before the taint lands
+                r.node.unschedulable = True
             self.taint_to_be_deleted(r.node)
             self.tracker.start(r.node.name, now)
+
+        def evict_daemonsets(r: NodeToRemove) -> None:
+            """--daemonset-eviction-for-{empty,occupied}-nodes."""
+            enabled = (self.options.daemonset_eviction_for_empty_nodes
+                       if r.is_empty
+                       else self.options.daemonset_eviction_for_occupied_nodes)
+            if not enabled or not self.eviction_sink or not pods_by_slot:
+                return
+            for s in r.ds_to_evict:
+                pod = pods_by_slot.get(s)
+                if pod is not None:
+                    self.eviction_sink.evict(pod, r.node)
 
         results: list[DeletionResult] = []
         # empty nodes: batched per group (reference: delete_in_batch.go)
@@ -150,6 +166,8 @@ class Actuator:
             for start in range(0, len(rs), step):
                 batch = rs[start:start + step]
                 try:
+                    for r in batch:
+                        evict_daemonsets(r)
                     g.delete_nodes([r.node for r in batch])
                     for r in batch:
                         self.tracker.finish(r.node.name, True)
@@ -176,6 +194,12 @@ class Actuator:
                             raise NodeGroupError("PDB budget exhausted")
                     for pod in priority_eviction_order(victims):
                         self.eviction_sink.evict(pod, r.node)
+                    from kubernetes_autoscaler_tpu.metrics.metrics import (
+                        default_registry,
+                    )
+
+                    default_registry.counter("evicted_pods_total").inc(len(victims))
+                evict_daemonsets(r)
                 g = self.provider.node_group_for_node(r.node)
                 if g is None:
                     raise NodeGroupError("no node group")

@@ -32,4 +32,7 @@ class NodeLatencyTracker:
             return None
         latency = now - t
         self.observed.append((node, latency))
+        from kubernetes_autoscaler_tpu.metrics.metrics import default_registry
+
+        default_registry.histogram("node_removal_latency_seconds").observe(latency)
         return latency

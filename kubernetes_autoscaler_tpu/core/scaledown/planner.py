@@ -43,6 +43,9 @@ class NodeToRemove:
     is_empty: bool
     pods_to_move: list[int] = field(default_factory=list)   # scheduled-pod slots
     destinations: dict[int, int] = field(default_factory=dict)  # slot -> node idx
+    ds_to_evict: list[int] = field(default_factory=list)    # daemonset pod slots
+    # (reference: --daemonset-eviction-for-{empty,occupied}-nodes consumes
+    # these in the actuator)
 
 
 @dataclass
@@ -61,7 +64,8 @@ class Planner:
         self.options = options
         self.quota = quota
         self.unneeded_nodes = UnneededNodes()
-        self.unremovable = UnremovableNodes()
+        self.unremovable = UnremovableNodes(
+            ttl_s=options.unremovable_node_recheck_timeout_s)
         self.state = PlannerState()
         self.pdb_tracker = pdb_tracker          # shared with the actuator
         self.latency_tracker = latency_tracker
@@ -72,7 +76,7 @@ class Planner:
                now: float | None = None) -> PlannerState:
         now = time.time() if now is None else now
         n_real = len(nodes)
-        util = np.asarray(util_ops.node_utilization(enc.nodes))[:n_real]
+        util = self._utilization(enc, nodes)
         defaults = _ng_defaults(self.options)
 
         eligible_idx: list[int] = []
@@ -81,6 +85,9 @@ class Planner:
             self.state.utilization[nd.name] = float(util[i])
             if nd.annotations.get(SCALE_DOWN_DISABLED_KEY) == "true":
                 self._mark(nd.name, "ScaleDownDisabledAnnotation", now)
+                continue
+            if not nd.ready and not self.options.scale_down_unready_enabled:
+                self._mark(nd.name, "ScaleDownUnreadyDisabled", now)
                 continue
             g = self.provider.node_group_for_node(nd)
             if g is None:
@@ -121,6 +128,19 @@ class Planner:
                     self.options.scale_down_candidates_pool_min_count,
                 )
                 eligible_idx = eligible_idx[:pool]
+            # cap candidates that need a DRAIN simulation with pods to move
+            # (reference: --scale-down-non-empty-candidates-count; empty
+            # nodes are cheap and exempt). 0 = unlimited.
+            cap = self.options.scale_down_non_empty_candidates_count
+            if cap > 0:
+                kept, non_empty = [], 0
+                for i in eligible_idx:
+                    if i in occupied:
+                        if non_empty >= cap:
+                            continue
+                        non_empty += 1
+                    kept.append(i)
+                eligible_idx = kept
 
         if not eligible_idx:
             self.state.unneeded = []
@@ -168,6 +188,42 @@ class Planner:
     def _mark(self, name: str, reason: str, now: float) -> None:
         self.unremovable.add(name, reason, now)
 
+    def _utilization(self, enc: EncodedCluster, nodes: list[Node]) -> np.ndarray:
+        """Per-node dominant-resource utilization, with daemonset and mirror
+        pod usage excluded per the flags (reference: utilization/info.go
+        CalculateUtilization skipDaemonSetPods/skipMirrorPods)."""
+        n_real = len(nodes)
+        util = np.asarray(util_ops.node_utilization(enc.nodes))[:n_real]
+        defaults = _ng_defaults(self.options)
+        ignore_mirror = self.options.ignore_mirror_pods_utilization
+        ignore_ds_ids: set[int] = set()
+        for i, nd in enumerate(nodes):
+            g = self.provider.node_group_for_node(nd)
+            if g is None:
+                continue
+            flag = g.get_options(defaults).ignore_daemonsets_utilization
+            if flag is None:
+                flag = defaults.ignore_daemonsets_utilization
+            if flag:
+                ignore_ds_ids.add(i)
+        if not ignore_mirror and not ignore_ds_ids:
+            return util
+        from kubernetes_autoscaler_tpu.models.resources import CPU, MEMORY
+
+        cap = np.asarray(enc.nodes.cap, dtype=np.float64)[:n_real]
+        alloc = np.asarray(enc.nodes.alloc, dtype=np.float64)[:n_real].copy()
+        reqs = np.asarray(enc.scheduled.req, dtype=np.float64)
+        for j, p in enumerate(enc.scheduled_pods):
+            ni = enc.node_index.get(p.node_name, -1)
+            if ni < 0 or ni >= n_real:
+                continue
+            skip = (ignore_mirror and p.is_mirror()) or (
+                ni in ignore_ds_ids and p.is_daemonset())
+            if skip:
+                alloc[ni] -= reqs[j]
+        ratio = alloc / np.maximum(cap, 1.0)
+        return np.maximum(ratio[:, CPU], ratio[:, MEMORY])
+
     # ---- final selection (reference: NodesToDelete :151) ----
 
     def nodes_to_delete(self, enc: EncodedCluster, nodes: list[Node],
@@ -214,6 +270,10 @@ class Planner:
         node_valid = (np.asarray(enc.nodes.valid)
                       & np.asarray(enc.nodes.ready)
                       & np.asarray(enc.nodes.schedulable))
+        ds_by_node: dict[str, list[int]] = {}
+        for j, p in enumerate(enc.scheduled_pods):
+            if p.is_daemonset():
+                ds_by_node.setdefault(p.node_name, []).append(j)
         ordered = sorted(self.state.unneeded, key=lambda n: self.unneeded_nodes.since.get(n, now))
 
         # Atomic-group pre-screen (reference: AtomicResizeFilteringProcessor):
@@ -435,7 +495,8 @@ class Planner:
                 # The actuator evicts only pods physically on the node;
                 # received slots were capacity bookkeeping for the pass.
                 out.append(NodeToRemove(nd, bool(is_empty),
-                                        pods_to_move=orig_slots))
+                                        pods_to_move=orig_slots,
+                                        ds_to_evict=ds_by_node.get(nd.name, [])))
 
             # backstop: an atomic group that only PARTIALLY confirmed (a
             # member failed mid-pass) must not ship partial deletions

@@ -141,11 +141,15 @@ class ScaleUpOrchestrator:
             nodes=enc.nodes,
             with_constraints=enc.has_constraints,
         )
-        templates = [
-            (g.template_node_info(), g.max_size() - g.target_size(),
-             getattr(g, "price_per_node", 1.0))
-            for g in groups
-        ]
+        templates = []
+        for g in groups:
+            tmpl = g.template_node_info()
+            if self.options.scale_from_unschedulable and tmpl.unschedulable:
+                # reference: --scale-from-unschedulable ignores
+                # .spec.unschedulable in node templates
+                tmpl.unschedulable = False
+            templates.append((tmpl, g.max_size() - g.target_size(),
+                              getattr(g, "price_per_node", 1.0)))
         group_tensors = encode_node_groups(
             templates, enc.registry, enc.zone_table, enc.dims
         )
@@ -217,6 +221,11 @@ class ScaleUpOrchestrator:
             return options
         all_nodes, pods_by_node = enc.all_nodes_and_pods()
         scheduled = np.asarray(est.scheduled)  # [NG, G]
+        # --max-binpacking-time bounds the whole option computation; once the
+        # budget is gone, options needing a re-estimate are dropped rather
+        # than shipped unverified (reference: BinpackingLimiter stops
+        # computing further options)
+        deadline = time.monotonic() + self.options.max_binpacking_time_s
         out = []
         for opt in options:
             g_t = groups[opt.group_index].template_node_info()
@@ -233,6 +242,8 @@ class ScaleUpOrchestrator:
             if not refuted:
                 out.append(opt)
                 continue
+            if time.monotonic() > deadline:
+                continue  # budget exhausted: unverifiable option is dropped
             # re-estimate this one node group with the refuted pods removed
             count = np.asarray(enc.specs.count).copy()
             count[refuted] = 0
@@ -269,7 +280,8 @@ class ScaleUpOrchestrator:
             if self._ng_opts(g).zero_or_max_node_scaling:
                 continue  # an atomic sibling cannot absorb a partial split
             t = g.template_node_info()
-            if _similar_templates(tmpl, t) and g.target_size() < g.max_size():
+            if _similar_templates(tmpl, t, self.options) \
+                    and g.target_size() < g.max_size():
                 similar.append(g)
         total = best.node_count
         plan: dict[str, int] = {}
@@ -301,6 +313,13 @@ class ScaleUpOrchestrator:
                 allowed = self.quota.max_nodes_addable(
                     status, g.template_node_info(), capped[gid]
                 )
+                if allowed < capped[gid]:
+                    from kubernetes_autoscaler_tpu.metrics.metrics import (
+                        default_registry,
+                    )
+
+                    default_registry.counter("skipped_scale_events_count").inc(
+                        direction="up", reason="ResourceLimits")
                 if allowed < capped[gid] and self._ng_opts(g).zero_or_max_node_scaling:
                     # an atomic group cannot partially scale: all or nothing
                     del capped[gid]
@@ -336,7 +355,8 @@ class ScaleUpOrchestrator:
                 g.increase_size(delta)
             return gid, delta, False
 
-        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        workers = 8 if self.options.parallel_scale_up else 1
+        with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
             futures = {ex.submit(one, gid, d): gid for gid, d in plan.items()}
             for fut in concurrent.futures.as_completed(futures):
                 gid = futures[fut]
@@ -372,11 +392,18 @@ class ScaleUpOrchestrator:
         return result
 
 
-def _similar_templates(a, b) -> bool:
-    """Reference similarity: capacity within 5%, same labels ignoring
-    zone/hostname (processors/nodegroupset/compare_nodegroups.go:105)."""
+def _similar_templates(a, b, options: AutoscalingOptions | None = None) -> bool:
+    """Reference similarity: capacity within --max-allocatable-difference-ratio
+    (memory within --memory-difference-ratio), same labels ignoring
+    zone/hostname plus --balancing-ignore-label entries; --balancing-label
+    switches to comparing ONLY the listed labels
+    (processors/nodegroupset/compare_nodegroups.go:105 + flags)."""
     IGNORE = {"kubernetes.io/hostname", "topology.kubernetes.io/zone",
               "failure-domain.beta.kubernetes.io/zone"}
+    ratio = options.max_allocatable_difference_ratio if options else 0.05
+    mem_ratio = options.memory_difference_ratio if options else 0.015
+    if options:
+        IGNORE = IGNORE | set(options.balancing_ignore_labels)
 
     def caps(n):
         return {k: float(v) for k, v in n.alloc_or_cap().items()}
@@ -386,8 +413,12 @@ def _similar_templates(a, b) -> bool:
         return False
     for k in ca:
         hi = max(ca[k], cb[k])
-        if hi > 0 and abs(ca[k] - cb[k]) / hi > 0.05:
+        limit = mem_ratio if k == "memory" else ratio
+        if hi > 0 and abs(ca[k] - cb[k]) / hi > limit:
             return False
+    if options and options.balancing_labels:
+        keys = options.balancing_labels
+        return all(a.labels.get(k) == b.labels.get(k) for k in keys)
     la = {k: v for k, v in a.labels.items() if k not in IGNORE}
     lb = {k: v for k, v in b.labels.items() if k not in IGNORE}
     return la == lb

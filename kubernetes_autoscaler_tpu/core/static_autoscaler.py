@@ -43,7 +43,10 @@ from kubernetes_autoscaler_tpu.processors.processors import (
     AutoscalingProcessors,
     ProcessorContext,
 )
-from kubernetes_autoscaler_tpu.resourcequotas.tracker import QuotaTracker
+from kubernetes_autoscaler_tpu.resourcequotas.tracker import (
+    QuotaTracker,
+    merge_flag_limits,
+)
 from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
     DrainOptions,
     apply_drainability,
@@ -87,7 +90,11 @@ class StaticAutoscaler:
         self.source = source
         self.processors = processors or AutoscalingProcessors.default()
         self.metrics = registry or default_registry
-        self.health = HealthCheck()
+        self.health = HealthCheck(
+            max_inactivity_s=self.options.max_inactivity_s,
+            max_failing_time_s=self.options.max_failing_time_s,
+            max_startup_time_s=self.options.max_startup_time_s,
+        )
         # debugging /snapshotz collector (reference: debuggingsnapshot/)
         self.debugging_snapshotter = debugging_snapshotter
         # status-document sink (reference: WriteStatusConfigMap each loop)
@@ -96,7 +103,11 @@ class StaticAutoscaler:
         # scale event broadcast (reference: observers/nodegroupchange)
         self.node_group_change_observers = NodeGroupChangeObserverList()
         self.cluster_state = ClusterStateRegistry(provider, self.options)
-        self.quota = QuotaTracker(provider.get_resource_limiter(), None)  # registry set per loop
+        # flag-level cores/memory/GPU caps merge into the provider's limiter
+        # (reference: resourcequotas default provider wraps --cores-total etc.)
+        limiter = merge_flag_limits(provider.get_resource_limiter(), self.options)
+        self.quota = (QuotaTracker(limiter, None)  # registry set per loop
+                      if self.options.capacity_quotas_enabled else None)
         expander = build_expander(self.options.expander, expander_priorities,
                                   pricing=provider.pricing())
         # auto-provisioning wiring (reference: builder picks the
@@ -131,7 +142,9 @@ class StaticAutoscaler:
         # shared scale-down trackers (reference: planner & actuator share one
         # RemainingPdbTracker; latency spans plan→delete)
         self.pdb_tracker = RemainingPdbTracker()
-        self.latency_tracker = NodeLatencyTracker()
+        self.latency_tracker = (
+            NodeLatencyTracker()
+            if self.options.node_removal_latency_tracking_enabled else None)
         self.planner = Planner(provider, self.options, None,
                                pdb_tracker=self.pdb_tracker,
                                latency_tracker=self.latency_tracker)
@@ -148,7 +161,8 @@ class StaticAutoscaler:
         # the scale-up orchestrator when ProvReq support is on) — active when
         # the data source exposes requests
         self.provreq_wrapper = None
-        list_provreqs = getattr(source, "list_provisioning_requests", None)
+        list_provreqs = (getattr(source, "list_provisioning_requests", None)
+                         if self.options.enable_provisioning_requests else None)
         if list_provreqs is not None:
             from kubernetes_autoscaler_tpu.provisioningrequest.orchestrator import (
                 ProvReqOrchestrator,
@@ -171,6 +185,16 @@ class StaticAutoscaler:
 
     def run_once(self, now: float | None = None) -> RunOnceStatus:
         now = time.time() if now is None else now
+        try:
+            return self._run_once_inner(now)
+        except Exception as e:
+            # liveness + errors_total (reference: errors surface through
+            # metrics.RegisterError and fail the HealthCheck's failing clock)
+            self.health.mark_failed(now)
+            self.metrics.counter("errors_total").inc(type=type(e).__name__)
+            raise
+
+    def _run_once_inner(self, now: float) -> RunOnceStatus:
         status = RunOnceStatus()
         with self.metrics.time_function("main"):
             self.provider.refresh()
@@ -183,12 +207,18 @@ class StaticAutoscaler:
                 status.ran = False
                 status.aborted_reason = "no nodes"
                 return status
+            if not self.options.scale_up_from_zero and not any(
+                nd.ready for nd in nodes
+            ):
+                status.ran = False
+                status.aborted_reason = "no ready nodes (--scale-up-from-zero=false)"
+                return status
 
             # crash recovery (first loop only): resume unneeded clocks from
             # DeletionCandidate soft taints — the scale-down WAL — and clear
             # stale ToBeDeleted taints a crashed predecessor left behind
             if not self._startup_recovery_done:
-                self._recover_scale_down_state(nodes)
+                self._recover_scale_down_state(nodes, now)
                 self._startup_recovery_done = True
             self.processors.custom_resources.filter_ready(nodes)
 
@@ -208,8 +238,10 @@ class StaticAutoscaler:
                 status.aborted_reason = "cluster unhealthy"
                 return status
 
-            # min-size enforcement (reference: ScaleUpToNodeGroupMinSize :223)
-            self.scale_up_orchestrator.scale_up_to_min_sizes(now)
+            # min-size enforcement (reference: ScaleUpToNodeGroupMinSize :223,
+            # gated by --enforce-node-group-min-size)
+            if self.options.enforce_node_group_min_size:
+                self.scale_up_orchestrator.scale_up_to_min_sizes(now)
 
             # ProvisioningRequests on alternating turns (reference:
             # WrapperOrchestrator, provisioningrequest/orchestrator/)
@@ -233,14 +265,18 @@ class StaticAutoscaler:
             # DRA / CSI lowering (reference: DraProvider/CsiProvider.Snapshot
             # at static_autoscaler.go:313-328, joined into NodeInfos) — device
             # claims and volume limits fold into the resource axis pre-encode
-            dra_snapshot_fn = getattr(self.source, "dra_snapshot", None)
+            dra_snapshot_fn = (getattr(self.source, "dra_snapshot", None)
+                               if self.options.enable_dynamic_resource_allocation
+                               else None)
             if dra_snapshot_fn is not None:
                 from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
                     apply_dra,
                 )
 
                 apply_dra(nodes, pods, dra_snapshot_fn())
-            csi_snapshot_fn = getattr(self.source, "csi_snapshot", None)
+            csi_snapshot_fn = (getattr(self.source, "csi_snapshot", None)
+                               if self.options.enable_csi_node_aware_scheduling
+                               else None)
             if csi_snapshot_fn is not None:
                 from kubernetes_autoscaler_tpu.simulator.csi import apply_csi
 
@@ -263,7 +299,8 @@ class StaticAutoscaler:
                     pdb_namespaced_names=self.pdb_tracker.namespaced_names_with_pdb(
                         [p for p in pods if p.node_name]
                     ))
-            self.quota.registry = enc.registry
+            if self.quota is not None:
+                self.quota.registry = enc.registry
             self.scale_up_orchestrator.quota = self.quota
             self.planner.quota = self.quota
             snapshot = TensorClusterSnapshot(enc)
@@ -339,6 +376,12 @@ class StaticAutoscaler:
                     self.metrics.counter("scaled_up_nodes_total").inc(
                         sum(result.increases.values())
                     )
+                    gpu_nodes = sum(
+                        d for gid, d in result.increases.items()
+                        if self._group_has_gpu(gid)
+                    )
+                    if gpu_nodes:
+                        self.metrics.counter("scaled_up_gpu_nodes_total").inc(gpu_nodes)
 
             # scale-down (reference: scaleDown :749; delay gating :604)
             if self.options.scale_down_enabled and not scaled_up \
@@ -385,6 +428,12 @@ class StaticAutoscaler:
                     self.metrics.counter("scaled_down_nodes_total").inc(
                         len(status.scale_down_deleted)
                     )
+                    gpu_deleted = sum(
+                        1 for n in status.scale_down_deleted
+                        if self._group_has_gpu(group_of.get(n, ""))
+                    )
+                    if gpu_deleted:
+                        self.metrics.counter("scaled_down_gpu_nodes_total").inc(gpu_deleted)
 
             # reap empty autoprovisioned groups (reference: NodeGroupManager
             # cleanup in the default processors chain)
@@ -392,14 +441,14 @@ class StaticAutoscaler:
                 self.node_group_manager.remove_unneeded_node_groups(self.provider)
 
             # status document (reference: WriteStatusConfigMap every loop,
-            # static_autoscaler.go:418-421)
+            # static_autoscaler.go:418-421; gated by --write-status-configmap)
             from kubernetes_autoscaler_tpu.clusterstate.api import build_status
 
             self.last_status = build_status(
                 self.cluster_state, now,
                 scale_down_candidates=status.unneeded_nodes,
             )
-            if self.status_sink is not None:
+            if self.status_sink is not None and self.options.write_status_configmap:
                 try:
                     self.status_sink(self.last_status)
                 except Exception:
@@ -407,6 +456,23 @@ class StaticAutoscaler:
 
             if self.debugging_snapshotter is not None:
                 self.debugging_snapshotter.flush(now)
+
+            # per-loop metric sweep (reference: metrics.Update* calls spread
+            # through RunOnce; per-nodegroup series behind the flag)
+            from kubernetes_autoscaler_tpu.metrics.parity import (
+                emit_cluster_metrics,
+            )
+
+            emit_cluster_metrics(
+                self.metrics, self.cluster_state, self.provider, self.options,
+                enc, now, health=self.health,
+                latency_tracker=self.latency_tracker)
+            self.metrics.gauge("unremovable_nodes_count").set(
+                float(len(self.planner.unremovable.entries)))
+            self.metrics.gauge("pending_node_deletions").set(
+                float(self.actuator.tracker.in_flight()))
+            self.metrics.gauge("scale_down_in_cooldown").set(
+                0.0 if self._scale_down_allowed(now) else 1.0)
 
             self.health.mark_active(now)
         return status
@@ -480,6 +546,13 @@ class StaticAutoscaler:
             snapshot.add_node(t, group_id=-1)
         return count
 
+    def _group_has_gpu(self, gid: str) -> bool:
+        g = next((x for x in self.provider.node_groups() if x.id() == gid), None)
+        if g is None:
+            return False
+        cap = g.template_node_info().alloc_or_cap()
+        return float(cap.get(self.provider.gpu_resource_name(), 0.0)) > 0
+
     def _node_group_index(self, nodes: list[Node]) -> dict[str, int]:
         group_ids = {g.id(): i for i, g in enumerate(self.provider.node_groups())}
         out = {}
@@ -489,7 +562,7 @@ class StaticAutoscaler:
                 out[nd.name] = group_ids.get(g.id(), -1)
         return out
 
-    def _recover_scale_down_state(self, nodes: list[Node]) -> None:
+    def _recover_scale_down_state(self, nodes: list[Node], now: float) -> None:
         """First-loop WAL replay: DeletionCandidate taint values are the
         epoch timestamps the clocks started at (actuator writes them);
         leftover ToBeDeleted taints from a crashed run are removed so the
@@ -499,14 +572,21 @@ class StaticAutoscaler:
             TO_BE_DELETED_TAINT,
         )
 
+        ttl = self.options.node_deletion_candidate_ttl_s
         tainted_since: dict[str, float] = {}
         for nd in nodes:
             for t in nd.taints:
                 if t.key == DELETION_CANDIDATE_TAINT:
                     try:
-                        tainted_since[nd.name] = float(t.value)
+                        since = float(t.value)
                     except ValueError:
-                        pass
+                        continue
+                    # stale intent is discarded, fresh clocks resume
+                    # (reference: --node-deletion-candidate-ttl)
+                    if ttl <= 0 or now - since <= ttl:
+                        tainted_since[nd.name] = since
+                    else:
+                        self.actuator.untaint(nd, DELETION_CANDIDATE_TAINT)
             if any(t.key == TO_BE_DELETED_TAINT for t in nd.taints):
                 self.actuator.untaint(nd, TO_BE_DELETED_TAINT)
         if tainted_since:
@@ -514,19 +594,28 @@ class StaticAutoscaler:
 
     def _sync_soft_taints(self, nodes: list[Node]) -> None:
         """Make DeletionCandidate taints mirror the unneeded set: taint newly
-        unneeded nodes, clean taints off nodes that became needed again."""
+        unneeded nodes, clean taints off nodes that became needed again.
+        Bounded per loop by --max-bulk-soft-taint-count updates and
+        --max-bulk-soft-taint-time wall clock (reference: softtaint.go
+        UpdateSoftDeletionTaints budgets) — the rest catches up next loop."""
         from kubernetes_autoscaler_tpu.models.api import DELETION_CANDIDATE_TAINT
 
+        budget = self.options.max_bulk_soft_taint_count
+        deadline = time.monotonic() + self.options.max_bulk_soft_taint_time_s
         unneeded = set(self.planner.state.unneeded)
         for nd in nodes:
+            if budget <= 0 or time.monotonic() > deadline:
+                break
             has = any(t.key == DELETION_CANDIDATE_TAINT for t in nd.taints)
             if nd.name in unneeded and not has:
                 self.actuator.taint_deletion_candidate(
                     nd, since=self.planner.unneeded_nodes.since.get(nd.name))
+                budget -= 1
             elif has and nd.name not in unneeded:
                 self.actuator.untaint(nd, DELETION_CANDIDATE_TAINT)
                 if self.actuator.on_taint:
                     self.actuator.on_taint(nd, "")
+                budget -= 1
 
     def _scale_down_allowed(self, now: float) -> bool:
         o = self.options
@@ -545,6 +634,7 @@ class StaticAutoscaler:
                 continue
             try:
                 g.delete_nodes([Node(name=u.name)])
+                self.metrics.counter("old_unregistered_nodes_removed_count").inc()
             except Exception:
                 pass
 
@@ -565,6 +655,7 @@ class StaticAutoscaler:
             # back off FIRST — even if deletion fails (e.g. min-size guard),
             # a group producing create-errors must stop winning scale-ups
             self.cluster_state.register_failed_scale_up(g, now)
+            self.metrics.counter("failed_node_creations_total").inc(len(errored))
             try:
                 g.delete_nodes([Node(name=i.name) for i in errored])
             except Exception:

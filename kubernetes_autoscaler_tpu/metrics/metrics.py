@@ -89,13 +89,17 @@ class Registry:
 
     @contextmanager
     def time_function(self, label: str):
-        """reference: function_duration_seconds histogram per FunctionLabel."""
+        """reference: function_duration_seconds histogram per FunctionLabel
+        (+ the quantile variant, metrics.go function_duration_quantile)."""
         h = self.histogram("function_duration_seconds")
+        q = self.histogram("function_duration_quantile_seconds")
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            h.observe(time.perf_counter() - t0, function=label)
+            dt = time.perf_counter() - t0
+            h.observe(dt, function=label)
+            q.observe(dt, function=label)
 
     def expose_text(self) -> str:
         """Prometheus exposition format (consumed by the /metrics endpoint)."""
@@ -134,17 +138,39 @@ def _fmt(key: tuple, **extra) -> str:
 
 @dataclass
 class HealthCheck:
-    """reference: metrics/liveness.go — fails liveness when the loop stalls."""
+    """reference: metrics/liveness.go — fails liveness when the loop stalls
+    (--max-inactivity), keeps failing (--max-failing-time), or never completes
+    a first successful run (--max-startup-time)."""
 
     max_inactivity_s: float = 600.0
+    max_failing_time_s: float = 900.0
+    max_startup_time_s: float = 1200.0
+    started: float = field(default_factory=time.time)
     last_activity: float = field(default_factory=time.time)
+    last_success: float = 0.0
+    last_failure: float = 0.0
 
     def mark_active(self, now: float | None = None) -> None:
-        self.last_activity = now if now is not None else time.time()
+        now = time.time() if now is None else now
+        self.last_activity = now
+        self.last_success = now
+
+    def mark_failed(self, now: float | None = None) -> None:
+        now = time.time() if now is None else now
+        self.last_activity = now
+        self.last_failure = now
 
     def healthy(self, now: float | None = None) -> bool:
         now = time.time() if now is None else now
-        return now - self.last_activity <= self.max_inactivity_s
+        if self.last_success == 0.0:
+            # never completed a run: bounded by the startup budget
+            return now - self.started <= self.max_startup_time_s
+        if now - self.last_activity > self.max_inactivity_s:
+            return False
+        if (self.last_failure > self.last_success
+                and now - self.last_success > self.max_failing_time_s):
+            return False
+        return True
 
 
 default_registry = Registry()

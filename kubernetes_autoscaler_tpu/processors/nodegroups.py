@@ -75,7 +75,11 @@ class NodeGroupManager:
     def create_node_group(self, group: NodeGroup) -> NodeGroup:
         if group.exist():
             return group
-        return group.create()
+        created = group.create()
+        from kubernetes_autoscaler_tpu.metrics.metrics import default_registry
+
+        default_registry.counter("created_node_groups_total").inc()
+        return created
 
     def remove_unneeded_node_groups(self, provider: CloudProvider) -> list[str]:
         """Delete empty autoprovisioned groups (no nodes, target 0)."""
@@ -89,6 +93,11 @@ class NodeGroupManager:
                 try:
                     g.delete()
                     removed.append(g.id())
+                    from kubernetes_autoscaler_tpu.metrics.metrics import (
+                        default_registry,
+                    )
+
+                    default_registry.counter("deleted_node_groups_total").inc()
                 except NodeGroupError:
                     pass
         return removed

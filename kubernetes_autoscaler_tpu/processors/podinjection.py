@@ -56,7 +56,17 @@ class PodInjectionProcessor:
         list_workloads = getattr(ctx, "list_workloads", None)
         if list_workloads is None:
             return pods
+        # total injected fake pods per loop are capped (reference:
+        # --pod-injection-limit, default 5000)
+        limit = getattr(getattr(ctx, "options", None), "pod_injection_limit", 5000)
         out = list(pods)
+        injected = 0
         for w in list_workloads():
-            out.extend(injected_pods_for(w, pods))
+            fakes = injected_pods_for(w, pods)
+            if limit > 0 and injected + len(fakes) > limit:
+                fakes = fakes[: max(limit - injected, 0)]
+            out.extend(fakes)
+            injected += len(fakes)
+            if limit > 0 and injected >= limit:
+                break
         return out

@@ -21,6 +21,23 @@ CORES = "cpu"
 MEMORY = "memory"
 
 
+def merge_flag_limits(limiter: ResourceLimiter, options) -> ResourceLimiter:
+    """Fold --cores-total/--memory-total/--gpu-total caps into the provider's
+    ResourceLimiter (reference: resourcequotas default provider wraps the flag
+    limits; flags.go --cores-total et al.)."""
+    max_limits = dict(limiter.max_limits)
+
+    def cap(name: str, value: float) -> None:
+        if value > 0:
+            max_limits[name] = min(max_limits.get(name, 1 << 60), value)
+
+    cap(CORES, options.max_cores_total)
+    cap(MEMORY, options.max_memory_total_mib)
+    cap("nvidia.com/gpu", options.max_gpu_total)
+    return ResourceLimiter(min_limits=dict(limiter.min_limits),
+                           max_limits=max_limits)
+
+
 @dataclass
 class QuotaStatus:
     """Current cluster totals in limiter units (cores, MiB, custom counts)."""

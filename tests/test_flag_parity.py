@@ -1,0 +1,116 @@
+"""Flag parity vs the reference's config/flags/flags.go: every reference flag
+is either implemented (the parser accepts it AND it maps to an option with a
+consumer) or explicitly rejected with a reason; nothing is a silent no-op.
+"""
+
+import pytest
+
+from kubernetes_autoscaler_tpu.config import flag_parity
+from kubernetes_autoscaler_tpu.config.flags import build_parser, parse_options
+
+# The reference's flag list, transcribed from config/flags/flags.go (the
+# interface contract this framework tracks; names only).
+REFERENCE_FLAGS = """
+address allowed-scheduler-names async-node-groups aws-use-static-instance-list
+balance-similar-node-groups balancing-ignore-label balancing-label
+blocking-system-pod-distruption-timeout bulk-mig-instances-listing-enabled
+bypassed-scheduler-names capacity-buffer-controller-enabled
+capacity-buffer-pod-dry-run-enabled capacity-buffer-pod-injection-enabled
+capacity-quotas-enabled check-capacity-batch-processing
+check-capacity-processor-instance
+check-capacity-provisioning-request-batch-timebox
+check-capacity-provisioning-request-max-batch-size cloud-config cloud-provider
+cluster-name cluster-snapshot-parallelism clusterapi-cloud-config-authoritative
+cordon-node-before-terminating cores-total daemonset-eviction-for-empty-nodes
+daemonset-eviction-for-occupied-nodes debugging-snapshot-enabled
+drain-priority-config dynamic-node-delete-delay-after-taint-enabled
+emit-per-nodegroup-metrics enable-csi-node-aware-scheduling
+enable-dynamic-resource-allocation enable-proactive-scaleup
+enable-provisioning-requests enforce-node-group-min-size estimator expander
+expendable-pods-priority-cutoff fastpath-binpacking-enabled
+force-delete-failed-nodes force-delete-unregistered-nodes
+frequent-loops-enabled gce-concurrent-refreshes
+gce-mig-instances-min-refresh-wait-time gpu-total grpc-expander-cert
+grpc-expander-url ignore-daemonsets-utilization ignore-mirror-pods-utilization
+ignore-taint initial-node-group-backoff-duration kube-api-content-type
+kube-client-burst kube-client-qps kubeconfig max-allocatable-difference-ratio
+max-binpacking-time max-bulk-soft-taint-count max-bulk-soft-taint-time
+max-drain-parallelism max-failing-time max-free-difference-ratio
+max-graceful-termination-sec max-inactivity max-node-group-backoff-duration
+max-node-provision-time max-node-skip-eval-time-tracker-enabled
+max-node-startup-time max-nodegroup-binpacking-duration max-nodes-per-scaleup
+max-nodes-total max-pod-eviction-time max-scale-down-parallelism
+max-startup-time max-total-unready-percentage memory-difference-ratio
+memory-total min-replica-count namespace new-pod-scale-up-delay
+node-delete-delay-after-taint node-deletion-batcher-interval
+node-deletion-candidate-ttl node-deletion-delay-timeout
+node-group-auto-discovery node-group-backoff-reset-timeout
+node-info-cache-expire-time node-removal-latency-tracking-enabled nodes
+ok-total-unready-count parallel-scale-up pod-injection-limit
+predicate-parallelism profiling provisioning-request-initial-backoff-time
+provisioning-request-max-backoff-cache-size
+provisioning-request-max-backoff-time record-duplicated-events regional
+salvo-scale-up salvo-scale-up-budget scale-down-candidates-pool-min-count
+scale-down-candidates-pool-ratio scale-down-delay-after-add
+scale-down-delay-after-delete scale-down-delay-after-failure
+scale-down-delay-type-local scale-down-enabled
+scale-down-gpu-utilization-threshold scale-down-non-empty-candidates-count
+scale-down-simulation-timeout scale-down-unneeded-time
+scale-down-unready-enabled scale-down-unready-time
+scale-down-utilization-threshold scale-from-unschedulable scale-up-from-zero
+scaleup-simulation-for-skipped-node-groups-enabled scan-interval
+skip-nodes-with-custom-controller-pods skip-nodes-with-local-storage
+skip-nodes-with-system-pods startup-taint status-config-map-name status-taint
+unremovable-node-recheck-timeout user-agent write-status-configmap
+""".split()
+
+def test_every_reference_flag_is_classified():
+    covered = set(flag_parity.IMPLEMENTED) | set(flag_parity.REJECTED)
+    missing = [f for f in REFERENCE_FLAGS if f not in covered]
+    assert not missing, f"unclassified reference flags: {missing}"
+
+
+def test_no_flag_in_both_buckets():
+    both = set(flag_parity.IMPLEMENTED) & set(flag_parity.REJECTED)
+    assert not both
+
+
+def test_parser_accepts_every_implemented_flag():
+    parser = build_parser()
+    known = set()
+    for action in parser._actions:
+        for opt in action.option_strings:
+            known.add(opt.lstrip("-"))
+    for f in flag_parity.IMPLEMENTED:
+        assert f in known, f"--{f} marked implemented but the parser lacks it"
+
+
+def test_rejected_flags_accepted_without_effect(capsys):
+    opts, _ = parse_options(["--kubeconfig", "/tmp/kc", "--predicate-parallelism", "16"])
+    err = capsys.readouterr().err
+    assert "--kubeconfig accepted without effect" in err
+    assert "--predicate-parallelism accepted without effect" in err
+
+
+def test_truly_unknown_flag_errors():
+    with pytest.raises(SystemExit):
+        parse_options(["--definitely-not-a-flag", "1"])
+
+
+def test_implemented_flags_reach_options():
+    opts, _ = parse_options([
+        "--async-node-groups", "true",
+        "--salvo-scale-up", "true",
+        "--max-bulk-soft-taint-count", "3",
+        "--scale-down-unready-enabled", "false",
+        "--cordon-node-before-terminating", "true",
+        "--gpu-total", "0:16",
+        "--emit-per-nodegroup-metrics", "true",
+    ])
+    assert opts.async_node_group_creation
+    assert opts.scale_up_salvo_enabled
+    assert opts.max_bulk_soft_taint_count == 3
+    assert not opts.scale_down_unready_enabled
+    assert opts.cordon_node_before_terminating
+    assert opts.max_gpu_total == 16
+    assert opts.emit_per_nodegroup_metrics

@@ -36,7 +36,7 @@ def test_flags_map_to_options():
         "--max-nodes-total", "500",
         "--cores-total", "0:1000",
         "--balance-similar-node-groups", "true",
-        "--some-unknown-cloud-flag", "xyz",       # parity-ignored
+        "--cloud-config", "/etc/cloud.conf",      # parity-rejected, ignored
     ])
     assert opts.scan_interval_s == 30.0
     assert opts.expander == "priority,least-waste"
