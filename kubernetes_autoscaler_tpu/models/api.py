@@ -125,6 +125,10 @@ class Pod:
     restart_policy: str = "Always"
     volumes_with_local_storage: int = 0                       # emptyDir/hostPath count (drain rule)
     pvc_refs: tuple[str, ...] = ()
+    # names of ResourceClaims this pod references beyond its owned (template)
+    # claims — the shared-claim reference edge (reference:
+    # pod.spec.resourceClaims; consumed by simulator/dynamicresources.py)
+    resource_claims: tuple[str, ...] = ()
 
     def is_daemonset(self) -> bool:
         return self.owner is not None and self.owner.kind == "DaemonSet"

@@ -81,26 +81,124 @@ class ResourceClaim:
     reserved_for: list[str] = field(default_factory=list)
 
 
+# ReservedFor list cap (reference: resourceapi.ResourceClaimReservedForMaxSize)
+RESERVED_FOR_MAX = 32
+
+
 @dataclass
 class DraSnapshot:
     """The queryable DRA world handed to the lowering pass (reference:
-    DraProvider.Snapshot() at static_autoscaler.go:313)."""
+    DraProvider.Snapshot() at static_autoscaler.go:313).
+
+    Fork/commit/revert mirror the reference's patchset store
+    (simulator/dynamicresources/snapshot + simulator/common/patchset.go):
+    the mutable claim state (allocation + reservations) is checkpointed as an
+    overlay stack; slices and classes are immutable within a loop."""
 
     classes: dict[str, DeviceClass] = field(default_factory=dict)
     slices: list[ResourceSlice] = field(default_factory=list)
     claims: list[ResourceClaim] = field(default_factory=list)
+    _stack: list[dict[str, tuple[str, tuple[str, ...]]]] = field(
+        default_factory=list, repr=False)
+
+    # ---- fork/commit/revert (reference: patchset Fork/Commit/Revert) ----
+
+    def fork(self) -> None:
+        self._stack.append({
+            c.name: (c.allocated_node, tuple(c.reserved_for))
+            for c in self.claims
+        })
+
+    def revert(self) -> None:
+        if not self._stack:
+            raise RuntimeError("revert without fork")
+        saved = self._stack.pop()
+        for c in self.claims:
+            if c.name in saved:
+                node, reserved = saved[c.name]
+                c.allocated_node = node
+                c.reserved_for = list(reserved)
+
+    def commit(self) -> None:
+        if not self._stack:
+            raise RuntimeError("commit without fork")
+        self._stack.pop()  # keep the current (child) state
+
+    # ---- queries ----
+
+    def claim_by_name(self, name: str, namespace: str = "default"
+                      ) -> ResourceClaim | None:
+        for c in self.claims:
+            if c.name == name and c.namespace == namespace:
+                return c
+        return None
 
     def claims_for_pod(self, pod: Pod) -> list[ResourceClaim]:
-        return [c for c in self.claims
-                if c.owner_pod == pod.name and c.namespace == pod.namespace]
+        """Owned (template) claims plus referenced shared claims."""
+        out = [c for c in self.claims
+               if c.owner_pod == pod.name and c.namespace == pod.namespace]
+        for name in pod.resource_claims:
+            c = self.claim_by_name(name, pod.namespace)
+            if c is not None and c not in out:
+                out.append(c)
+        return out
+
+    def sharers_of(self, claim: ResourceClaim, pods: list[Pod]) -> list[Pod]:
+        return [p for p in pods
+                if p.namespace == claim.namespace
+                and (claim.name in p.resource_claims
+                     or claim.owner_pod == p.name)]
 
     def device_capacity(self) -> dict[str, dict[str, int]]:
-        """node -> class -> device count."""
+        """node -> class -> device count. Global slices (node_name == "")
+        are pool devices not tied to any node and impose no node constraint."""
         out: dict[str, dict[str, int]] = {}
         for s in self.slices:
+            if not s.node_name:
+                continue
             per = out.setdefault(s.node_name, {})
             per[s.device_class] = per.get(s.device_class, 0) + s.count
         return out
+
+    # ---- reservation (reference: claim reservation in RunReserve) ----
+
+    def reserve(self, claim: ResourceClaim, pod: Pod, node_name: str) -> bool:
+        """Allocate (if needed) and add the pod to ReservedFor. False when
+        the claim is bound elsewhere or the ReservedFor list is full."""
+        if claim.allocated_node and claim.allocated_node != node_name:
+            if self._is_node_local(claim):
+                return False
+        if len(claim.reserved_for) >= RESERVED_FOR_MAX:
+            return False
+        if not claim.allocated_node and self._is_node_local(claim):
+            claim.allocated_node = node_name
+        ref = f"{pod.namespace}/{pod.name}"
+        if ref not in claim.reserved_for:
+            claim.reserved_for.append(ref)
+        return True
+
+    def release(self, pod: Pod) -> None:
+        """Drop the pod's reservations; deallocate claims nobody holds
+        (reference: unreserve + deallocation on drain/unschedule)."""
+        ref = f"{pod.namespace}/{pod.name}"
+        for c in self.claims_for_pod(pod):
+            if ref in c.reserved_for:
+                c.reserved_for.remove(ref)
+            if not c.reserved_for:
+                c.allocated_node = ""
+
+    def _is_node_local(self, claim: ResourceClaim) -> bool:
+        """A claim binds to one node unless EVERY request's class is served
+        by a global pool (node_name == "" slices). Classes with no slices at
+        all — e.g. scale-from-zero, where only templates advertise devices —
+        are node-local (the conservative and correct default)."""
+        for req in claim.requests:
+            has_global = any(not s.node_name
+                             and s.device_class == req.device_class
+                             for s in self.slices)
+            if not has_global:
+                return True
+        return False
 
 
 def slice_matches(s: ResourceSlice, req: ClaimRequest,
@@ -131,34 +229,105 @@ def claim_fits_exact(claim: ResourceClaim, node: Node, dra: DraSnapshot,
     return True
 
 
+DRA_SHARED_LABEL_PREFIX = "dra.claim/"
+
+
 def apply_dra(nodes: list[Node], pods: list[Pod], dra: DraSnapshot) -> None:
     """The lowering pass: fold device counts into node capacity and claim
     counts into pod requests as 'dra/<class>' extended resources, BEFORE
-    encode_cluster. Pods with selectored or shared claims additionally get
-    the host-check annotation (consumed by models/encode)."""
+    encode_cluster.
+
+    Shared claims (multiple sharers, reference: ReservedFor) lower to:
+      * allocated node-local claim  → every PENDING sharer gets a hostname
+        nodeSelector to the allocated node (dense); devices are charged to
+        that node once by subtracting from its published capacity.
+      * unallocated node-local claim → one REPRESENTATIVE sharer carries the
+        device request; all sharers get a synthetic self pod-affinity on
+        hostname (the gang shape the wave placer handles exactly, including
+        the first-pod exception) so they co-locate where the devices are.
+      * global-pool claims (only global slices provide the class) impose no
+        node constraint and charge nothing node-local.
+    Pods with selectored claims or other inexpressible shapes get the
+    host-check annotation (claim_fits_exact is the exact tier).
+
+    Totals are recomputed and OVERWRITTEN each pass — the loop re-lists the
+    same Pod objects every tick, so += would compound across loops."""
     cap = dra.device_capacity()
+    # devices held by allocated claims of NON-resident owners (shared claims
+    # or claims of departed pods) reduce the node's free devices; resident
+    # owners are charged through their own pod requests at encode time
+    pods_by_ref = {f"{p.namespace}/{p.name}": p for p in pods}
+    held: dict[str, dict[str, int]] = {}
+    for claim in dra.claims:
+        if not claim.allocated_node:
+            continue
+        resident_owner = any(
+            pods_by_ref.get(ref) is not None
+            and pods_by_ref[ref].node_name == claim.allocated_node
+            and pods_by_ref[ref].name == claim.owner_pod
+            for ref in claim.reserved_for
+        )
+        if claim.owner_pod and resident_owner:
+            continue  # charged via the owner pod's lowered requests
+        per = held.setdefault(claim.allocated_node, {})
+        for req in claim.requests:
+            per[req.device_class] = per.get(req.device_class, 0) + req.count
     for nd in nodes:
         for cls, count in cap.get(nd.name, {}).items():
             key = DRA_RESOURCE_PREFIX + cls
-            nd.capacity[key] = count
+            free = count - held.get(nd.name, {}).get(cls, 0)
+            nd.capacity[key] = max(free, 0)
             if nd.allocatable:
-                nd.allocatable[key] = count
+                nd.allocatable[key] = max(free, 0)
 
-    # allocated claims on live nodes consume device capacity exactly like
-    # resident pods consume cpu/mem (encode charges scheduled pods' requests).
-    # Totals are recomputed and OVERWRITTEN each pass — the loop re-lists the
-    # same Pod objects every tick, so += would compound across loops.
+    shared_rep: dict[str, str] = {}   # claim key -> representative pod name
+    for claim in dra.claims:
+        sharers = dra.sharers_of(claim, pods)
+        if len(sharers) <= 1 or not dra._is_node_local(claim):
+            continue
+        ckey = f"{claim.namespace}/{claim.name}"
+        pending = [p for p in sharers if not p.node_name]
+        if claim.allocated_node:
+            # bound claim: pending sharers can only go where the devices are
+            for p in pending:
+                p.node_selector["kubernetes.io/hostname"] = claim.allocated_node
+        elif pending:
+            shared_rep[ckey] = pending[0].name
+            from kubernetes_autoscaler_tpu.models.api import AffinityTerm
+
+            gang_label = DRA_SHARED_LABEL_PREFIX + claim.name
+            for p in pending:
+                p.labels[gang_label] = "y"
+                if not any(t.match_labels == {gang_label: "y"}
+                           for t in p.pod_affinity):
+                    p.pod_affinity.append(AffinityTerm(
+                        match_labels={gang_label: "y"}))
+
     for pod in pods:
         totals: dict[str, int] = {}
         lossy = False
         for claim in dra.claims_for_pod(pod):
-            if len(claim.reserved_for) > 1:
-                lossy = True
+            sharers = dra.sharers_of(claim, pods)
+            shared = len(sharers) > 1 or not claim.owner_pod
+            if (claim.allocated_node and not pod.node_name
+                    and claim.owner_pod == pod.name):
+                # owned claim already bound: the pod must follow its devices,
+                # which `held` charged to the node (no double charge)
+                pod.node_selector["kubernetes.io/hostname"] = claim.allocated_node
+                continue
             for req in claim.requests:
-                key = DRA_RESOURCE_PREFIX + req.device_class
-                totals[key] = totals.get(key, 0) + req.count
                 if req.selector:
                     lossy = True
+                if not dra._is_node_local(claim):
+                    continue  # global pool: no node-local charge
+                key = DRA_RESOURCE_PREFIX + req.device_class
+                if shared:
+                    ckey = f"{claim.namespace}/{claim.name}"
+                    if shared_rep.get(ckey) == pod.name:
+                        totals[key] = totals.get(key, 0) + req.count
+                        lossy = True  # exact tier re-checks the gang charge
+                else:
+                    totals[key] = totals.get(key, 0) + req.count
         for key, total in totals.items():
             pod.requests[key] = total
         if lossy:

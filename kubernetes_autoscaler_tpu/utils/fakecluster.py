@@ -156,6 +156,11 @@ class FakeCluster:
         if live is not None:
             live.node_name = ""
             live.phase = "Pending"
+        # eviction releases DRA claim reservations; claims nobody holds
+        # deallocate so their devices free up (reference: drain path
+        # unreserving claims through the DRA snapshot)
+        if self._dra is not None:
+            self._dra.release(pod)
 
     # ---- fixture helpers ----
 

@@ -1,0 +1,237 @@
+"""RunOnce scenarios with DRA claims, mirroring the reference's
+core/static_autoscaler_dra_test.go table: per-pod device claims, shared
+claims (allocated and unallocated), scale-from-zero with template devices,
+drain freeing devices, and fork/commit/revert claim-state safety.
+"""
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.config.options import NodeGroupDefaults
+from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+    ClaimRequest,
+    DeviceClass,
+    DraSnapshot,
+    ResourceClaim,
+    ResourceSlice,
+)
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+from test_runonce import autoscaler_for
+
+GPU = "gpu.example.com"
+
+
+def _world(n_seed_nodes=1, devices_per_node=1, max_size=10):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=10000, mem_mib=16384)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=max_size)
+    dra = fake.dra_snapshot()
+    dra.classes[GPU] = DeviceClass(GPU)
+    for i in range(n_seed_nodes):
+        name = f"seed-{i}"
+        fake.add_existing_node(
+            "ng1", build_test_node(name, cpu_milli=10000, mem_mib=16384))
+        dra.slices.append(ResourceSlice(name, GPU, devices_per_node))
+    # template nodes advertise the same devices (reference: template pods /
+    # slices on the template NodeInfo) — the group template must carry them
+    tmpl.capacity[f"dra/{GPU}"] = devices_per_node
+    tmpl.allocatable[f"dra/{GPU}"] = devices_per_node
+    return fake, dra
+
+
+def _device_pod(name, dra, count=1, node_name=""):
+    p = build_test_pod(name, cpu_milli=500, mem_mib=256, owner_name="rs",
+                       node_name=node_name)
+    dra.claims.append(ResourceClaim(
+        f"{name}-claim", owner_pod=name,
+        requests=[ClaimRequest(GPU, count)]))
+    if node_name:
+        p.phase = "Running"
+        c = dra.claims[-1]
+        c.allocated_node = node_name
+        c.reserved_for.append(f"{p.namespace}/{p.name}")
+    return p
+
+
+def test_scale_up_one_pod_per_node_one_device():
+    # reference: "scale-up: one pod per node, one device per node" —
+    # 1xGPU nodes; 1 scheduled + 3 unschedulable 1xGPU pods -> 3 new nodes
+    fake, dra = _world(n_seed_nodes=1, devices_per_node=1)
+    fake.add_pod(_device_pod("scheduled-0", dra, node_name="seed-0"))
+    for i in range(3):
+        fake.add_pod(_device_pod(f"unsched-{i}", dra))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is not None
+    assert status.scale_up.increases == {"ng1": 3}
+
+
+def test_scale_up_multiple_pods_per_node():
+    # reference: "multiple pods per node, pods requesting one device" —
+    # 3xGPU nodes; 2 scheduled + 10 unschedulable -> ceil((10-1)/3)=3 new
+    fake, dra = _world(n_seed_nodes=1, devices_per_node=3)
+    fake.add_pod(_device_pod("scheduled-0", dra, node_name="seed-0"))
+    fake.add_pod(_device_pod("scheduled-1", dra, node_name="seed-0"))
+    for i in range(10):
+        fake.add_pod(_device_pod(f"unsched-{i}", dra))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    # 1 device left on seed; 9 pods over 3-device nodes -> 3 new nodes
+    assert status.scale_up.increases == {"ng1": 3}
+
+
+def test_scale_up_from_zero_nodes():
+    # reference: "scale from 0 nodes in a node group"
+    fake, dra = _world(n_seed_nodes=0, devices_per_node=2)
+    # actionable-cluster gate needs some node; give an unrelated busy one
+    other = build_test_node("other", cpu_milli=1000, mem_mib=1024)
+    fake.add_node_group("ng-other", build_test_node(
+        "other-tmpl", cpu_milli=1000, mem_mib=1024), min_size=1, max_size=1)
+    fake.add_existing_node("ng-other", other)
+    for i in range(4):
+        fake.add_pod(_device_pod(f"unsched-{i}", dra))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up.increases == {"ng1": 2}  # 2 devices per node
+
+
+def test_no_scale_up_when_devices_split_across_groups():
+    # reference: "pods requesting multiple different devices, but they're on
+    # different nodes" — no template offers both -> no scale-up
+    NIC = "nic.example.com"
+    fake = FakeCluster()
+    gpu_tmpl = build_test_node("gpu-tmpl", cpu_milli=10000, mem_mib=16384)
+    gpu_tmpl.capacity["dra/" + GPU] = 1
+    gpu_tmpl.allocatable["dra/" + GPU] = 1
+    nic_tmpl = build_test_node("nic-tmpl", cpu_milli=10000, mem_mib=16384)
+    nic_tmpl.capacity["dra/" + NIC] = 1
+    nic_tmpl.allocatable["dra/" + NIC] = 1
+    fake.add_node_group("ng-gpu", gpu_tmpl, max_size=5)
+    fake.add_node_group("ng-nic", nic_tmpl, max_size=5)
+    fake.add_existing_node("ng-gpu", build_test_node(
+        "seed", cpu_milli=100, mem_mib=128))
+    dra = fake.dra_snapshot()
+    dra.classes[GPU] = DeviceClass(GPU)
+    dra.classes[NIC] = DeviceClass(NIC)
+    for i in range(3):
+        p = build_test_pod(f"both-{i}", cpu_milli=500, mem_mib=256,
+                           owner_name="rs")
+        dra.claims.append(ResourceClaim(
+            f"both-{i}-claim", owner_pod=f"both-{i}",
+            requests=[ClaimRequest(GPU, 1), ClaimRequest(NIC, 1)]))
+        fake.add_pod(p)
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is None or not status.scale_up.scaled_up
+
+
+def test_shared_unallocated_claim_binds_to_one_node():
+    # reference: "pods requesting a shared, unallocated claim" — all sharers
+    # must land on ONE node; only one new node helps regardless of pod count
+    fake, dra = _world(n_seed_nodes=0, devices_per_node=1, max_size=10)
+    other = build_test_node("other", cpu_milli=1000, mem_mib=1024)
+    fake.add_node_group("ng-other", build_test_node(
+        "other-tmpl", cpu_milli=1000, mem_mib=1024), min_size=1, max_size=1)
+    fake.add_existing_node("ng-other", other)
+    shared = ResourceClaim("shared-gpu", requests=[ClaimRequest(GPU, 1)])
+    dra.claims.append(shared)
+    for i in range(6):
+        p = build_test_pod(f"sharer-{i}", cpu_milli=2000, mem_mib=256,
+                           owner_name="rs")
+        p.resource_claims = ("shared-gpu",)
+        fake.add_pod(p)
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    # one 10-CPU node fits 5 x 2000m sharers; the 6th cannot follow the gang
+    # and must NOT buy a second node (the claim binds to one node)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    assert status.scale_up.increases == {"ng1": 1}
+
+
+def test_shared_allocated_claim_pins_pending_sharers():
+    fake, dra = _world(n_seed_nodes=2, devices_per_node=1)
+    shared = ResourceClaim("shared-gpu", requests=[ClaimRequest(GPU, 1)],
+                           allocated_node="seed-1")
+    shared.reserved_for.append("default/existing")
+    dra.claims.append(shared)
+    p = build_test_pod("joiner", cpu_milli=500, mem_mib=256, owner_name="rs")
+    p.resource_claims = ("shared-gpu",)
+    fake.add_pod(p)
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    # the joiner fits the allocated node: no scale-up
+    assert status.pending_pods == 0
+    assert status.scale_up is None
+
+
+def test_drain_frees_devices_and_releases_claims():
+    # reference: "scale-down: single-device nodes with drain" — device pods
+    # consolidate onto nodes with free devices; eviction releases the claims
+    fake, dra = _world(n_seed_nodes=3, devices_per_node=2)
+    fake.add_pod(_device_pod("a", dra, node_name="seed-0"))
+    fake.add_pod(_device_pod("b", dra, node_name="seed-1"))
+    a = autoscaler_for(fake, node_group_defaults=NodeGroupDefaults(
+        scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0))
+    status = a.run_once(now=1000.0)
+    assert status.scale_down_deleted, "idle/underused device nodes must drain"
+    # every evicted device pod's claim was released
+    for name in fake.evicted:
+        claim = dra.claim_by_name(f"{name}-claim")
+        assert claim is not None and claim.reserved_for == []
+        assert claim.allocated_node == ""
+
+
+def test_no_scale_down_when_no_device_destination():
+    # reference: "no scale-down: no place to reschedule" — both nodes' devices
+    # are fully used; neither can absorb the other's device pod
+    fake, dra = _world(n_seed_nodes=2, devices_per_node=1)
+    fake.add_pod(_device_pod("a", dra, node_name="seed-0"))
+    fake.add_pod(_device_pod("b", dra, node_name="seed-1"))
+    a = autoscaler_for(fake, node_group_defaults=NodeGroupDefaults(
+        scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0))
+    status = a.run_once(now=1000.0)
+    assert not status.scale_down_deleted
+
+
+def test_fork_revert_commit_claim_state():
+    dra = DraSnapshot(claims=[
+        ResourceClaim("c1", requests=[ClaimRequest(GPU, 1)]),
+    ])
+    dra.slices.append(ResourceSlice("n1", GPU, 1))
+    pod = build_test_pod("p", cpu_milli=100, mem_mib=64)
+    pod.resource_claims = ("c1",)
+
+    dra.fork()
+    assert dra.reserve(dra.claims[0], pod, "n1")
+    assert dra.claims[0].allocated_node == "n1"
+    dra.revert()
+    assert dra.claims[0].allocated_node == ""
+    assert dra.claims[0].reserved_for == []
+
+    dra.fork()
+    assert dra.reserve(dra.claims[0], pod, "n1")
+    dra.commit()
+    assert dra.claims[0].allocated_node == "n1"
+    assert dra.claims[0].reserved_for == ["default/p"]
+
+
+def test_reserve_respects_binding_and_capacity():
+    dra = DraSnapshot(claims=[
+        ResourceClaim("c1", requests=[ClaimRequest(GPU, 1)],
+                      allocated_node="n1"),
+    ])
+    dra.slices.append(ResourceSlice("n1", GPU, 1))
+    dra.slices.append(ResourceSlice("n2", GPU, 1))
+    p = build_test_pod("p", cpu_milli=100, mem_mib=64)
+    p.resource_claims = ("c1",)
+    assert not dra.reserve(dra.claims[0], p, "n2")  # bound elsewhere
+    assert dra.reserve(dra.claims[0], p, "n1")
+    # ReservedFor cap
+    from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+        RESERVED_FOR_MAX,
+    )
+
+    dra.claims[0].reserved_for = [f"ns/p{i}" for i in range(RESERVED_FOR_MAX)]
+    q = build_test_pod("q", cpu_milli=100, mem_mib=64)
+    assert not dra.reserve(dra.claims[0], q, "n1")
