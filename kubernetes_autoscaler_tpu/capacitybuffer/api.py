@@ -27,11 +27,14 @@ PROVISIONING = "Provisioning"
 @dataclass
 class BufferStatus:
     """reference: CapacityBufferStatus — resolved template + replica count
-    plus conditions explaining why a buffer is (not) being provisioned."""
+    plus conditions explaining why a buffer is (not) being provisioned, and
+    the generation bookkeeping that lets reconciles skip unchanged specs."""
 
     pod_template: Optional[Pod] = None
     replicas: int = 0
     conditions: dict[str, str] = field(default_factory=dict)  # type -> True/False/reason
+    observed_generation: int = 0          # reference: Status.ObservedGeneration
+    pod_template_generation: int = 0      # reference: Status.PodTemplateGeneration
 
     def ready(self) -> bool:
         return self.conditions.get(READY_FOR_PROVISIONING) == "True"
@@ -52,4 +55,13 @@ class CapacityBuffer:
     # minimum replicas when percentage rounds down to zero
     limits_min_replicas: int = 0
     provisioning_strategy: str = ACTIVE_PROVISIONING_STRATEGY
+    # spec generation, bumped by whoever mutates the spec (the CRD machinery
+    # in the reference); reconcile skips generations it already observed
+    generation: int = 1
+    # pod-template object generation (reference: PodTemplate.Generation)
+    pod_template_generation: int = 1
     status: BufferStatus = field(default_factory=BufferStatus)
+
+    def bump(self) -> None:
+        """Test/fixture helper: record a spec mutation."""
+        self.generation += 1

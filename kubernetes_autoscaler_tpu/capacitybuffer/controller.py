@@ -22,24 +22,76 @@ from kubernetes_autoscaler_tpu.models.api import Pod
 
 
 class BufferController:
-    def __init__(self, buffers: list[CapacityBuffer] | None = None):
+    """Reconcile = filter chain → translate → quota clamp → status update.
+
+    `status_sink(buffer)` is the persistence seam (the reference's updater/
+    writes Status back through the CRD client); `headroom_quota` caps the
+    TOTAL buffer headroom per resource (reference: controller/resourcequotas.go
+    trimming buffers that would exceed the capacity quotas)."""
+
+    def __init__(self, buffers: list[CapacityBuffer] | None = None,
+                 filters=None, status_sink=None,
+                 headroom_quota: dict[str, float] | None = None):
+        from kubernetes_autoscaler_tpu.capacitybuffer.filters import (
+            default_filters,
+        )
+
         self.buffers: list[CapacityBuffer] = list(buffers or [])
+        self.filters = filters if filters is not None else default_filters()
+        self.status_sink = status_sink
+        self.headroom_quota = headroom_quota or {}
 
     def reconcile(self) -> list[CapacityBuffer]:
-        """Filter + translate every buffer; returns the active set
-        (reference: controller loop over filters/translators/updater)."""
-        active = []
-        for buf in self.buffers:
-            # strategy filter (reference: capacitybuffer/filters) — foreign
-            # strategies are parked, not provisioned
-            if buf.provisioning_strategy != ACTIVE_PROVISIONING_STRATEGY:
-                buf.status.conditions[READY_FOR_PROVISIONING] = "False"
-                buf.status.conditions["reason"] = "UnsupportedProvisioningStrategy"
-                continue
+        """Returns the active set (reference: controller loop over
+        filters/translators/updater)."""
+        to_process = list(self.buffers)
+        skipped: list[CapacityBuffer] = []
+        for f in self.filters:
+            to_process, skip = f.filter(to_process)
+            skipped.extend(skip)
+        for buf in to_process:
             translate_buffer(buf)
-            if buf.status.ready():
-                active.append(buf)
-        return active
+            buf.status.observed_generation = buf.generation
+            buf.status.pod_template_generation = buf.pod_template_generation
+            if self.status_sink is not None:
+                try:
+                    self.status_sink(buf)
+                except Exception:
+                    pass
+        # generation-skipped buffers stay active if previously resolved ready
+        active = [b for b in self.buffers if b.status.ready()]
+        return self._clamp_to_quota(active)
+
+    def _clamp_to_quota(self, active: list[CapacityBuffer]
+                        ) -> list[CapacityBuffer]:
+        if not self.headroom_quota:
+            return active
+        used: dict[str, float] = {}
+        out = []
+        for buf in active:
+            tmpl = buf.status.pod_template
+            if tmpl is None:
+                out.append(buf)
+                continue
+            replicas = buf.status.replicas
+            # clamp replicas so cumulative headroom stays under quota
+            for res_name, limit in self.headroom_quota.items():
+                per = float(tmpl.requests.get(res_name, 0.0))
+                if per <= 0:
+                    continue
+                room = limit - used.get(res_name, 0.0)
+                replicas = min(replicas, int(max(room, 0) // per))
+            if replicas < buf.status.replicas:
+                buf.status.conditions["reason"] = "LimitedByBufferQuota"
+            if replicas <= 0:
+                continue
+            buf.status.replicas = replicas
+            tmplreq = buf.status.pod_template.requests
+            for res_name in self.headroom_quota:
+                used[res_name] = (used.get(res_name, 0.0)
+                                  + float(tmplreq.get(res_name, 0.0)) * replicas)
+            out.append(buf)
+        return out
 
     def pending_pods(self) -> list[Pod]:
         """Fake pending pods for all active buffers — injected each loop."""

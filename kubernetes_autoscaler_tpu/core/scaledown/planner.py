@@ -20,6 +20,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -168,6 +169,10 @@ class Planner:
             max_zones=enc.dims.max_zones,
             with_constraints=enc.has_constraints,
         )
+        # one consolidated device->host transfer (the verdict fields are
+        # consumed host-side here and in nodes_to_delete; lazy per-field
+        # np.asarray would cost one tunnel round trip each)
+        removal = jax.device_get(removal)
         drainable = np.asarray(removal.drainable)
         unneeded = []
         for k, i in enumerate(eligible_idx):
@@ -187,6 +192,100 @@ class Planner:
 
     def _mark(self, name: str, reason: str, now: float) -> None:
         self.unremovable.add(name, reason, now)
+
+    def _device_confirm(self, enc, nodes, ordered, drainable, by_index,
+                        name_to_i, node_gid, seen_groups, defaults,
+                        ds_by_node, now) -> list[NodeToRemove]:
+        """Sequential confirmation on device + host-side policy caps."""
+        from kubernetes_autoscaler_tpu.ops.drain import (
+            confirm_removals_sequential_jit,
+        )
+
+        # pre-screen: drainable verdict + matured unneeded clock
+        screened: list[int] = []
+        for name in ordered:
+            i = name_to_i.get(name)
+            if i is None or i not in by_index or not drainable[by_index[i]]:
+                continue
+            g = seen_groups.get(node_gid.get(name))
+            if g is None:
+                continue
+            nd = nodes[i]
+            opts = g.get_options(defaults)
+            unneeded_time = (
+                (opts.scale_down_unneeded_time_s if nd.ready
+                 else opts.scale_down_unready_time_s)
+                or (defaults.scale_down_unneeded_time_s if nd.ready
+                    else defaults.scale_down_unready_time_s)
+            )
+            if self.unneeded_nodes.removable_at(name, now, unneeded_time):
+                screened.append(i)
+        if not screened:
+            return []
+        # jit-cache-stable padding: duplicate candidates are always rejected
+        # by the kernel (capacity monotonically shrinks; deleted gate)
+        bucket = 256
+        pad_c = ((len(screened) + bucket - 1) // bucket) * bucket
+        cand = np.asarray(
+            screened + [screened[0]] * (pad_c - len(screened)), np.int32)
+        res = confirm_removals_sequential_jit(
+            enc.nodes, enc.specs, enc.scheduled,
+            jnp.asarray(cand), jnp.ones((enc.nodes.n,), bool),
+            max_pods_per_node=self.options.max_pods_per_node,
+        )
+        accepted = np.asarray(res.accepted)[: len(screened)]
+        dest_node = np.asarray(res.dest_node)[: len(screened)]
+        pod_slot = np.asarray(res.pod_slot)[: len(screened)]
+        movable_f = np.asarray(enc.scheduled.movable)
+
+        # host-side caps over the accepted sequence (conservative: a node the
+        # caps reject keeps its capacity charge inside the device pass)
+        quota_status = None
+        if self.quota is not None:
+            quota_status = self.quota.status_from_encoded(enc)
+        empty_budget = self.options.max_empty_bulk_delete
+        drain_budget = self.options.max_drain_parallelism
+        total_budget = self.options.max_scale_down_parallelism
+        group_room: dict[str, int] = {}
+        out: list[NodeToRemove] = []
+        for k, i in enumerate(screened):
+            if not accepted[k]:
+                self._mark(nodes[i].name, "NoPlaceToMovePods", now)
+                continue
+            if len(out) >= total_budget:
+                break
+            nd = nodes[i]
+            g = seen_groups.get(node_gid.get(nd.name))
+            room = group_room.setdefault(
+                g.id(), g.target_size() - g.min_size())
+            if room <= 0:
+                self._mark(nd.name, "NodeGroupMinSizeReached", now)
+                continue
+            if quota_status is not None and not self.quota.nodes_removable(
+                    quota_status, nd):
+                self._mark(nd.name, "MinimalResourceLimitExceeded", now)
+                continue
+            slots = [int(s) for s in pod_slot[k] if s >= 0]
+            moves = {int(s): int(d) for s, d in zip(pod_slot[k], dest_node[k])
+                     if s >= 0 and d >= 0}
+            orig = [s for s in slots if movable_f[s]]
+            is_empty = not orig
+            if is_empty:
+                if empty_budget <= 0:
+                    continue
+                empty_budget -= 1
+            else:
+                if drain_budget <= 0:
+                    continue
+                drain_budget -= 1
+            if quota_status is not None:
+                self.quota.deduct(quota_status, nd)
+            group_room[g.id()] -= 1
+            out.append(NodeToRemove(
+                nd, bool(is_empty), pods_to_move=orig,
+                destinations={s: moves[s] for s in orig if s in moves},
+                ds_to_evict=ds_by_node.get(nd.name, [])))
+        return out
 
     def _utilization(self, enc: EncodedCluster, nodes: list[Node]) -> np.ndarray:
         """Per-node dominant-resource utilization, with daemonset and mirror
@@ -249,6 +348,7 @@ class Planner:
         # candidate is simulated, simulator/cluster.go:174-188), which the
         # independent per-candidate device sweep deliberately omits.
         reqs = np.asarray(enc.scheduled.req)
+        greq = np.asarray(enc.specs.req)
         group_ref = np.asarray(enc.scheduled.group_ref)
         movable_f = np.asarray(enc.scheduled.movable)
         limit_g = np.asarray(enc.specs.one_per_node())
@@ -317,6 +417,19 @@ class Planner:
         ordered = [n for n in ordered
                    if atomic_groups.get(n) not in atomic_blocked]
 
+        # FAST PATH: when no policy machinery needs per-move host decisions —
+        # no atomic groups, no exact-oracle groups, no PDBs — the sequential
+        # confirmation runs as ONE device program (ops/drain.py
+        # confirm_removals_sequential); the host only applies budget/quota
+        # caps to the accepted sequence. This is what keeps the pass inside
+        # the loop budget at 5k nodes / 50k pods (round-2 review Weak #6).
+        pdb_active = (self.pdb_tracker is not None
+                      and len(self.pdb_tracker.get_pdbs()) > 0)
+        if not atomic_gids and not need_exact.any() and not pdb_active:
+            return self._device_confirm(
+                enc, nodes, ordered, drainable, by_index, name_to_i,
+                node_gid, seen_groups, defaults, ds_by_node, now)
+
         # The confirmation pass runs as ATTEMPTS: if an atomic group fails
         # mid-pass (one member can't place its pods), everything it consumed
         # — budgets, destination capacity, PDB reservations — is poisoned,
@@ -334,6 +447,17 @@ class Planner:
             free = (np.asarray(enc.nodes.cap)
                     - np.asarray(enc.nodes.alloc)).astype(np.int64)
             deleted_mask = np.zeros((enc.nodes.n,), dtype=bool)
+            # Incremental fits cache: fits_m[g, n] = predicate plane AND
+            # capacity, built once (G x N x R) and patched per move (only the
+            # destination column changes) — keeps the host pass O(moves x G x R)
+            # instead of O(moves x N x R) at 5k nodes / 50k pods.
+            fits_m = (feas & node_valid[None, :]
+                      & (free[None, :, :] >= greq[:, None, :]).all(axis=2))
+
+            def charge(d: int, req_vec: np.ndarray, sign: int) -> None:
+                free[d] -= sign * req_vec
+                fits_m[:, d] = (feas[:, d] & node_valid[d]
+                                & (free[d][None, :] >= greq).all(axis=1))
             # oracle world for exact-checked moves (rebuilt per attempt)
             by_node: dict[str, list] = {}
             for q in enc.scheduled_pods:
@@ -420,8 +544,7 @@ class Planner:
                 for slot in victim_slots:
                     g_ref = int(group_ref[slot])
                     req = reqs[slot]
-                    fits = feas[g_ref] & node_valid & ~deleted_mask
-                    fits &= (free >= req[None, :]).all(axis=1)
+                    fits = fits_m[g_ref] & ~deleted_mask
                     fits[i] = False
                     if limit_g[g_ref]:
                         for (gm, dm) in moved_marks | local_marks:
@@ -457,7 +580,7 @@ class Planner:
                         if not fits[d]:
                             ok = False
                             break
-                    free[d] -= req
+                    charge(d, req, +1)
                     moves[slot] = d
                     if limit_g[g_ref]:
                         local_marks.add((g_ref, d))
@@ -465,7 +588,7 @@ class Planner:
                     # revert charges; try again next loop (destinations taken
                     # by an earlier candidate this round)
                     for slot, d in moves.items():
-                        free[d] += reqs[slot]
+                        charge(d, reqs[slot], -1)
                     for pod_obj, src_name, clone in local_pod_moves:
                         dst = by_node.get(clone.node_name, [])
                         if clone in dst:

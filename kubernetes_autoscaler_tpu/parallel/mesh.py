@@ -87,4 +87,12 @@ def shard_cluster(cluster, mesh: Mesh):
     # scheduled pods index into nodes/groups arbitrarily → replicate for now
     scheduled = jax.tree_util.tree_map(lambda x: place(("repl", x)), cluster.scheduled)
     groups = jax.tree_util.tree_map(lambda x: place(("repl", x)), cluster.groups)
-    return cluster.replace(nodes=nodes, pending=pending, scheduled=scheduled, groups=groups)
+    out = cluster.replace(nodes=nodes, pending=pending, scheduled=scheduled,
+                          groups=groups)
+    if getattr(cluster, "planes", None) is not None:
+        # constraint planes are small ([G, N] counts) and indexed by both
+        # axes inside the wave placer — replicate
+        planes = jax.tree_util.tree_map(lambda x: place(("repl", x)),
+                                        cluster.planes)
+        out = out.replace(planes=planes)
+    return out

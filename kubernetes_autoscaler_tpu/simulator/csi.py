@@ -61,15 +61,38 @@ def apply_csi(nodes: list[Node], pods: list[Pod], csi: CsiSnapshot) -> None:
                 nd.allocatable[key] = d.allocatable_count
             drivers_seen.add(d.name)
 
+    # A PVC mounted by several pods occupies ONE attachment on a node, not
+    # one per pod (the scheduler's volume-limits filter counts unique
+    # volumes). The dense per-pod lowering can't express sharing, so the
+    # FIRST referencing pod carries the charge and the rest go through the
+    # host-check tier (the same exactness pattern as shared DRA claims).
+    from kubernetes_autoscaler_tpu.models.api import HOST_CHECK_ANNOTATION
+
+    pvc_owners: dict[str, str] = {}
+    pvc_refcount: dict[str, int] = {}
+    for pod in pods:
+        for ref in pod.pvc_refs:
+            key = ref if "/" in ref else f"{pod.namespace}/{ref}"
+            pvc_refcount[key] = pvc_refcount.get(key, 0) + 1
+            pvc_owners.setdefault(key, pod.name)
+
     for pod in pods:
         per_driver: dict[str, int] = {}
+        lossy = False
         for ref in pod.pvc_refs:
             key = ref if "/" in ref else f"{pod.namespace}/{ref}"
             driver = csi.pvc_driver.get(key)
-            if driver:
-                per_driver[driver] = per_driver.get(driver, 0) + 1
+            if not driver:
+                continue
+            if pvc_refcount.get(key, 1) > 1:
+                lossy = True
+                if pvc_owners.get(key) != pod.name:
+                    continue  # a sibling already carries the attachment
+            per_driver[driver] = per_driver.get(driver, 0) + 1
         # overwrite, not accumulate — the loop re-lists the same Pod objects
         # every tick and this pass must be idempotent
         for driver, n in per_driver.items():
             if driver in drivers_seen:
                 pod.requests[CSI_RESOURCE_PREFIX + driver] = n
+        if lossy:
+            pod.annotations[HOST_CHECK_ANNOTATION] = "true"

@@ -168,7 +168,12 @@ class TensorClusterSnapshot:
         from kubernetes_autoscaler_tpu.ops.schedule import schedule_pending_on_existing
 
         s = self.state
-        return schedule_pending_on_existing(s.nodes, s.specs, s.scheduled)
+        return schedule_pending_on_existing(
+            s.nodes, s.specs, s.scheduled,
+            planes=self.enc.planes,
+            max_zones=self.enc.dims.max_zones,
+            with_constraints=self.enc.has_constraints,
+        )
 
     def apply_placement(self, placed: jnp.ndarray) -> None:
         """Charge a PackResult.placed (i32[G, N]) onto node allocations and
@@ -196,6 +201,9 @@ class TensorClusterSnapshot:
             s.nodes, s.specs, s.scheduled,
             jnp.asarray(candidate_indices, jnp.int32), dest_allowed,
             max_pods_per_node=max_pods_per_node, chunk=chunk,
+            planes=self.enc.planes,
+            max_zones=self.enc.dims.max_zones,
+            with_constraints=self.enc.has_constraints,
         )
 
 

@@ -153,3 +153,41 @@ def test_runonce_pod_injection_prescales():
     assert status.scale_up is not None and status.scale_up.scaled_up
     # 6 pods x 1800m, 2 per 4-CPU node -> 3 nodes total, 1 exists -> +2
     assert status.scale_up.increases == {"ng1": 2}
+
+
+def test_generation_tracking_skips_unchanged_specs():
+    from kubernetes_autoscaler_tpu.capacitybuffer.api import CapacityBuffer
+    from kubernetes_autoscaler_tpu.capacitybuffer.controller import BufferController
+
+    calls = []
+    buf = CapacityBuffer(name="b1",
+                         pod_template=build_test_pod("t", cpu_milli=500, mem_mib=256),
+                         replicas=2)
+    c = BufferController([buf], status_sink=calls.append)
+    assert len(c.reconcile()) == 1
+    assert buf.status.observed_generation == buf.generation
+    assert calls == [buf]
+    # unchanged spec: no re-translation, no status write
+    c.reconcile()
+    assert calls == [buf]
+    # spec mutation bumps generation -> re-translated and re-written
+    buf.replicas = 5
+    buf.bump()
+    c.reconcile()
+    assert len(calls) == 2
+    assert buf.status.replicas == 5
+
+
+def test_headroom_quota_clamps_buffer_replicas():
+    from kubernetes_autoscaler_tpu.capacitybuffer.api import CapacityBuffer
+    from kubernetes_autoscaler_tpu.capacitybuffer.controller import BufferController
+
+    big = CapacityBuffer(name="big",
+                         pod_template=build_test_pod("t", cpu_milli=1000, mem_mib=256),
+                         replicas=10)
+    c = BufferController([big], headroom_quota={"cpu": 3.0})
+    active = c.reconcile()
+    assert len(active) == 1
+    assert active[0].status.replicas == 3       # 3 cores / 1 core per pod
+    assert big.status.conditions["reason"] == "LimitedByBufferQuota"
+    assert len(c.pending_pods()) == 3

@@ -1,0 +1,93 @@
+"""CSI volume-limit CONTENTION (round-2 review: the old tests only checked
+the lowering arithmetic). Mirrors core/static_autoscaler_csi_test.go shapes:
+a full node rejects further volume pods, drain frees attachments, and shared
+PVCs charge one attachment.
+"""
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.config.options import NodeGroupDefaults
+from kubernetes_autoscaler_tpu.models.api import HOST_CHECK_ANNOTATION
+from kubernetes_autoscaler_tpu.simulator.csi import (
+    CSINode,
+    CSINodeDriver,
+    CsiSnapshot,
+    apply_csi,
+)
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+from test_runonce import autoscaler_for
+
+EBS = "ebs.csi.example.com"
+
+
+def _world(limit=2, n_nodes=1):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384)
+    tmpl.capacity[f"csi/{EBS}"] = limit
+    tmpl.allocatable[f"csi/{EBS}"] = limit
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    csi = fake.csi_snapshot()
+    for i in range(n_nodes):
+        name = f"n{i}"
+        fake.add_existing_node(
+            "ng1", build_test_node(name, cpu_milli=8000, mem_mib=16384))
+        csi.add(CSINode(name, [CSINodeDriver(EBS, limit)]))
+    return fake, csi
+
+
+def _vol_pod(name, csi, pvc, node_name=""):
+    p = build_test_pod(name, cpu_milli=200, mem_mib=128, owner_name="rs",
+                       node_name=node_name)
+    if node_name:
+        p.phase = "Running"
+    p.pvc_refs = (pvc,)
+    csi.pvc_driver[f"default/{pvc}"] = EBS
+    return p
+
+
+def test_volume_limit_blocks_third_pod_and_scales_up():
+    fake, csi = _world(limit=2, n_nodes=1)
+    fake.add_pod(_vol_pod("v0", csi, "pvc-0", node_name="n0"))
+    fake.add_pod(_vol_pod("v1", csi, "pvc-1", node_name="n0"))
+    fake.add_pod(_vol_pod("v2", csi, "pvc-2"))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    # node n0's 2 attachments are taken: the third volume pod needs a new node
+    assert status.scale_up is not None and status.scale_up.increases == {"ng1": 1}
+
+
+def test_drain_respects_destination_volume_limits():
+    # n0 has 1 volume pod, n1 has 2 (full): n0 cannot drain onto n1
+    fake, csi = _world(limit=2, n_nodes=2)
+    fake.add_pod(_vol_pod("v0", csi, "pvc-0", node_name="n0"))
+    fake.add_pod(_vol_pod("v1", csi, "pvc-1", node_name="n1"))
+    fake.add_pod(_vol_pod("v2", csi, "pvc-2", node_name="n1"))
+    a = autoscaler_for(fake, node_group_defaults=NodeGroupDefaults(
+        scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0))
+    status = a.run_once(now=1000.0)
+    assert not status.scale_down_deleted, (
+        "n1 has no free attachments; n0's pod has nowhere to go")
+
+
+def test_drain_consolidates_when_attachments_free():
+    fake, csi = _world(limit=4, n_nodes=2)
+    fake.add_pod(_vol_pod("v0", csi, "pvc-0", node_name="n0"))
+    fake.add_pod(_vol_pod("v1", csi, "pvc-1", node_name="n1"))
+    a = autoscaler_for(fake, node_group_defaults=NodeGroupDefaults(
+        scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0))
+    status = a.run_once(now=1000.0)
+    assert len(status.scale_down_deleted) == 1
+
+
+def test_shared_pvc_charges_one_attachment():
+    fake, csi = _world(limit=2, n_nodes=1)
+    nodes = fake.list_nodes()
+    a = _vol_pod("a", csi, "shared-pvc")
+    b = _vol_pod("b", csi, "shared-pvc")
+    pods = [a, b]
+    apply_csi(nodes, pods, csi)
+    charges = [p.requests.get(f"csi/{EBS}", 0) for p in pods]
+    assert sorted(charges) == [0, 1], "one attachment total, not one per pod"
+    assert all(p.annotations.get(HOST_CHECK_ANNOTATION) == "true" for p in pods)
