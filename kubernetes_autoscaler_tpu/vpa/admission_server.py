@@ -1,0 +1,147 @@
+"""VPA admission webhook SERVER: the AdmissionReview HTTP surface.
+
+Reference counterpart: vertical-pod-autoscaler/pkg/admission-controller/
+logic/server.go — a mutating webhook for pods (patch container requests to the
+matching VPA's recommendation) and a validating webhook for VPA objects. The
+reference additionally self-manages its serving certificate
+(certs/manager.go); here TLS is injected (pass an ssl.SSLContext or cert/key
+paths) because certificate issuance belongs to the deployment, not the
+decision logic. The request/response wire shape is the k8s
+admission.k8s.io/v1 AdmissionReview JSON, base64-JSONPatch response included,
+so a real apiserver could call this endpoint unmodified.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubernetes_autoscaler_tpu.vpa.admission import patch_for_pod, validate_vpa
+from kubernetes_autoscaler_tpu.vpa.model import VerticalPodAutoscaler
+
+
+def _jsonpatch_from_ops(ops) -> list[dict]:
+    """PatchOps → RFC-6902 ops against the pod spec (reference:
+    resource/pod/patch builds the same /spec/containers/... paths)."""
+    patches = []
+    for op in ops:
+        if op.resource.startswith("limit:"):
+            res = op.resource.split(":", 1)[1]
+            path = f"/spec/containers/{op.container}/resources/limits/{res}"
+        else:
+            path = f"/spec/containers/{op.container}/resources/requests/{op.resource}"
+        patches.append({"op": "replace", "path": path, "value": op.value})
+    return patches
+
+
+class AdmissionService:
+    """Transport-independent webhook logic; the HTTP handler is a thin shim."""
+
+    def __init__(self, vpas: list[VerticalPodAutoscaler] | None = None):
+        self.vpas = list(vpas or [])
+
+    def review(self, body: dict) -> dict:
+        req = body.get("request", {})
+        uid = req.get("uid", "")
+        kind = (req.get("kind") or {}).get("kind", "")
+        obj = req.get("object") or {}
+        if kind == "Pod":
+            response = self._mutate_pod(req, obj)
+        elif kind == "VerticalPodAutoscaler":
+            response = self._validate_vpa(obj)
+        else:
+            response = {"allowed": True}
+        response["uid"] = uid
+        return {"apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview", "response": response}
+
+    def _mutate_pod(self, req: dict, pod: dict) -> dict:
+        meta = pod.get("metadata", {})
+        namespace = req.get("namespace") or meta.get("namespace", "default")
+        owners = meta.get("ownerReferences") or []
+        owner = owners[0]["name"] if owners else meta.get("name", "")
+        containers = {}
+        limits = {}
+        for c in pod.get("spec", {}).get("containers", []):
+            res = c.get("resources", {})
+            containers[c["name"]] = {
+                k: float(v) for k, v in (res.get("requests") or {}).items()}
+            limits[c["name"]] = {
+                k: float(v) for k, v in (res.get("limits") or {}).items()}
+        ops = patch_for_pod(namespace, owner, containers, limits, self.vpas)
+        if not ops:
+            return {"allowed": True}
+        patch = json.dumps(_jsonpatch_from_ops(ops)).encode()
+        return {"allowed": True, "patchType": "JSONPatch",
+                "patch": base64.b64encode(patch).decode()}
+
+    def _validate_vpa(self, obj: dict) -> dict:
+        vpa = VerticalPodAutoscaler(
+            name=obj.get("metadata", {}).get("name", ""),
+            namespace=obj.get("metadata", {}).get("namespace", "default"),
+            target_name=(obj.get("spec", {}).get("targetRef") or {}).get("name", ""),
+        )
+        problems = validate_vpa(vpa)
+        if problems:
+            return {"allowed": False,
+                    "status": {"message": "; ".join(problems)}}
+        return {"allowed": True}
+
+
+class AdmissionServer:
+    """The serving shell (reference: admission-controller main.go + server.go).
+
+    Plain HTTP by default; pass certfile/keyfile for TLS (the apiserver
+    requires TLS in real deployments)."""
+
+    def __init__(self, service: AdmissionService, host: str = "127.0.0.1",
+                 port: int = 0, certfile: str | None = None,
+                 keyfile: str | None = None):
+        svc = service
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                if self.path not in ("/mutate-pods", "/validate-vpa", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    out = json.dumps(svc.review(body)).encode()
+                    code = 200
+                except (ValueError, KeyError) as e:
+                    out = json.dumps({"error": str(e)}).encode()
+                    code = 400
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
+                                                server_side=True)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

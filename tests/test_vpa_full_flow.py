@@ -1,0 +1,140 @@
+"""Full-VPA closed loop (reference: test/e2e/v1/full_vpa.go): usage samples →
+recommender → updater evicts the divergent pod → the recreated pod passes
+through the admission WEBHOOK SERVER and comes out resized.
+"""
+
+import base64
+import http.client
+import json
+
+from kubernetes_autoscaler_tpu.vpa.admission_server import (
+    AdmissionServer,
+    AdmissionService,
+)
+from kubernetes_autoscaler_tpu.vpa.model import (
+    ContainerUsageSample,
+    VerticalPodAutoscaler,
+)
+from kubernetes_autoscaler_tpu.vpa.recommender import AggregateKey, Recommender
+from kubernetes_autoscaler_tpu.vpa.updater import PodView, Updater
+
+MIB = 1024.0 * 1024.0
+
+
+def _post(port, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    conn.close()
+    return resp.status, out
+
+
+def _admission_review_pod(name, owner, cpu_req):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "uid-1",
+            "kind": {"kind": "Pod"},
+            "namespace": "default",
+            "object": {
+                "metadata": {"name": name, "namespace": "default",
+                             "ownerReferences": [{"name": owner}]},
+                "spec": {"containers": [{
+                    "name": "app",
+                    "resources": {"requests": {"cpu": cpu_req,
+                                               "memory": 64 * MIB}},
+                }]},
+            },
+        },
+    }
+
+
+def test_full_vpa_closed_loop():
+    # --- 1. recommender learns from sustained high usage ---
+    rec = Recommender()
+    vpa = VerticalPodAutoscaler(name="v", target_name="web", min_replicas=1)
+    samples = [
+        ContainerUsageSample(namespace="default", pod_name=f"web-{i}",
+                             container_name="app", owner_name="web",
+                             cpu_cores=2.0, memory_bytes=512 * MIB,
+                             timestamp=float(i))
+        for i in range(200)
+    ]
+    rec.feed(samples, now=200.0)
+    rec.recommend([vpa], {"web": ["app"]}, now=200.0)
+    assert vpa.recommendation
+    target_cpu = vpa.recommendation[0].target["cpu"]
+    assert target_cpu > 1.0  # ~2 cores observed
+
+    # --- 2. updater decides the under-provisioned pod must be replaced ---
+    evicted = []
+    upd = Updater(evict=lambda p: evicted.append(p.name))
+    pod = PodView(name="web-0", namespace="default", owner_name="web",
+                  containers={"app": {"cpu": 0.1, "memory": 64 * MIB}},
+                  replicas_of_owner=2)
+    acted = upd.run_once([vpa], [pod], now=300.0)
+    assert evicted == ["web-0"]
+    assert acted and acted[0].outside_bounds
+
+    # --- 3. the recreated pod is admitted through the webhook SERVER and
+    #        lands with the recommended requests ---
+    server = AdmissionServer(AdmissionService([vpa]))
+    server.start()
+    try:
+        status, review = _post(server.port, "/mutate-pods",
+                               _admission_review_pod("web-0-new", "web", 0.1))
+        assert status == 200
+        resp = review["response"]
+        assert resp["allowed"] and resp["uid"] == "uid-1"
+        patch = json.loads(base64.b64decode(resp["patch"]))
+        cpu_ops = [p for p in patch if p["path"].endswith("/requests/cpu")]
+        assert cpu_ops and abs(cpu_ops[0]["value"] - target_cpu) < 1e-9
+    finally:
+        server.stop()
+
+
+def test_webhook_validates_vpa_objects():
+    server = AdmissionServer(AdmissionService([]))
+    server.start()
+    try:
+        bad = {
+            "request": {
+                "uid": "u2",
+                "kind": {"kind": "VerticalPodAutoscaler"},
+                "object": {"metadata": {"name": "v"},
+                           "spec": {"targetRef": {"name": ""}}},
+            }
+        }
+        status, review = _post(server.port, "/validate-vpa", bad)
+        assert status == 200
+        assert review["response"]["allowed"] is False
+        assert "targetRef" in review["response"]["status"]["message"]
+
+        good = {
+            "request": {
+                "uid": "u3",
+                "kind": {"kind": "VerticalPodAutoscaler"},
+                "object": {"metadata": {"name": "v"},
+                           "spec": {"targetRef": {"name": "web"}}},
+            }
+        }
+        _, review = _post(server.port, "/validate-vpa", good)
+        assert review["response"]["allowed"] is True
+    finally:
+        server.stop()
+
+
+def test_webhook_rejects_malformed_body():
+    server = AdmissionServer(AdmissionService([]))
+    server.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        conn.request("POST", "/mutate-pods", "{not json",
+                     {"Content-Type": "application/json"})
+        assert conn.getresponse().status == 400
+        conn.close()
+    finally:
+        server.stop()
