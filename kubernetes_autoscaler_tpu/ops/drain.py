@@ -429,7 +429,7 @@ def confirm_removals_sequential(
 
     init = (nodes.free(), ~nodes.valid, jnp.zeros((n,), bool))
     (free_after, _, _), (accepted, dest_node, pod_slot) = jax.lax.scan(
-        step, init, jnp.asarray(ordered_cand, jnp.int32), unroll=8)
+        step, init, jnp.asarray(ordered_cand, jnp.int32), unroll=2)
     return ConfirmResult(accepted=accepted, dest_node=dest_node,
                          pod_slot=pod_slot, free_after=free_after)
 
