@@ -193,100 +193,6 @@ class Planner:
     def _mark(self, name: str, reason: str, now: float) -> None:
         self.unremovable.add(name, reason, now)
 
-    def _device_confirm(self, enc, nodes, ordered, drainable, by_index,
-                        name_to_i, node_gid, seen_groups, defaults,
-                        ds_by_node, now) -> list[NodeToRemove]:
-        """Sequential confirmation on device + host-side policy caps."""
-        from kubernetes_autoscaler_tpu.ops.drain import (
-            confirm_removals_sequential_jit,
-        )
-
-        # pre-screen: drainable verdict + matured unneeded clock
-        screened: list[int] = []
-        for name in ordered:
-            i = name_to_i.get(name)
-            if i is None or i not in by_index or not drainable[by_index[i]]:
-                continue
-            g = seen_groups.get(node_gid.get(name))
-            if g is None:
-                continue
-            nd = nodes[i]
-            opts = g.get_options(defaults)
-            unneeded_time = (
-                (opts.scale_down_unneeded_time_s if nd.ready
-                 else opts.scale_down_unready_time_s)
-                or (defaults.scale_down_unneeded_time_s if nd.ready
-                    else defaults.scale_down_unready_time_s)
-            )
-            if self.unneeded_nodes.removable_at(name, now, unneeded_time):
-                screened.append(i)
-        if not screened:
-            return []
-        # jit-cache-stable padding: duplicate candidates are always rejected
-        # by the kernel (capacity monotonically shrinks; deleted gate)
-        bucket = 256
-        pad_c = ((len(screened) + bucket - 1) // bucket) * bucket
-        cand = np.asarray(
-            screened + [screened[0]] * (pad_c - len(screened)), np.int32)
-        res = confirm_removals_sequential_jit(
-            enc.nodes, enc.specs, enc.scheduled,
-            jnp.asarray(cand), jnp.ones((enc.nodes.n,), bool),
-            max_pods_per_node=self.options.max_pods_per_node,
-        )
-        accepted = np.asarray(res.accepted)[: len(screened)]
-        dest_node = np.asarray(res.dest_node)[: len(screened)]
-        pod_slot = np.asarray(res.pod_slot)[: len(screened)]
-        movable_f = np.asarray(enc.scheduled.movable)
-
-        # host-side caps over the accepted sequence (conservative: a node the
-        # caps reject keeps its capacity charge inside the device pass)
-        quota_status = None
-        if self.quota is not None:
-            quota_status = self.quota.status_from_encoded(enc)
-        empty_budget = self.options.max_empty_bulk_delete
-        drain_budget = self.options.max_drain_parallelism
-        total_budget = self.options.max_scale_down_parallelism
-        group_room: dict[str, int] = {}
-        out: list[NodeToRemove] = []
-        for k, i in enumerate(screened):
-            if not accepted[k]:
-                self._mark(nodes[i].name, "NoPlaceToMovePods", now)
-                continue
-            if len(out) >= total_budget:
-                break
-            nd = nodes[i]
-            g = seen_groups.get(node_gid.get(nd.name))
-            room = group_room.setdefault(
-                g.id(), g.target_size() - g.min_size())
-            if room <= 0:
-                self._mark(nd.name, "NodeGroupMinSizeReached", now)
-                continue
-            if quota_status is not None and not self.quota.nodes_removable(
-                    quota_status, nd):
-                self._mark(nd.name, "MinimalResourceLimitExceeded", now)
-                continue
-            slots = [int(s) for s in pod_slot[k] if s >= 0]
-            moves = {int(s): int(d) for s, d in zip(pod_slot[k], dest_node[k])
-                     if s >= 0 and d >= 0}
-            orig = [s for s in slots if movable_f[s]]
-            is_empty = not orig
-            if is_empty:
-                if empty_budget <= 0:
-                    continue
-                empty_budget -= 1
-            else:
-                if drain_budget <= 0:
-                    continue
-                drain_budget -= 1
-            if quota_status is not None:
-                self.quota.deduct(quota_status, nd)
-            group_room[g.id()] -= 1
-            out.append(NodeToRemove(
-                nd, bool(is_empty), pods_to_move=orig,
-                destinations={s: moves[s] for s in orig if s in moves},
-                ds_to_evict=ds_by_node.get(nd.name, [])))
-        return out
-
     def _utilization(self, enc: EncodedCluster, nodes: list[Node]) -> np.ndarray:
         """Per-node dominant-resource utilization, with daemonset and mirror
         pod usage excluded per the flags (reference: utilization/info.go
@@ -417,19 +323,6 @@ class Planner:
         ordered = [n for n in ordered
                    if atomic_groups.get(n) not in atomic_blocked]
 
-        # FAST PATH: when no policy machinery needs per-move host decisions —
-        # no atomic groups, no exact-oracle groups, no PDBs — the sequential
-        # confirmation runs as ONE device program (ops/drain.py
-        # confirm_removals_sequential); the host only applies budget/quota
-        # caps to the accepted sequence. This is what keeps the pass inside
-        # the loop budget at 5k nodes / 50k pods (round-2 review Weak #6).
-        pdb_active = (self.pdb_tracker is not None
-                      and len(self.pdb_tracker.get_pdbs()) > 0)
-        if not atomic_gids and not need_exact.any() and not pdb_active:
-            return self._device_confirm(
-                enc, nodes, ordered, drainable, by_index, name_to_i,
-                node_gid, seen_groups, defaults, ds_by_node, now)
-
         # The confirmation pass runs as ATTEMPTS: if an atomic group fails
         # mid-pass (one member can't place its pods), everything it consumed
         # — budgets, destination capacity, PDB reservations — is poisoned,
@@ -534,56 +427,86 @@ class Planner:
                         continue
                     pdb_need = self.pdb_tracker.reservation(victims)
 
-                # Re-place every victim (original + received) sequentially:
-                # first feasible node in index order — the device packer's
-                # tie-break — over live free capacity and this round's state.
+                # Re-place every victim (original + received) over live free
+                # capacity — first feasible node in index order (the device
+                # packer's tie-break). Identical pods of a group place as one
+                # BLOCK via the cumulative-fit trick (one numpy pass per
+                # group instead of per pod: this bound the pass at 5k nodes /
+                # 50k pods — round-2 review Weak #6); exact-oracle and
+                # one-per-node groups keep the per-pod path.
                 moves: dict[int, int] = {}
                 local_marks: set[tuple[int, int]] = set()
                 local_pod_moves: list[tuple[object, str, object]] = []
                 ok = True
+                slots_by_group: dict[int, list[int]] = {}
                 for slot in victim_slots:
-                    g_ref = int(group_ref[slot])
-                    req = reqs[slot]
-                    fits = fits_m[g_ref] & ~deleted_mask
-                    fits[i] = False
-                    if limit_g[g_ref]:
-                        for (gm, dm) in moved_marks | local_marks:
-                            if gm == g_ref:
-                                fits[dm] = False
-                    pod_obj = (enc.scheduled_pods[slot]
-                               if slot < len(enc.scheduled_pods) else None)
-                    if need_exact[g_ref] and pod_obj is not None:
-                        # unschedule from the oracle world, then exact-check
-                        # each dense-feasible destination in index order
-                        src_list = by_node.get(pod_obj.node_name, [])
-                        if pod_obj in src_list:
-                            src_list.remove(pod_obj)
-                        alive = [nd for k, nd in enumerate(nodes)
-                                 if not deleted_mask[k]]
-                        d = -1
-                        for cand_d in np.nonzero(fits)[0]:
-                            if _oracle.check_pod_in_cluster(
-                                    pod_obj, nodes[int(cand_d)], alive, by_node,
-                                    registry=enc.registry):
-                                d = int(cand_d)
+                    slots_by_group.setdefault(int(group_ref[slot]), []).append(slot)
+                for g_ref, slots_g in sorted(slots_by_group.items()):
+                    if not (need_exact[g_ref] or limit_g[g_ref]):
+                        want = len(slots_g)
+                        gr = greq[g_ref]
+                        fits = fits_m[g_ref] & ~deleted_mask
+                        fits[i] = False
+                        per_r = np.where(gr[None, :] > 0,
+                                         np.maximum(free, 0) // np.maximum(gr[None, :], 1),
+                                         1 << 30)
+                        fit = np.clip(per_r.min(axis=1), 0, want)
+                        fit = np.where(fits, fit, 0)
+                        cum = np.cumsum(fit)
+                        place = np.clip(want - (cum - fit), 0, fit)
+                        if int(place.sum()) < want:
+                            ok = False
+                            break
+                        dests = np.repeat(np.nonzero(place)[0],
+                                          place[place > 0].astype(int))
+                        for slot, d in zip(slots_g, dests):
+                            charge(int(d), reqs[slot], +1)
+                            moves[slot] = int(d)
+                        continue
+                    for slot in slots_g:
+                        req = reqs[slot]
+                        fits = fits_m[g_ref] & ~deleted_mask
+                        fits[i] = False
+                        if limit_g[g_ref]:
+                            for (gm, dm) in moved_marks | local_marks:
+                                if gm == g_ref:
+                                    fits[dm] = False
+                        pod_obj = (enc.scheduled_pods[slot]
+                                   if slot < len(enc.scheduled_pods) else None)
+                        if need_exact[g_ref] and pod_obj is not None:
+                            # unschedule from the oracle world, then exact-check
+                            # each dense-feasible destination in index order
+                            src_list = by_node.get(pod_obj.node_name, [])
+                            if pod_obj in src_list:
+                                src_list.remove(pod_obj)
+                            alive = [nd for k, nd in enumerate(nodes)
+                                     if not deleted_mask[k]]
+                            d = -1
+                            for cand_d in np.nonzero(fits)[0]:
+                                if _oracle.check_pod_in_cluster(
+                                        pod_obj, nodes[int(cand_d)], alive, by_node,
+                                        registry=enc.registry):
+                                    d = int(cand_d)
+                                    break
+                            if d < 0:
+                                src_list.append(pod_obj)  # restore the world
+                                ok = False
                                 break
-                        if d < 0:
-                            src_list.append(pod_obj)  # restore the world
-                            ok = False
-                            break
-                        clone = _copy.deepcopy(pod_obj)
-                        clone.node_name = nodes[d].name
-                        by_node.setdefault(nodes[d].name, []).append(clone)
-                        local_pod_moves.append((pod_obj, pod_obj.node_name, clone))
-                    else:
-                        d = int(np.argmax(fits))
-                        if not fits[d]:
-                            ok = False
-                            break
-                    charge(d, req, +1)
-                    moves[slot] = d
-                    if limit_g[g_ref]:
-                        local_marks.add((g_ref, d))
+                            clone = _copy.deepcopy(pod_obj)
+                            clone.node_name = nodes[d].name
+                            by_node.setdefault(nodes[d].name, []).append(clone)
+                            local_pod_moves.append((pod_obj, pod_obj.node_name, clone))
+                        else:
+                            d = int(np.argmax(fits))
+                            if not fits[d]:
+                                ok = False
+                                break
+                        charge(d, reqs[slot], +1)
+                        moves[slot] = d
+                        if limit_g[g_ref]:
+                            local_marks.add((g_ref, d))
+                    if not ok:
+                        break
                 if not ok:
                     # revert charges; try again next loop (destinations taken
                     # by an earlier candidate this round)

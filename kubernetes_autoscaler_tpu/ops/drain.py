@@ -309,131 +309,3 @@ def _simulate_removals_jit(
         feas=feas_gn,
     )
 
-
-class ConfirmResult(struct.PyTreeNode):
-    accepted: jax.Array    # bool[C] candidate confirmed for deletion
-    dest_node: jax.Array   # i32[C, MPN] destination per pod slot (-1 = none)
-    pod_slot: jax.Array    # i32[C, MPN] scheduled-pod slot per window entry
-    free_after: jax.Array  # i32[N, R] remaining capacity after all accepts
-
-
-def confirm_removals_sequential(
-    nodes: NodeTensors,
-    specs: PodGroupTensors,
-    scheduled: ScheduledPodTensors,
-    ordered_cand: jnp.ndarray,   # i32[C] PRE-SCREENED candidates, oldest first
-    dest_allowed: jnp.ndarray,   # bool[N]
-    max_pods_per_node: int = 128,
-    max_groups_per_node: int = 16,
-) -> ConfirmResult:
-    """The SEQUENTIAL confirmation pass as one device program.
-
-    Reference counterpart: the commit-on-success ordering of
-    simulator/cluster.go:174-188 — each accepted removal's moves commit into
-    the working snapshot before the next candidate simulates. The planner's
-    host loop did this in Python per pod (the round-2 review's unbounded
-    cost); here it is a lax.scan carrying (free, deleted): per candidate, its
-    resident groups re-pack onto live capacity; all-or-nothing acceptance.
-
-    Policy gates (unneeded time, budgets, quotas, PDBs, atomic groups) stay on
-    the host: the candidate list arrives pre-screened, and the host applies
-    caps to the accepted sequence afterwards (a host-rejected accept leaves
-    conservative capacity bookkeeping — never optimistic).
-
-    A node that RECEIVED moved pods is never subsequently deleted (received
-    pods are not in the resident tensors, so their re-placement cannot be
-    simulated). The host loop allows that cascade by re-placing received
-    slots; the kernel trades it for bounded cost — receivers fill toward
-    100% utilization and stop being deletion-worthy either way, so the
-    steady-state consolidation ratio is identical.
-    """
-    n = nodes.n
-    g_total = specs.g
-    mpn = max_pods_per_node
-    kk = max_groups_per_node
-
-    feas_gn = feasibility_mask(nodes, specs, check_resources=False)
-    resident = resident_group_counts(scheduled, g_total, n)
-    feas_gn = feas_gn & ~(specs.anti_affinity_self[:, None] & (resident > 0))
-    limit_g = specs.one_per_node()
-
-    sort_key = jnp.where(scheduled.valid, scheduled.node_idx, n + 1)
-    pod_order = jnp.argsort(sort_key).astype(jnp.int32)
-    sorted_nodes = sort_key[pod_order]
-    starts = jnp.searchsorted(sorted_nodes, jnp.arange(n)).astype(jnp.int32)
-    pad_order = jnp.concatenate([pod_order, jnp.full((mpn,), -1, jnp.int32)])
-    base_dest = dest_allowed & nodes.valid & nodes.ready & nodes.schedulable
-
-    def step(carry, c):
-        free, deleted, received = carry
-        start = starts[c]
-        slots = jax.lax.dynamic_slice(pad_order, (start,), (mpn,))
-        safe = jnp.maximum(slots, 0)
-        on_c = (slots >= 0) & (scheduled.node_idx[safe] == c) & scheduled.valid[safe]
-        movable = on_c & scheduled.movable[safe]
-        blocker = (on_c & scheduled.blocks[safe]).any()
-
-        gref = jnp.where(movable, scheduled.group_ref[safe], g_total)
-        counts = jnp.zeros((g_total + 1,), jnp.int32).at[gref].add(
-            movable.astype(jnp.int32))
-        nz = counts[:g_total] > 0
-        rank = jnp.cumsum(nz) - 1
-        compact_of_g = jnp.where(nz & (rank < kk), rank, kk)
-        gidx = (jnp.zeros((kk + 1,), jnp.int32)
-                .at[compact_of_g].set(jnp.arange(g_total, dtype=jnp.int32))[:kk])
-        filled = jnp.arange(kk) < jnp.minimum(nz.sum(), kk)
-        cnt_k = jnp.where(filled, counts[:g_total][gidx], 0)
-        overflow = nz.sum() > kk
-
-        dest = base_dest & ~deleted & (jnp.arange(n) != c)
-
-        # static K unroll: the sequential depth of the whole pass is
-        # candidates × 1 compiled steps, not candidates × K loop iterations
-        free_try = free
-        placed_n = jnp.zeros((n,), jnp.int32)
-        placed_js, cumplace_js = [], []
-        for j in range(kk):
-            gi = gidx[j]
-            want = cnt_k[j]
-            fit = fit_count(free_try, specs.req[gi])
-            fit = jnp.where(feas_gn[gi] & dest, fit, 0)
-            fit = jnp.where(limit_g[gi], jnp.minimum(fit, 1), fit)
-            fit = jnp.minimum(fit, want)
-            cum = jnp.cumsum(fit)
-            place = jnp.clip(want - (cum - fit), 0, fit)
-            free_try = free_try - place[:, None] * specs.req[gi][None, :]
-            placed_n = placed_n + place
-            placed_js.append(place.sum())
-            cumplace_js.append(jnp.cumsum(place))
-        placed_k = jnp.stack(placed_js)
-        cumplace_k = jnp.stack(cumplace_js)
-        ok = ((~blocker) & (~overflow) & (~received[c]) & (~deleted[c])
-              & (placed_k.sum() == movable.sum()))
-
-        # per-pod destination reconstruction (as in the independent sweep)
-        same = (gref[:, None] == gref[None, :]) & movable[:, None] & movable[None, :]
-        before = jnp.sum(jnp.tril(same, -1), axis=1).astype(jnp.int32)
-        j_of_slot = jnp.concatenate(
-            [compact_of_g, jnp.full((1,), kk, jnp.int32)])[gref]
-        dests = jnp.full((mpn,), -1, jnp.int32)
-        for j in range(kk):
-            d_j = jnp.searchsorted(cumplace_k[j], before + 1).astype(jnp.int32)
-            hit = movable & (j_of_slot == j) & (before < placed_k[j])
-            dests = jnp.where(hit, d_j, dests)
-        dests = jnp.where(ok, dests, -1)
-
-        free = jnp.where(ok, free_try, free)
-        deleted = deleted.at[c].set(deleted[c] | ok)
-        received = received | (ok & (placed_n > 0))
-        return (free, deleted, received), (ok, dests, jnp.where(on_c, safe, -1))
-
-    init = (nodes.free(), ~nodes.valid, jnp.zeros((n,), bool))
-    (free_after, _, _), (accepted, dest_node, pod_slot) = jax.lax.scan(
-        step, init, jnp.asarray(ordered_cand, jnp.int32), unroll=2)
-    return ConfirmResult(accepted=accepted, dest_node=dest_node,
-                         pod_slot=pod_slot, free_after=free_after)
-
-
-confirm_removals_sequential_jit = partial(
-    jax.jit, static_argnames=("max_pods_per_node", "max_groups_per_node")
-)(confirm_removals_sequential)
