@@ -51,6 +51,29 @@ import numpy as np
 RETRIES = int(os.environ.get("KA_TPU_BENCH_RETRIES", "5"))
 BACKOFF_S = float(os.environ.get("KA_TPU_BENCH_BACKOFF_S", "3"))
 BACKOFF_CAP_S = 60.0
+INIT_TIMEOUT_S = float(os.environ.get("KA_TPU_BENCH_INIT_TIMEOUT_S", "120"))
+
+
+def with_timeout(fn, seconds: float = INIT_TIMEOUT_S):
+    """Run fn() with a hard wall-clock bound. A DOWN tunnel makes backend
+    discovery HANG (observed live) rather than raise — without this, no retry
+    ever fires and no error JSON is ever printed. The worker thread is
+    daemonic: if it never returns, process exit is not blocked."""
+    import concurrent.futures
+
+    def wrapped():
+        ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="bench-init")
+        try:
+            fut = ex.submit(fn)
+            return fut.result(timeout=seconds)
+        except concurrent.futures.TimeoutError:
+            raise TimeoutError(
+                f"backend touch exceeded {seconds:.0f}s (tunnel hang?)")
+        finally:
+            ex.shutdown(wait=False)
+
+    return wrapped
 
 
 def with_retries(fn, what: str, attempts: int = RETRIES,
@@ -194,15 +217,16 @@ def run_bench(args, metric: str) -> None:
 
         return jax, jax.devices()[0], scale_up_sim
 
-    jax, dev, scale_up_sim = with_retries(_init, "backend init")
+    jax, dev, scale_up_sim = with_retries(with_timeout(_init), "backend init")
     import jax.numpy as jnp
 
     from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS
 
     # encode ships tensors to the device, so it is also a tunnel touch
     enc, groups, encode_s = with_retries(
-        lambda: build_world(args.nodes, args.pods, args.pod_groups,
-                            args.nodegroups),
+        with_timeout(lambda: build_world(args.nodes, args.pods,
+                                         args.pod_groups, args.nodegroups),
+                     seconds=max(INIT_TIMEOUT_S, 180)),
         "world encode + upload",
     )
     nodes, specs, sched, groups = with_retries(
@@ -225,7 +249,10 @@ def run_bench(args, metric: str) -> None:
 
     t0 = time.perf_counter()
     out = with_retries(
-        lambda: jax.block_until_ready(step(nodes, specs, sched, groups, jnp.int32(0))),
+        with_timeout(
+            lambda: jax.block_until_ready(step(nodes, specs, sched, groups,
+                                               jnp.int32(0))),
+            seconds=max(INIT_TIMEOUT_S, 300)),
         "compile + first dispatch",
     )
     compile_s = time.perf_counter() - t0

@@ -101,3 +101,37 @@ def test_bench_small_run_on_cpu_produces_metric():
     assert doc["unit"] == "ms"
     assert doc["value"] is not None and doc["value"] > 0
     assert "error" not in doc
+
+
+def test_with_timeout_raises_on_hang():
+    bench = _load_bench()
+    import time as _time
+
+    def hang():
+        _time.sleep(30)
+
+    wrapped = bench.with_timeout(hang, seconds=0.2)
+    with pytest.raises(TimeoutError):
+        wrapped()
+
+    def quick():
+        return 42
+
+    assert bench.with_timeout(quick, seconds=5)() == 42
+
+
+def test_hang_then_recover_via_retries():
+    bench = _load_bench()
+    calls = {"n": 0}
+    import time as _time
+
+    def flaky_hang():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            _time.sleep(30)   # first attempt: tunnel hang
+        return "ok"
+
+    out = bench.with_retries(bench.with_timeout(flaky_hang, seconds=0.2),
+                             "probe", attempts=3, backoff_s=0.01,
+                             sleep=lambda s: None)
+    assert out == "ok" and calls["n"] == 2
