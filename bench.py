@@ -57,21 +57,30 @@ INIT_TIMEOUT_S = float(os.environ.get("KA_TPU_BENCH_INIT_TIMEOUT_S", "120"))
 def with_timeout(fn, seconds: float = INIT_TIMEOUT_S):
     """Run fn() with a hard wall-clock bound. A DOWN tunnel makes backend
     discovery HANG (observed live) rather than raise — without this, no retry
-    ever fires and no error JSON is ever printed. The worker thread is
-    daemonic: if it never returns, process exit is not blocked."""
-    import concurrent.futures
+    ever fires and no error JSON is ever printed. The worker is a DAEMON
+    thread (ThreadPoolExecutor would block interpreter exit joining the hung
+    worker), so a never-returning call cannot wedge the process."""
+    import threading
 
     def wrapped():
-        ex = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="bench-init")
-        try:
-            fut = ex.submit(fn)
-            return fut.result(timeout=seconds)
-        except concurrent.futures.TimeoutError:
+        result: list = []
+        error: list = []
+
+        def run():
+            try:
+                result.append(fn())
+            except Exception as e:  # noqa: BLE001 — forwarded to caller
+                error.append(e)
+
+        t = threading.Thread(target=run, daemon=True, name="bench-init")
+        t.start()
+        t.join(timeout=seconds)
+        if t.is_alive():
             raise TimeoutError(
                 f"backend touch exceeded {seconds:.0f}s (tunnel hang?)")
-        finally:
-            ex.shutdown(wait=False)
+        if error:
+            raise error[0]
+        return result[0]
 
     return wrapped
 
