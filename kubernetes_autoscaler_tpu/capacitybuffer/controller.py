@@ -60,21 +60,26 @@ class BufferController:
                     pass
         # generation-skipped buffers stay active if previously resolved ready
         active = [b for b in self.buffers if b.status.ready()]
-        return self._clamp_to_quota(active)
+        return [buf for buf, _ in self.active_with_replicas(active)]
 
-    def _clamp_to_quota(self, active: list[CapacityBuffer]
-                        ) -> list[CapacityBuffer]:
+    def active_with_replicas(self, active: list[CapacityBuffer] | None = None
+                             ) -> list[tuple[CapacityBuffer, int]]:
+        """(buffer, effective replicas) with the quota clamp applied
+        TRANSIENTLY per reconcile — status.replicas keeps the spec-resolved
+        value so the clamp relaxes the moment quota frees up (the clamp is a
+        per-loop admission decision, not a spec mutation)."""
+        if active is None:
+            active = self.reconcile()
         if not self.headroom_quota:
-            return active
+            return [(b, b.status.replicas) for b in active]
         used: dict[str, float] = {}
-        out = []
+        out: list[tuple[CapacityBuffer, int]] = []
         for buf in active:
             tmpl = buf.status.pod_template
             if tmpl is None:
-                out.append(buf)
+                out.append((buf, buf.status.replicas))
                 continue
             replicas = buf.status.replicas
-            # clamp replicas so cumulative headroom stays under quota
             for res_name, limit in self.headroom_quota.items():
                 per = float(tmpl.requests.get(res_name, 0.0))
                 if per <= 0:
@@ -83,21 +88,22 @@ class BufferController:
                 replicas = min(replicas, int(max(room, 0) // per))
             if replicas < buf.status.replicas:
                 buf.status.conditions["reason"] = "LimitedByBufferQuota"
+            else:
+                buf.status.conditions.pop("reason", None)
             if replicas <= 0:
                 continue
-            buf.status.replicas = replicas
-            tmplreq = buf.status.pod_template.requests
             for res_name in self.headroom_quota:
                 used[res_name] = (used.get(res_name, 0.0)
-                                  + float(tmplreq.get(res_name, 0.0)) * replicas)
-            out.append(buf)
+                                  + float(tmpl.requests.get(res_name, 0.0))
+                                  * replicas)
+            out.append((buf, replicas))
         return out
 
     def pending_pods(self) -> list[Pod]:
         """Fake pending pods for all active buffers — injected each loop."""
         out: list[Pod] = []
-        for buf in self.reconcile():
-            out.extend(fake_pods_for(buf))
+        for buf, replicas in self.active_with_replicas():
+            out.extend(fake_pods_for(buf, replicas=replicas))
         return out
 
 

@@ -1,0 +1,110 @@
+"""ctypes binding for the native confirmation pass (kaconfirm.cc in
+libkacodec.so) + the planner-facing wrapper.
+
+The native kernel covers the COMMON case (no PDBs, no exact-oracle groups, no
+one-per-node groups, no atomic groups); `core/scaledown/planner.py` keeps the
+Python pass as the general fallback and `tests/test_native_confirm.py`
+property-tests the two against each other.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "sidecar")
+_LIB_PATH = os.path.join(_DIR, "libkacodec.so")
+_lib = None
+_available: bool | None = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        subprocess.run(["make", "-C", _DIR, "-s"], check=True)
+    lib = ctypes.CDLL(_LIB_PATH)
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.ka_confirm.restype = ctypes.c_int
+    lib.ka_confirm.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        i64p, u8p, u8p, i32p,
+        ctypes.c_int, i32p, i32p, i32p, i32p, i32p,
+        ctypes.c_int, i32p,
+        ctypes.c_void_p, ctypes.c_void_p, i64p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        u8p, u8p, i32p,
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    global _available
+    if _available is None:
+        try:
+            _load()
+            _available = True
+        except Exception:
+            _available = False
+    return _available
+
+
+def confirm(
+    free: np.ndarray,            # i64[N, R] — mutated
+    feas: np.ndarray,            # bool[G, N]
+    node_valid: np.ndarray,      # bool[N]
+    greq: np.ndarray,            # i32[G, R]
+    cand_node: np.ndarray,       # i32[C]
+    slot_ids: np.ndarray,        # i32[total]
+    slot_group: np.ndarray,      # i32[total]
+    slot_off: np.ndarray,        # i32[C+1]
+    cand_group_idx: np.ndarray,  # i32[C]
+    group_room: np.ndarray,      # i32[n_room] — mutated
+    quota_totals: np.ndarray | None,  # i64[R] — mutated
+    quota_min: np.ndarray | None,     # i64[R]
+    node_cap: np.ndarray,        # i64[N, R]
+    empty_budget: int, drain_budget: int, total_budget: int,
+    max_slot_id: int,
+):
+    """Run the native pass; returns (accept u8[C], reason u8[C], dest i32[S])."""
+    lib = _load()
+    n, r = free.shape
+    g = feas.shape[0]
+    c = cand_node.shape[0]
+    accept = np.zeros((c,), np.uint8)
+    reason = np.zeros((c,), np.uint8)
+    dest = np.full((max_slot_id + 1,), -1, np.int32)
+    qt = (quota_totals.ctypes.data_as(ctypes.c_void_p)
+          if quota_totals is not None else None)
+    qm = (quota_min.ctypes.data_as(ctypes.c_void_p)
+          if quota_min is not None else None)
+    rc = lib.ka_confirm(
+        n, r, g,
+        np.ascontiguousarray(free),
+        np.ascontiguousarray(feas.astype(np.uint8)),
+        np.ascontiguousarray(node_valid.astype(np.uint8)),
+        np.ascontiguousarray(greq.astype(np.int32)),
+        c,
+        np.ascontiguousarray(cand_node.astype(np.int32)),
+        np.ascontiguousarray(slot_ids.astype(np.int32)),
+        np.ascontiguousarray(slot_group.astype(np.int32)),
+        np.ascontiguousarray(slot_off.astype(np.int32)),
+        np.ascontiguousarray(cand_group_idx.astype(np.int32)),
+        int(group_room.shape[0]),
+        group_room,
+        qt, qm,
+        np.ascontiguousarray(node_cap.astype(np.int64)),
+        int(empty_budget), int(drain_budget), int(total_budget),
+        accept, reason, dest,
+    )
+    if rc < 0:
+        raise RuntimeError("ka_confirm rejected its arguments")
+    return accept, reason, dest

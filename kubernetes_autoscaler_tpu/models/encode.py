@@ -573,6 +573,10 @@ def encode_cluster(
         if enc_g.spread_kind:
             selectors.append((None, enc_g.spread_selector))
         selectors.extend((t, None) for t in enc_g.anti_host_terms + enc_g.anti_zone_terms)
+        if enc_g.aff_term is not None and not enc_g.aff_self:
+            # positive affinity satisfiable only by ANOTHER pending group's
+            # placements: not modeled on device → host-check tier
+            selectors.append((enc_g.aff_term, None))
         if not selectors:
             continue
         for hrow in pending_rows:

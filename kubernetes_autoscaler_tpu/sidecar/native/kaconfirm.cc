@@ -1,0 +1,201 @@
+// Native sequential confirmation pass for scale-down.
+//
+// Reference counterpart: the commit-on-success ordering of
+// simulator/cluster.go:174-188 driven by core/scaledown/planner NodesToDelete —
+// the one latency-critical HOST-side loop in the framework (SURVEY.md §0:
+// "the single latency-critical host-side component ... is C++ where Go/Python
+// would be too slow"). Python/numpy does this pass in seconds at 5k nodes /
+// 50k pods; this kernel does the identical algorithm in milliseconds.
+//
+// Semantics (mirrors core/scaledown/planner.py attempt(), fast-path subset —
+// no PDBs, no exact-oracle groups, no one-per-node groups, no atomic groups;
+// the Python loop remains the fallback for those):
+//   * candidates processed in the given order (oldest unneeded clock first)
+//   * per candidate: its victim slots (original residents + pods RECEIVED
+//     from earlier accepted drains) re-place group-by-group, first feasible
+//     node in index order, against live free capacity
+//   * all-or-nothing: failure reverts the candidate's placements
+//   * group min-size room, empty/drain/total budgets, and min-quota gates
+//     applied exactly as the Python pass does
+//
+// Build: part of libkacodec.so (see ../Makefile).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Move {
+  int slot;
+  int node;
+  int group;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of accepted candidates, or -1 on bad arguments.
+// reason_out: 0 accepted, 1 no-place, 2 group-room, 3 quota, 4 budget-skip.
+int ka_confirm(
+    int n, int r, int g,
+    int64_t* free_io,            // [n*r] free capacity, mutated in place
+    const uint8_t* feas,         // [g*n] predicate plane (pre-capacity)
+    const uint8_t* node_valid,   // [n] valid & ready & schedulable
+    const int32_t* greq,         // [g*r] per-group request vectors
+    int n_cand,
+    const int32_t* cand_node,    // [n_cand]
+    const int32_t* slot_ids,     // [total_slots] scheduled-pod slot ids
+    const int32_t* slot_group,   // [total_slots] group per slot
+    const int32_t* slot_off,     // [n_cand+1] per-candidate ranges
+    const int32_t* cand_group_idx,  // [n_cand] index into group_room
+    int n_room,
+    int32_t* group_room,         // [n_room] remaining deletions per node group
+    int64_t* quota_totals,       // [r] running cluster totals (or null)
+    const int64_t* quota_min,    // [r] min limits (or null)
+    const int64_t* node_cap,     // [n*r] per-node capacity (for quota deduct)
+    int empty_budget, int drain_budget, int total_budget,
+    uint8_t* accept_out,         // [n_cand]
+    uint8_t* reason_out,         // [n_cand]
+    int32_t* dest_out)           // slot id -> destination (indexed by slot id;
+                                 // caller sizes it max_slot_id+1, fills -1)
+{
+  if (n <= 0 || r <= 0 || g <= 0 || n_cand < 0) return -1;
+  std::vector<uint8_t> deleted(n, 0);
+  // pods moved ONTO a node (re-placed again if that node later drains)
+  std::vector<std::vector<Move>> received(n);
+  // first-fit frontier hint per group: nodes before the hint are known full
+  // for that group's request (capacity only shrinks; reverts rewind the hint)
+  std::vector<int> hint(g, 0);
+  int accepted = 0;
+
+  for (int c = 0; c < n_cand; ++c) {
+    accept_out[c] = 0;
+    reason_out[c] = 4;
+    if (accepted >= total_budget) continue;
+    const int cand = cand_node[c];
+    if (cand < 0 || cand >= n) continue;
+
+    const int gi_room = cand_group_idx[c];
+    if (gi_room < 0 || gi_room >= n_room || group_room[gi_room] <= 0) {
+      reason_out[c] = 2;
+      continue;
+    }
+    if (quota_totals && quota_min) {
+      bool quota_ok = true;
+      for (int k = 0; k < r; ++k) {
+        if (quota_totals[k] - node_cap[(int64_t)cand * r + k] < quota_min[k]) {
+          quota_ok = false;
+          break;
+        }
+      }
+      if (!quota_ok) {
+        reason_out[c] = 3;
+        continue;
+      }
+    }
+
+    // victim set: original slots + received pods
+    std::vector<Move> victims;
+    for (int s = slot_off[c]; s < slot_off[c + 1]; ++s)
+      victims.push_back({slot_ids[s], -1, slot_group[s]});
+    const size_t n_orig = victims.size();
+    for (const Move& m : received[cand]) victims.push_back(m);
+    const bool is_empty = victims.empty();
+    if (is_empty) {
+      if (empty_budget <= 0) continue;
+    } else {
+      if (drain_budget <= 0) continue;
+    }
+
+    // place group-by-group (stable-sorted so equal groups are consecutive),
+    // first-fit in node index order
+    std::stable_sort(victims.begin(), victims.end(),
+                     [](const Move& a, const Move& b) { return a.group < b.group; });
+    std::vector<Move> placed;
+    placed.reserve(victims.size());
+    bool ok = true;
+    size_t v = 0;
+    while (v < victims.size() && ok) {
+      const int gg = victims[v].group;
+      size_t v_end = v;
+      while (v_end < victims.size() && victims[v_end].group == gg) ++v_end;
+      int want = (int)(v_end - v);
+      const int32_t* req = greq + (int64_t)gg * r;
+      const uint8_t* fg = feas + (int64_t)gg * n;
+      int node = hint[gg];
+      bool advancing_frontier = true;
+      while (want > 0 && node < n) {
+        if (node == cand) {
+          // the candidate itself is only transiently excluded — never
+          // advance the persistent frontier past it
+          advancing_frontier = false;
+          ++node;
+          continue;
+        }
+        if (deleted[node] || !node_valid[node] || !fg[node]) {
+          if (advancing_frontier && node == hint[gg]) ++hint[gg];
+          ++node;
+          continue;
+        }
+        int64_t* fr = free_io + (int64_t)node * r;
+        int64_t fits = INT64_MAX;
+        for (int k = 0; k < r; ++k) {
+          if (req[k] > 0) {
+            int64_t f = fr[k] / req[k];
+            if (f < fits) fits = f;
+          }
+        }
+        if (fits <= 0) {
+          if (advancing_frontier && node == hint[gg]) ++hint[gg];
+          ++node;
+          continue;
+        }
+        advancing_frontier = false;
+        int take = (int)(fits < want ? fits : want);
+        for (int t = 0; t < take; ++t) {
+          placed.push_back({victims[v + (v_end - v - want) + t].slot, node, gg});
+        }
+        for (int k = 0; k < r; ++k) fr[k] -= (int64_t)req[k] * take;
+        want -= take;
+        ++node;
+      }
+      if (want > 0) ok = false;
+      v = v_end;
+    }
+
+    if (!ok) {
+      for (const Move& m : placed) {
+        const int32_t* req = greq + (int64_t)m.group * r;
+        int64_t* fr = free_io + (int64_t)m.node * r;
+        for (int k = 0; k < r; ++k) fr[k] += req[k];
+        if (m.node < hint[m.group]) hint[m.group] = m.node;
+      }
+      reason_out[c] = 1;
+      continue;
+    }
+
+    // accept
+    accept_out[c] = 1;
+    reason_out[c] = 0;
+    ++accepted;
+    deleted[cand] = 1;
+    group_room[gi_room] -= 1;
+    if (is_empty) --empty_budget; else --drain_budget;
+    if (quota_totals) {
+      for (int k = 0; k < r; ++k)
+        quota_totals[k] -= node_cap[(int64_t)cand * r + k];
+    }
+    received[cand].clear();
+    for (const Move& m : placed) {
+      dest_out[m.slot] = m.node;
+      received[m.node].push_back(m);
+    }
+    (void)n_orig;
+  }
+  return accepted;
+}
+
+}  // extern "C"

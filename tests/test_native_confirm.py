@@ -1,0 +1,120 @@
+"""Native (C++) confirmation pass ≡ the Python pass: randomized worlds,
+identical plans (accepted nodes, destinations, reasons class).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.scaledown import native_confirm
+from kubernetes_autoscaler_tpu.core.scaledown.planner import Planner
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
+    apply_drainability,
+)
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+pytestmark = pytest.mark.skipif(not native_confirm.available(),
+                                reason="native toolchain unavailable")
+
+
+def _opts(**kw):
+    base = dict(
+        node_shape_bucket=64, group_shape_bucket=16, max_new_nodes_static=32,
+        max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    base.update(kw)
+    return AutoscalingOptions(**base)
+
+
+def _world(rng, n_nodes):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384, pods=32)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=4 * n_nodes)
+    nodes, pods = [], []
+    for i in range(n_nodes):
+        nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384, pods=32)
+        fake.add_existing_node("ng1", nd)
+        nodes.append(nd)
+        for j in range(rng.randint(0, 4)):
+            p = build_test_pod(
+                f"p{i}-{j}", cpu_milli=rng.choice([500, 1000, 1500]),
+                mem_mib=rng.choice([256, 512]),
+                owner_name=f"rs{rng.randint(0, 4)}", node_name=nd.name)
+            fake.add_pod(p)
+            pods.append(p)
+    enc = encode_cluster(nodes, pods, node_bucket=64, group_bucket=16)
+    apply_drainability(enc)
+    return fake, enc, nodes
+
+
+def _plan(fake, enc, nodes, use_native, monkeypatch, **opt_kw):
+    if not use_native:
+        monkeypatch.setattr(native_confirm, "_available", False)
+    else:
+        monkeypatch.setattr(native_confirm, "_available", None)
+    pl = Planner(fake.provider, _opts(**opt_kw))
+    pl.update(enc, nodes, now=1000.0)
+    out = pl.nodes_to_delete(enc, nodes, now=1000.0)
+    return {r.node.name: (r.is_empty, sorted(r.pods_to_move),
+                          dict(sorted(r.destinations.items())))
+            for r in out}
+
+
+def test_native_matches_python_randomized(monkeypatch):
+    for trial in range(5):
+        rng = random.Random(100 + trial)
+        fake, enc, nodes = _world(rng, n_nodes=rng.randint(6, 14))
+        got_native = _plan(fake, enc, nodes, True, monkeypatch,
+                           max_scale_down_parallelism=len(nodes),
+                           max_drain_parallelism=len(nodes),
+                           max_empty_bulk_delete=len(nodes))
+        got_python = _plan(fake, enc, nodes, False, monkeypatch,
+                           max_scale_down_parallelism=len(nodes),
+                           max_drain_parallelism=len(nodes),
+                           max_empty_bulk_delete=len(nodes))
+        assert got_native == got_python, f"trial {trial}"
+
+
+def test_native_matches_python_with_budgets(monkeypatch):
+    rng = random.Random(7)
+    fake, enc, nodes = _world(rng, n_nodes=12)
+    for kw in (dict(max_scale_down_parallelism=3),
+               dict(max_drain_parallelism=1, max_empty_bulk_delete=2),
+               dict(max_empty_bulk_delete=0, max_drain_parallelism=4)):
+        a = _plan(fake, enc, nodes, True, monkeypatch, **kw)
+        b = _plan(fake, enc, nodes, False, monkeypatch, **kw)
+        assert a == b, kw
+
+
+def test_native_consolidation_scenario(monkeypatch):
+    # the 40%-utilization consolidation shape: exact same deletions either way
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=10_000, mem_mib=32_768, pods=16)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=100)
+    nodes, pods = [], []
+    for i in range(20):
+        nd = build_test_node(f"n{i}", cpu_milli=10_000, mem_mib=32_768, pods=16)
+        fake.add_existing_node("ng1", nd)
+        nodes.append(nd)
+        for j in range(2):
+            p = build_test_pod(f"p{i}-{j}", cpu_milli=2000, mem_mib=512,
+                               owner_name=f"rs{i % 5}", node_name=nd.name)
+            fake.add_pod(p)
+            pods.append(p)
+    enc = encode_cluster(nodes, pods, node_bucket=64, group_bucket=16)
+    apply_drainability(enc)
+    kw = dict(max_scale_down_parallelism=20, max_drain_parallelism=20,
+              max_empty_bulk_delete=20)
+    a = _plan(fake, enc, nodes, True, monkeypatch, **kw)
+    b = _plan(fake, enc, nodes, False, monkeypatch, **kw)
+    assert a == b
+    assert len(a) == 12  # 60% consolidate
