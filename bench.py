@@ -300,19 +300,21 @@ def run_bench(args, metric: str) -> None:
         f"fit_checks/s={checks / (p50 / 1e3):.3e}",
         file=sys.stderr,
     )
-    if args.scaledown:
-        try:
-            bench_scaledown(args)
-        except Exception as e:  # stderr-only extra: never sink the metric
-            print(f"[bench] scale-down phase failed: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-
+    # the metric JSON prints FIRST: a tunnel hang in the optional scale-down
+    # phase must never lose the already-measured evidence
     print(json.dumps({
         "metric": metric,
         "value": round(p50, 3),
         "unit": "ms",
         "vs_baseline": round(200.0 / p50, 2),
-    }))
+    }), flush=True)
+
+    if args.scaledown:
+        try:
+            with_timeout(lambda: bench_scaledown(args), seconds=420)()
+        except Exception as e:  # stderr-only extra: never sink the metric
+            print(f"[bench] scale-down phase failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
 
 
 def bench_scaledown(args) -> None:

@@ -59,14 +59,15 @@ def translate_buffer(buf: CapacityBuffer) -> None:
     st.conditions[PROVISIONING] = "True"
 
 
-def fake_pods_for(buf: CapacityBuffer) -> list[Pod]:
+def fake_pods_for(buf: CapacityBuffer, replicas: int | None = None) -> list[Pod]:
     """Materialize pending pods from a resolved buffer status (reference:
-    capacitybuffer fakepods registry + simulator/fake/pod.go)."""
+    capacitybuffer fakepods registry + simulator/fake/pod.go). `replicas`
+    overrides the status count (the controller's per-loop quota clamp)."""
     st = buf.status
     if not st.ready() or st.pod_template is None:
         return []
     out = []
-    for i in range(st.replicas):
+    for i in range(st.replicas if replicas is None else replicas):
         p = copy.deepcopy(st.pod_template)
         p.name = f"capacity-buffer-{buf.name}-{i}"
         p.namespace = buf.namespace

@@ -25,7 +25,7 @@ IMPLEMENTED: dict[str, str] = {
     "balancing-ignore-label": "balancing_ignore_labels",
     "balancing-label": "balancing_labels",
     "capacity-buffer-controller-enabled": "capacity_buffer_controller_enabled",
-    "capacity-buffer-pod-injection-enabled": "capacity_buffer_controller_enabled",
+    "capacity-buffer-pod-injection-enabled": "capacity_buffer_pod_injection_enabled",
     "capacity-quotas-enabled": "capacity_quotas_enabled",
     "cordon-node-before-terminating": "cordon_node_before_terminating",
     "cores-total": "max_cores_total (quota limiter merge)",

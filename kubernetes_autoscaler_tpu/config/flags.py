@@ -221,9 +221,8 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         grpc_expander_url=args.grpc_expander_url,
         grpc_expander_cert=args.grpc_expander_cert,
         enable_provisioning_requests=args.enable_provisioning_requests,
-        capacity_buffer_controller_enabled=(
-            args.capacity_buffer_controller_enabled
-            and args.capacity_buffer_pod_injection_enabled),
+        capacity_buffer_controller_enabled=args.capacity_buffer_controller_enabled,
+        capacity_buffer_pod_injection_enabled=args.capacity_buffer_pod_injection_enabled,
         capacity_quotas_enabled=args.capacity_quotas_enabled,
         enable_dynamic_resource_allocation=args.enable_dynamic_resource_allocation,
         enable_csi_node_aware_scheduling=args.enable_csi_node_aware_scheduling,

@@ -71,6 +71,9 @@ class AutoscalingOptions:
     # subsystem gates (reference feature flags)
     enable_provisioning_requests: bool = True
     capacity_buffer_controller_enabled: bool = True
+    # injection can stop while the controller keeps reconciling statuses
+    # (two independent reference flags)
+    capacity_buffer_pod_injection_enabled: bool = True
     capacity_quotas_enabled: bool = True
     enable_dynamic_resource_allocation: bool = True
     enable_csi_node_aware_scheduling: bool = True

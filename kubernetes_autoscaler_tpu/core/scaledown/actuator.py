@@ -117,6 +117,14 @@ class Actuator:
     def untaint(self, node: Node, key: str) -> None:
         node.taints = [t for t in node.taints if t.key != key]
 
+    def _rollback_node(self, node: Node) -> None:
+        """Failed deletion: remove the hard taint AND undo the cordon
+        (reference: CleanToBeDeleted un-cordons on rollback when
+        --cordon-node-before-terminating is set) so capacity is not lost."""
+        self.untaint(node, TO_BE_DELETED_TAINT)
+        if self.options.cordon_node_before_terminating:
+            node.unschedulable = False
+
     # ---- deletion (reference: StartDeletion, actuator.go) ----
 
     def start_deletion(
@@ -176,7 +184,7 @@ class Actuator:
                         results.append(DeletionResult(r.node.name, True))
                 except NodeGroupError as e:
                     for r in batch:
-                        self.untaint(r.node, TO_BE_DELETED_TAINT)
+                        self._rollback_node(r.node)
                         self.tracker.finish(r.node.name, False, str(e))
                         results.append(DeletionResult(r.node.name, False, str(e)))
 
@@ -209,7 +217,7 @@ class Actuator:
                     self.latency_tracker.observe_deletion(r.node.name, now)
                 return DeletionResult(r.node.name, True)
             except NodeGroupError as e:
-                self.untaint(r.node, TO_BE_DELETED_TAINT)
+                self._rollback_node(r.node)
                 self.tracker.finish(r.node.name, False, str(e))
                 return DeletionResult(r.node.name, False, str(e))
 

@@ -15,10 +15,7 @@ import threading
 import time
 from dataclasses import dataclass
 
-from kubernetes_autoscaler_tpu.cloudprovider.provider import (
-    NodeGroup,
-    NodeGroupError,
-)
+from kubernetes_autoscaler_tpu.cloudprovider.provider import NodeGroup
 from kubernetes_autoscaler_tpu.models.api import Node
 
 
@@ -77,8 +74,11 @@ class AsyncNodeGroupCreator:
             created.increase_size(delta)
             if self.cluster_state is not None:
                 self.cluster_state.register_scale_up(created, delta, time.time())
-        except NodeGroupError as e:
-            self.errors[gid] = str(e)
+        except Exception as e:  # noqa: BLE001 — ANY failure must be recorded:
+            # an unexpected exception escaping into a never-inspected Future
+            # would silently drop the promised capacity AND skip the backoff,
+            # letting the broken group win the next loop again
+            self.errors[gid] = f"{type(e).__name__}: {e}"
             if self.cluster_state is not None:
                 try:
                     self.cluster_state.register_failed_scale_up(group, time.time())

@@ -160,6 +160,24 @@ class StaticAutoscaler:
         # ProvisioningRequest wiring (reference: builder/autoscaler.go wraps
         # the scale-up orchestrator when ProvReq support is on) — active when
         # the data source exposes requests
+        # capacity buffers (reference: InitializeAndRunDefaultBufferController,
+        # builder/autoscaler.go:209) — reconcile every loop when the source
+        # exposes buffers; fake-pod INJECTION has its own independent gate
+        self.buffer_controller = None
+        self._list_buffers = (getattr(source, "list_capacity_buffers", None)
+                              if self.options.capacity_buffer_controller_enabled
+                              else None)
+        if self._list_buffers is not None:
+            from kubernetes_autoscaler_tpu.capacitybuffer.controller import (
+                BufferController,
+                BufferPodListProcessor,
+            )
+
+            self.buffer_controller = BufferController([])
+            if self.options.capacity_buffer_pod_injection_enabled:
+                self.processors.pod_list_processors.append(
+                    BufferPodListProcessor(self.buffer_controller))
+
         self.provreq_wrapper = None
         list_provreqs = (getattr(source, "list_provisioning_requests", None)
                          if self.options.enable_provisioning_requests else None)
@@ -249,6 +267,12 @@ class StaticAutoscaler:
                 self.provreq_wrapper.maybe_run(
                     nodes, [p for p in pods if p.node_name], now
                 )
+
+            # buffer reconciliation (status updates happen even when pod
+            # injection is disabled — two independent reference flags)
+            if self.buffer_controller is not None:
+                self.buffer_controller.buffers = list(self._list_buffers())
+                self.buffer_controller.reconcile()
 
             # host-side pod pipeline
             ctx = ProcessorContext(

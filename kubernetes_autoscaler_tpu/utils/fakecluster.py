@@ -38,6 +38,7 @@ class FakeCluster:
         self.pdbs: list = []
         self.workloads: list = []
         self.provreqs: list = []
+        self.capacity_buffers: list = []
         self._dra = None
         self._csi = None
         self.provision_delay_s = provision_delay_s
@@ -125,6 +126,12 @@ class FakeCluster:
 
     def add_workload(self, workload) -> None:
         self.workloads.append(workload)
+
+    def list_capacity_buffers(self) -> list:
+        return list(self.capacity_buffers)
+
+    def add_capacity_buffer(self, buf) -> None:
+        self.capacity_buffers.append(buf)
 
     def list_provisioning_requests(self) -> list:
         return list(self.provreqs)

@@ -23,17 +23,40 @@ from kubernetes_autoscaler_tpu.vpa.admission import patch_for_pod, validate_vpa
 from kubernetes_autoscaler_tpu.vpa.model import VerticalPodAutoscaler
 
 
-def _jsonpatch_from_ops(ops) -> list[dict]:
-    """PatchOps → RFC-6902 ops against the pod spec (reference:
-    resource/pod/patch builds the same /spec/containers/... paths)."""
+_QUANTITY_SUFFIX = {
+    "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+}
+
+
+def parse_quantity(v) -> float:
+    """k8s resource.Quantity string → float ('100m' → 0.1, '128Mi' → bytes).
+    Real AdmissionReview pods carry quantity STRINGS, never bare numbers."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "m", "k", "M", "G", "T", "P"):
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * _QUANTITY_SUFFIX[suf]
+    return float(s)
+
+
+def _jsonpatch_from_ops(ops, container_index: dict[str, int]) -> list[dict]:
+    """PatchOps → RFC-6902 ops against the pod spec. Containers are a JSON
+    ARRAY, so paths must use the container's INDEX, not its name (reference:
+    resource/pod/patch emits /spec/containers/<i>/...); `add` upserts whether
+    or not the requests/limits key already exists."""
     patches = []
     for op in ops:
+        idx = container_index.get(op.container)
+        if idx is None:
+            continue
         if op.resource.startswith("limit:"):
             res = op.resource.split(":", 1)[1]
-            path = f"/spec/containers/{op.container}/resources/limits/{res}"
+            path = f"/spec/containers/{idx}/resources/limits/{res}"
         else:
-            path = f"/spec/containers/{op.container}/resources/requests/{op.resource}"
-        patches.append({"op": "replace", "path": path, "value": op.value})
+            path = f"/spec/containers/{idx}/resources/requests/{op.resource}"
+        patches.append({"op": "add", "path": path, "value": op.value})
     return patches
 
 
@@ -65,16 +88,18 @@ class AdmissionService:
         owner = owners[0]["name"] if owners else meta.get("name", "")
         containers = {}
         limits = {}
-        for c in pod.get("spec", {}).get("containers", []):
+        container_index: dict[str, int] = {}
+        for i, c in enumerate(pod.get("spec", {}).get("containers", [])):
+            container_index[c["name"]] = i
             res = c.get("resources", {})
             containers[c["name"]] = {
-                k: float(v) for k, v in (res.get("requests") or {}).items()}
+                k: parse_quantity(v) for k, v in (res.get("requests") or {}).items()}
             limits[c["name"]] = {
-                k: float(v) for k, v in (res.get("limits") or {}).items()}
+                k: parse_quantity(v) for k, v in (res.get("limits") or {}).items()}
         ops = patch_for_pod(namespace, owner, containers, limits, self.vpas)
         if not ops:
             return {"allowed": True}
-        patch = json.dumps(_jsonpatch_from_ops(ops)).encode()
+        patch = json.dumps(_jsonpatch_from_ops(ops, container_index)).encode()
         return {"allowed": True, "patchType": "JSONPatch",
                 "patch": base64.b64encode(patch).decode()}
 

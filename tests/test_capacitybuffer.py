@@ -186,8 +186,57 @@ def test_headroom_quota_clamps_buffer_replicas():
                          pod_template=build_test_pod("t", cpu_milli=1000, mem_mib=256),
                          replicas=10)
     c = BufferController([big], headroom_quota={"cpu": 3.0})
-    active = c.reconcile()
-    assert len(active) == 1
-    assert active[0].status.replicas == 3       # 3 cores / 1 core per pod
+    pairs = c.active_with_replicas()
+    assert pairs == [(big, 3)]                  # 3 cores / 1 core per pod
     assert big.status.conditions["reason"] == "LimitedByBufferQuota"
+    assert big.status.replicas == 10            # spec-resolved value untouched
     assert len(c.pending_pods()) == 3
+    # quota relaxes -> the clamp relaxes WITHOUT a spec bump (non-sticky)
+    c.headroom_quota = {"cpu": 100.0}
+    assert c.active_with_replicas() == [(big, 10)]
+    assert "reason" not in big.status.conditions
+    assert len(c.pending_pods()) == 10
+
+
+def test_runonce_buffer_injection_drives_scale_up():
+    from test_runonce import autoscaler_for
+
+    from kubernetes_autoscaler_tpu.capacitybuffer.api import CapacityBuffer
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("seed", cpu_milli=4000,
+                                                  mem_mib=8192))
+    fake.add_capacity_buffer(CapacityBuffer(
+        name="headroom",
+        pod_template=build_test_pod("t", cpu_milli=1500, mem_mib=512),
+        replicas=6))
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    # 6 x 1500m headroom: seed holds 2, 4 need 2 new 4-CPU nodes
+    assert status.scale_up is not None and status.scale_up.increases == {"ng1": 2}
+
+
+def test_injection_flag_off_still_reconciles_statuses():
+    from test_runonce import autoscaler_for
+
+    from kubernetes_autoscaler_tpu.capacitybuffer.api import CapacityBuffer
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("seed", cpu_milli=4000,
+                                                  mem_mib=8192))
+    buf = CapacityBuffer(
+        name="headroom",
+        pod_template=build_test_pod("t", cpu_milli=1500, mem_mib=512),
+        replicas=6)
+    fake.add_capacity_buffer(buf)
+    a = autoscaler_for(fake, capacity_buffer_pod_injection_enabled=False)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is None          # no injection
+    assert buf.status.ready()               # but reconciliation still ran
+    assert buf.status.replicas == 6
