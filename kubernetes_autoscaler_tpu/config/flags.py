@@ -48,10 +48,13 @@ def _bool(text: str) -> bool:
 
 
 def build_parser() -> argparse.ArgumentParser:
+    from kubernetes_autoscaler_tpu.version import version_string
+
     p = argparse.ArgumentParser(
         prog="kubernetes-autoscaler-tpu",
         description="TPU-native cluster autoscaling framework",
     )
+    p.add_argument("--version", action="version", version=version_string())
     dur = parse_duration_s
 
     # loop (reference flags.go: --scan-interval)

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -126,6 +127,12 @@ class TensorClusterSnapshot:
         i = s.n_valid
         if i >= s.nodes.n:
             s.nodes = _grow_nodes(s.nodes)
+            if self.enc.planes is not None:
+                # constraint planes are [G, N]: keep the node axis in step
+                # (new columns are zero — fresh nodes carry no residents)
+                self.enc.planes = jax.tree_util.tree_map(
+                    lambda x: jnp.pad(x, ((0, 0), (0, x.shape[1]))),
+                    self.enc.planes)
         row = encode_node_row(node, self.enc.registry, self.enc.zone_table, self.enc.dims)
         nt = s.nodes
         s.nodes = nt.replace(

@@ -1,0 +1,96 @@
+"""Whole-loop RunOnce with topology constraints: spread pods drive
+zone-balanced scale-up through the ORCHESTRATOR (not just the kernels), and
+the host-check tier refuses constraints no template can satisfy.
+"""
+
+from kubernetes_autoscaler_tpu.models.api import (
+    AffinityTerm,
+    TopologySpreadConstraint,
+)
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+from test_runonce import autoscaler_for
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def test_runonce_zone_spread_scales_the_empty_zone():
+    fake = FakeCluster()
+    tmpl_a = build_test_node("tmpl-a", cpu_milli=4000, mem_mib=8192, zone="a")
+    tmpl_b = build_test_node("tmpl-b", cpu_milli=4000, mem_mib=8192, zone="b")
+    fake.add_node_group("ng-a", tmpl_a, min_size=1, max_size=10)
+    fake.add_node_group("ng-b", tmpl_b, min_size=1, max_size=10)
+    fake.add_existing_node("ng-a", build_test_node(
+        "a0", cpu_milli=4000, mem_mib=8192, zone="a"))
+    # zone b exists (an eligible domain with count 0) but is FULL — the only
+    # way to satisfy maxSkew=1 is new zone-b capacity
+    fake.add_existing_node("ng-b", build_test_node(
+        "b0", cpu_milli=150, mem_mib=8192, zone="b"))
+    # two spread replicas already sit in zone a
+    for i in range(2):
+        p = build_test_pod(f"r{i}", cpu_milli=200, mem_mib=64,
+                           labels={"app": "w"}, owner_name="w-rs",
+                           node_name="a0")
+        p.phase = "Running"
+        fake.add_pod(p)
+    # three more want to spread with maxSkew=1: zone b MUST host them
+    for i in range(3):
+        p = build_test_pod(f"p{i}", cpu_milli=200, mem_mib=64,
+                           labels={"app": "w"}, owner_name="w-rs")
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE, match_labels={"app": "w"})]
+        fake.add_pod(p)
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    assert list(status.scale_up.increases) == ["ng-b"], (
+        f"spread pods must scale zone b, got {status.scale_up.increases}")
+
+
+def test_runonce_zone_affinity_scales_matching_zone():
+    fake = FakeCluster()
+    tmpl_a = build_test_node("tmpl-a", cpu_milli=4000, mem_mib=8192, zone="a")
+    tmpl_b = build_test_node("tmpl-b", cpu_milli=4000, mem_mib=8192, zone="b")
+    fake.add_node_group("ng-a", tmpl_a, min_size=1, max_size=10)
+    fake.add_node_group("ng-b", tmpl_b, min_size=1, max_size=10)
+    fake.add_existing_node("ng-a", build_test_node(
+        "a0", cpu_milli=1000, mem_mib=8192, zone="a"))
+    fake.add_existing_node("ng-b", build_test_node(
+        "b0", cpu_milli=1000, mem_mib=8192, zone="b"))
+    db = build_test_pod("db", cpu_milli=800, mem_mib=64, labels={"app": "db"},
+                        owner_name="db-rs", node_name="b0")
+    db.phase = "Running"
+    fake.add_pod(db)
+    for i in range(4):
+        p = build_test_pod(f"w{i}", cpu_milli=800, mem_mib=64,
+                           labels={"app": "w"}, owner_name="w-rs")
+        p.pod_affinity = [AffinityTerm(match_labels={"app": "db"},
+                                       topology_key=ZONE)]
+        fake.add_pod(p)
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is not None and status.scale_up.scaled_up
+    assert list(status.scale_up.increases) == ["ng-b"], (
+        f"affinity pods must follow the db zone, got {status.scale_up.increases}")
+
+
+def test_runonce_unsatisfiable_topology_never_scales():
+    # exotic topology key -> host-check tier; the exact oracle refutes every
+    # template, so NO scale-up happens (the round-2 Weak #2 failure mode was
+    # packing these as schedulable-anywhere)
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node(
+        "n0", cpu_milli=100, mem_mib=128))
+    for i in range(3):
+        p = build_test_pod(f"p{i}", cpu_milli=500, mem_mib=64,
+                           labels={"app": "w"}, owner_name="w-rs")
+        p.pod_affinity = [AffinityTerm(match_labels={"app": "never-exists"},
+                                       topology_key="rack.example.com/id")]
+        fake.add_pod(p)
+    a = autoscaler_for(fake)
+    status = a.run_once(now=1000.0)
+    assert status.scale_up is None or not status.scale_up.scaled_up
+    assert len(fake.nodes) == 1
