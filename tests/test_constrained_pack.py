@@ -215,3 +215,43 @@ def test_randomized_topology_pack_matches_oracle():
         np.testing.assert_array_equal(
             placed[:, : len(nodes)][: want.shape[0]], want,
             err_msg=f"trial {trial}")
+
+
+def test_randomized_mixed_constraints_match_oracle():
+    """Spread AND affinity/anti on the SAME pod — the coupling interactions."""
+    rng = random.Random(42)
+    for trial in range(5):
+        zones = ["a", "b", "c"][: rng.randint(2, 3)]
+        nodes = [
+            build_test_node(f"n{i}", cpu_milli=rng.choice([1000, 2000]),
+                            mem_mib=4096, zone=rng.choice(zones))
+            for i in range(rng.randint(3, 6))
+        ]
+        pods = []
+        for i in range(rng.randint(0, 3)):
+            q = build_test_pod(f"r{i}", cpu_milli=100, mem_mib=32,
+                               labels={"app": "db"},
+                               node_name=rng.choice(nodes).name)
+            q.phase = "Running"
+            pods.append(q)
+        n_pods = rng.randint(2, 5)
+        for i in range(n_pods):
+            p = build_test_pod(f"m{i}", cpu_milli=100, mem_mib=32,
+                               labels={"app": "m"}, owner_name="m-rs")
+            p.topology_spread = [TopologySpreadConstraint(
+                max_skew=1, topology_key=ZONE, match_labels={"app": "m"})]
+            if rng.random() < 0.5:
+                p.pod_affinity = [AffinityTerm(match_labels={"app": "db"},
+                                               topology_key=ZONE)]
+            else:
+                p.anti_affinity = [AffinityTerm(match_labels={"app": "db"},
+                                                topology_key=ZONE)]
+            pods.append(p)
+        enc, placed, order = _pack(nodes, pods)
+        flagged = np.asarray(enc.specs.needs_host_check)
+        if flagged[np.asarray(enc.specs.count) > 0].any():
+            continue
+        want = _serial_greedy(enc, nodes, order)
+        np.testing.assert_array_equal(
+            placed[:, : len(nodes)][: want.shape[0]], want,
+            err_msg=f"mixed trial {trial}")
