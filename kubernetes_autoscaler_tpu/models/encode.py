@@ -194,12 +194,28 @@ def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
     terms: list[list[int]] = [[fold32(f"{k}={v}")] for k, v in sorted(pod.node_selector.items())]
     negs: list[int] = []
     # NodeAffinity is OR-of-AND (nodeSelectorTerms); the dense AND-of-OR shape
-    # carries a single term exactly. Multi-term OR is dropped from the dense
-    # mask (over-admits — never silently blocks) and flagged host-check; the
-    # oracle (utils/oracle.selector_matches) is the exact truth there.
+    # carries a single term exactly. Multi-term OR lowers exactly in the
+    # common shape where every term is ONE positive requirement — that IS a
+    # single OR row (alternatives across keys). Anything wider is dropped
+    # from the dense mask (over-admits — never silently blocks) and flagged
+    # host-check; the oracle (utils/oracle.selector_matches) is exact there.
     affinity_terms = pod.affinity_node_terms()
     if len(affinity_terms) > 1:
-        lossy = True
+        flat_alts: list[int] | None = []
+        for term in affinity_terms:
+            if (len(term) == 1 and term[0].operator in ("In", "Exists")
+                    and flat_alts is not None):
+                r0 = term[0]
+                if r0.operator == "In":
+                    flat_alts.extend(fold32(f"{r0.key}={v}") for v in r0.values)
+                else:
+                    flat_alts.append(fold32(r0.key + _KEY_MARK))
+            else:
+                flat_alts = None
+        if flat_alts is not None and len(flat_alts) <= dims.max_sel_alts:
+            terms.append(flat_alts)
+        else:
+            lossy = True
         affinity_terms = []
     for r in (affinity_terms[0] if affinity_terms else []):
         if r.operator == "In":

@@ -205,3 +205,46 @@ def test_anti_affinity_on_new_node_hostname_ok():
         match_labels={"app": "web"}, topology_key="topology.kubernetes.io/zone")]
     assert not oracle.check_pod_on_new_node(
         incoming, tmpl_z, nodes_z, oracle.group_pods_by_node([w1z]))
+
+
+def test_single_requirement_or_terms_lower_densely():
+    """(disk=ssd) OR (size=big) — one OR row, exact on device, NOT lossy."""
+    import numpy as np
+
+    from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+    from kubernetes_autoscaler_tpu.ops.predicates import feasibility_mask
+
+    nodes = [build_test_node("n0", labels={"disk": "ssd"}),
+             build_test_node("n1", labels={"size": "big"}),
+             build_test_node("n2")]
+    p = build_test_pod("p", cpu_milli=10, mem_mib=10, owner_name="rs")
+    p.node_affinity_terms = [
+        [NodeSelectorRequirement(key="disk", operator="In", values=("ssd",))],
+        [NodeSelectorRequirement(key="size", operator="Exists")],
+    ]
+    enc = encode_cluster(nodes, [p])
+    g = next(i for i, idxs in enumerate(enc.group_pods) if idxs)
+    assert not bool(np.asarray(enc.specs.needs_host_check)[g]), (
+        "single-requirement OR terms must lower exactly, not via host-check")
+    mask = np.asarray(feasibility_mask(enc.nodes, enc.specs))
+    assert list(mask[g, :3]) == [True, True, False]
+    # and the dense verdict agrees with the oracle on every node
+    for i, nd in enumerate(nodes):
+        assert mask[g, i] == oracle.check_pod_in_cluster(p, nd, nodes, {})
+
+
+def test_multi_requirement_or_terms_stay_host_checked():
+    import numpy as np
+
+    from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+
+    nodes = [build_test_node("n0", labels={"disk": "ssd", "size": "big"})]
+    p = build_test_pod("p", cpu_milli=10, mem_mib=10, owner_name="rs")
+    p.node_affinity_terms = [
+        [NodeSelectorRequirement(key="disk", operator="In", values=("ssd",)),
+         NodeSelectorRequirement(key="size", operator="In", values=("big",))],
+        [NodeSelectorRequirement(key="pool", operator="In", values=("x",))],
+    ]
+    enc = encode_cluster(nodes, [p])
+    g = next(i for i, idxs in enumerate(enc.group_pods) if idxs)
+    assert bool(np.asarray(enc.specs.needs_host_check)[g])
