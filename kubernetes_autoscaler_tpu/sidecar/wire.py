@@ -100,12 +100,16 @@ class DeltaWriter:
             and all(pod.labels.get(k) == v for k, v in term.match_labels.items())
             for term in pod.anti_affinity
         )
-        # lossy mirrors _encode_pod_spec: shapes the dense wire can't express
+        # lossy mirrors _encode_pod_spec: shapes the dense wire can't express.
+        # Uses the ACCESSORS so both the legacy sugar fields and the full
+        # list forms (topology_spread, node_affinity_terms, resource_claims)
+        # route to the host-check tier rather than silently dropping.
         lossy = bool(
             req_lossy
-            or pod.required_node_affinity
+            or pod.affinity_node_terms()
             or pod.pod_affinity
-            or pod.topology_spread_max_skew
+            or pod.spread_constraints()
+            or pod.resource_claims
             or any(not (t.topology_key == "kubernetes.io/hostname"
                         and t.match_labels
                         and all(pod.labels.get(k) == v
