@@ -1,0 +1,147 @@
+"""Behavioral-force tests for flags added in the parity sweep: each test
+proves its flag CHANGES a decision (the round-1/2 review's complaint was
+accepted-and-ignored flags; these tests make that class unrepresentable).
+"""
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.config.options import NodeGroupDefaults
+from kubernetes_autoscaler_tpu.metrics.metrics import HealthCheck
+from kubernetes_autoscaler_tpu.models.api import TO_BE_DELETED_TAINT
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+from test_runonce import autoscaler_for
+
+IDLE_DEFAULTS = NodeGroupDefaults(scale_down_unneeded_time_s=0.0,
+                                  scale_down_unready_time_s=0.0)
+
+
+def _idle_world(n=2):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    for i in range(n):
+        fake.add_existing_node("ng1", build_test_node(
+            f"idle-{i}", cpu_milli=4000, mem_mib=8192))
+    return fake
+
+
+def test_enforce_node_group_min_size_flag():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=3, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("n0", cpu_milli=4000,
+                                                  mem_mib=8192))
+    a = autoscaler_for(fake)                     # flag default: off (reference)
+    a.run_once(now=1000.0)
+    assert len(fake.nodes) == 1
+    b = autoscaler_for(fake, enforce_node_group_min_size=True)
+    b.run_once(now=2000.0)
+    assert len(fake.nodes) == 3                  # scaled to min size
+
+
+def test_scale_down_unready_enabled_flag():
+    fake = _idle_world(1)
+    fake.nodes["idle-0"].ready = False
+    a = autoscaler_for(fake, scale_down_unready_enabled=False,
+                       node_group_defaults=IDLE_DEFAULTS)
+    st = a.run_once(now=1000.0)
+    assert not st.scale_down_deleted
+    assert a.planner.unremovable.reason("idle-0") == "ScaleDownUnreadyDisabled"
+    b = autoscaler_for(fake, scale_down_unready_enabled=True,
+                       node_group_defaults=IDLE_DEFAULTS)
+    st = b.run_once(now=2000.0)
+    assert st.scale_down_deleted
+
+
+def test_max_bulk_soft_taint_count_bounds_per_loop():
+    from kubernetes_autoscaler_tpu.models.api import DELETION_CANDIDATE_TAINT
+
+    fake = _idle_world(4)
+    a = autoscaler_for(fake, max_bulk_soft_taint_count=2,
+                       node_group_defaults=NodeGroupDefaults(
+                           scale_down_unneeded_time_s=600.0,
+                           scale_down_unready_time_s=600.0))
+    a.run_once(now=1000.0)
+    tainted = sum(1 for nd in fake.nodes.values()
+                  if any(t.key == DELETION_CANDIDATE_TAINT for t in nd.taints))
+    assert tainted == 2                          # budget caps this loop
+    a.run_once(now=1010.0)
+    tainted = sum(1 for nd in fake.nodes.values()
+                  if any(t.key == DELETION_CANDIDATE_TAINT for t in nd.taints))
+    assert tainted == 4                          # the rest catch up next loop
+
+
+def test_cordon_before_terminating_and_rollback():
+    from kubernetes_autoscaler_tpu.cloudprovider.provider import NodeGroupError
+
+    fake = _idle_world(1)
+    g = next(iter(fake.provider.node_groups()))
+    orig = g.delete_nodes
+    g.delete_nodes = lambda nodes: (_ for _ in ()).throw(NodeGroupError("cloud down"))
+    a = autoscaler_for(fake, cordon_node_before_terminating=True,
+                       node_group_defaults=IDLE_DEFAULTS)
+    st = a.run_once(now=1000.0)
+    nd = fake.nodes["idle-0"]
+    # deletion failed: cordon AND hard taint must both be rolled back
+    assert not st.scale_down_deleted
+    assert not nd.unschedulable
+    assert all(t.key != TO_BE_DELETED_TAINT for t in nd.taints)
+    g.delete_nodes = orig
+    st = a.run_once(now=2000.0)
+    assert st.scale_down_deleted
+
+
+def test_daemonset_eviction_flags():
+    def world():
+        fake = _idle_world(2)
+        ds = build_test_pod("ds-0", cpu_milli=50, mem_mib=32,
+                            owner_kind="DaemonSet", owner_name="logger",
+                            node_name="idle-0")
+        ds.phase = "Running"
+        fake.add_pod(ds)
+        return fake
+
+    fake = world()
+    a = autoscaler_for(fake, daemonset_eviction_for_empty_nodes=False,
+                       node_group_defaults=IDLE_DEFAULTS)
+    a.run_once(now=1000.0)
+    assert "ds-0" not in fake.evicted
+    fake = world()
+    b = autoscaler_for(fake, daemonset_eviction_for_empty_nodes=True,
+                       node_group_defaults=IDLE_DEFAULTS)
+    b.run_once(now=1000.0)
+    assert "ds-0" in fake.evicted
+
+
+def test_liveness_budgets():
+    h = HealthCheck(max_inactivity_s=60, max_failing_time_s=120,
+                    max_startup_time_s=30, started=1000.0)
+    # startup budget: healthy until it expires without a first success
+    assert h.healthy(now=1020.0)
+    assert not h.healthy(now=1031.0)
+    h.mark_active(now=1040.0)
+    assert h.healthy(now=1090.0)
+    assert not h.healthy(now=1101.0)             # inactivity
+    # failing clock: failures keep activity fresh but success stays stale
+    h.mark_active(now=1200.0)
+    for t in (1230.0, 1260.0, 1290.0, 1320.0, 1330.0):
+        h.mark_failed(now=t)
+    assert not h.healthy(now=1330.0)             # failing > 120s since success
+
+
+def test_quota_flags_cap_scale_up():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("seed", cpu_milli=4000,
+                                                  mem_mib=8192))
+    for i in range(8):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1800, mem_mib=256,
+                                    owner_name="rs"))
+    # --cores-total max 12: seed uses 4 cores, so only 2 more 4-core nodes fit
+    a = autoscaler_for(fake, max_cores_total=12)
+    st = a.run_once(now=1000.0)
+    assert st.scale_up is not None
+    assert st.scale_up.increases == {"ng1": 2}
