@@ -39,8 +39,9 @@ def cluster_from_scenario(path: str) -> FakeCluster:
         doc = json.load(f)
     fake = FakeCluster()
     for g in doc.get("node_groups", []):
-        t = g.get("template", {})
-        tmpl = build_test_node(f"template-{g['id']}", **t)
+        t = dict(g.get("template", {}))
+        name = t.pop("name", f"template-{g['id']}")
+        tmpl = build_test_node(name, **t)
         fake.add_node_group(g["id"], tmpl, min_size=g.get("min", 0),
                             max_size=g.get("max", 10))
     for n in doc.get("nodes", []):
