@@ -246,7 +246,17 @@ def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
         effects = [t.effect] if t.effect else [NO_SCHEDULE, NO_EXECUTE]
         if t.operator == "Exists":
             if not t.key:
-                tolerate_all = True
+                # empty key = any taint key. With no effect it is the true
+                # tolerate-everything flag. Scoped to NoSchedule/NoExecute the
+                # dense encoding cannot express "any key of effect e" (taint
+                # hashes are key-scoped) → over-admit + host-check (oracle is
+                # exact). Scoped to PreferNoSchedule it covers no filterable
+                # taint at all → ignore. Found by tests/test_predicate_fuzz.py.
+                if not t.effect:
+                    tolerate_all = True
+                elif t.effect in (NO_SCHEDULE, NO_EXECUTE):
+                    tolerate_all = True
+                    lossy = True
                 continue
             for e in effects:
                 ky.append(fold32(f"{t.key}\0{e}"))

@@ -1,0 +1,88 @@
+"""Randomized encode→kernel vs oracle parity fuzz for the PLAIN predicate
+plane (resources, selectors, single-term affinity, taints/tolerations,
+hostPorts, readiness) — broad coverage beyond test_predicates.py's
+hand-written cases. Every non-lossy (group, node) verdict must equal the
+serial oracle's.
+"""
+
+import random
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.api import (
+    NodeSelectorRequirement,
+    Taint,
+    Toleration,
+)
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.ops.predicates import feasibility_mask
+from kubernetes_autoscaler_tpu.utils import oracle
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+KEYS = ["disk", "pool", "zone-ish", "arch"]
+VALS = ["a", "b", "c"]
+EFFECTS = ["NoSchedule", "NoExecute", "PreferNoSchedule"]
+
+
+def _rand_node(rng, i):
+    labels = {k: rng.choice(VALS) for k in KEYS if rng.random() < 0.5}
+    taints = []
+    for _ in range(rng.randint(0, 2)):
+        taints.append(Taint(rng.choice(KEYS), rng.choice(VALS + [""]),
+                            rng.choice(EFFECTS)))
+    return build_test_node(
+        f"n{i}", cpu_milli=rng.choice([500, 1000, 4000]),
+        mem_mib=rng.choice([512, 4096]), labels=labels, taints=taints,
+        ready=rng.random() > 0.15)
+
+
+def _rand_pod(rng, i):
+    sel = {k: rng.choice(VALS) for k in KEYS if rng.random() < 0.25}
+    tols = []
+    for _ in range(rng.randint(0, 2)):
+        op = rng.choice(["Equal", "Exists"])
+        tols.append(Toleration(
+            key=rng.choice(KEYS + [""]) if op == "Exists" else rng.choice(KEYS),
+            operator=op,
+            value=rng.choice(VALS + [""]) if op == "Equal" else "",
+            effect=rng.choice(EFFECTS + [""])))
+    p = build_test_pod(
+        f"p{i}", cpu_milli=rng.choice([100, 600, 2000]),
+        mem_mib=rng.choice([64, 1024]), node_selector=sel,
+        tolerations=tols, owner_name=f"rs{i}",
+        host_port=rng.choice([0, 0, 0, 8080]))
+    if rng.random() < 0.4:
+        op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist"])
+        vals = tuple(rng.sample(VALS, rng.randint(1, 2))) if op in ("In", "NotIn") else ()
+        p.required_node_affinity = [
+            NodeSelectorRequirement(key=rng.choice(KEYS), operator=op, values=vals)]
+    return p
+
+
+def test_fuzz_plain_predicates_match_oracle():
+    rng = random.Random(20260729)
+    for trial in range(8):
+        nodes = [_rand_node(rng, i) for i in range(rng.randint(2, 7))]
+        pods = [_rand_pod(rng, i) for i in range(rng.randint(2, 8))]
+        # some residents occupy ports/resources
+        for i in range(rng.randint(0, 3)):
+            q = build_test_pod(f"r{i}", cpu_milli=300, mem_mib=128,
+                               node_name=rng.choice(nodes).name,
+                               host_port=rng.choice([0, 8080]))
+            q.phase = "Running"
+            q.tolerations = [Toleration(key="", operator="Exists")]
+            pods.append(q)
+        enc = encode_cluster(nodes, pods)
+        mask = np.asarray(feasibility_mask(enc.nodes, enc.specs))
+        lossy = np.asarray(enc.specs.needs_host_check)
+        all_nodes, by_node = enc.all_nodes_and_pods()
+        for g, idxs in enumerate(enc.group_pods):
+            if not idxs or lossy[g]:
+                continue
+            pod = enc.pending_pods[idxs[0]]
+            for ni, nd in enumerate(nodes):
+                want = oracle.check_pod_in_cluster(pod, nd, all_nodes, by_node)
+                got = bool(mask[g, ni])
+                assert got == want, (
+                    f"trial {trial} pod {pod.name} node {nd.name}: "
+                    f"kernel={got} oracle={want}\npod={pod}\nnode={nd}")
