@@ -1,0 +1,94 @@
+"""Randomized drain-verdict fuzz: simulate_removals' per-candidate verdicts
+vs a serial oracle greedy that re-places the candidate's pods one at a time
+(the reference's findPlaceFor semantics, simulator/cluster.go:190-228).
+"""
+
+import copy
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_autoscaler_tpu.models.api import Taint, Toleration
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.ops.drain import simulate_removals
+from kubernetes_autoscaler_tpu.utils import oracle
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def _serial_drain_ok(enc, nodes, cand_i):
+    """All-or-nothing: can every movable pod on candidate re-place, pods of a
+    group placed consecutively in the kernel's first-seen order?"""
+    victims = [(j, p) for j, p in enumerate(enc.scheduled_pods)
+               if p.node_name == nodes[cand_i].name]
+    group_ref = np.asarray(enc.scheduled.group_ref)
+    seen, order = set(), []
+    for j, _ in victims:
+        g = int(group_ref[j])
+        if g not in seen:
+            seen.add(g)
+            order.append(g)
+    by_node = {}
+    for p in enc.scheduled_pods:
+        by_node.setdefault(p.node_name, []).append(p)
+    # unschedule the victims
+    by_node[nodes[cand_i].name] = []
+    world = [nd for i, nd in enumerate(nodes) if i != cand_i]
+    for g in order:
+        for j, p in victims:
+            if int(group_ref[j]) != g:
+                continue
+            placed = False
+            for ni, nd in enumerate(nodes):
+                if ni == cand_i:
+                    continue
+                if oracle.check_pod_in_cluster(p, nd, world, by_node):
+                    clone = copy.deepcopy(p)
+                    clone.node_name = nd.name
+                    by_node.setdefault(nd.name, []).append(clone)
+                    placed = True
+                    break
+            if not placed:
+                return False
+    return True
+
+
+def test_fuzz_drain_verdicts_match_oracle():
+    rng = random.Random(777)
+    for trial in range(6):
+        n_nodes = rng.randint(3, 6)
+        nodes = [build_test_node(
+            f"n{i}", cpu_milli=rng.choice([1000, 2000, 4000]),
+            mem_mib=4096,
+            taints=[Taint("ded", "x", "NoSchedule")] if rng.random() < 0.2 else [])
+            for i in range(n_nodes)]
+        pods = []
+        for i in range(rng.randint(2, 10)):
+            p = build_test_pod(
+                f"p{i}", cpu_milli=rng.choice([300, 700, 1500]),
+                mem_mib=rng.choice([128, 512]),
+                owner_name=f"rs{rng.randint(0, 3)}",
+                node_name=rng.choice(nodes).name,
+                tolerations=[Toleration(key="ded", operator="Exists")]
+                if rng.random() < 0.4 else [])
+            p.phase = "Running"
+            pods.append(p)
+        enc = encode_cluster(nodes, pods)
+        enc.scheduled = enc.scheduled.replace(
+            movable=enc.scheduled.valid,
+            blocks=jnp.zeros((enc.scheduled.p,), bool))
+        lossy = np.asarray(enc.specs.needs_host_check)
+        if lossy[np.unique(np.asarray(enc.scheduled.group_ref)[
+                np.asarray(enc.scheduled.valid)])].any():
+            continue
+        res = simulate_removals(
+            enc.nodes, enc.specs, enc.scheduled,
+            jnp.arange(n_nodes, dtype=jnp.int32),
+            jnp.ones((enc.nodes.n,), bool),
+            max_pods_per_node=16, chunk=8)
+        got = np.asarray(res.drainable)[:n_nodes]
+        for c in range(n_nodes):
+            want = _serial_drain_ok(enc, nodes, c)
+            assert bool(got[c]) == want, (
+                f"trial {trial} candidate {nodes[c].name}: "
+                f"kernel={bool(got[c])} oracle={want}")
