@@ -1,0 +1,66 @@
+"""Loop driver + leader election behaviors (reference: loop/trigger.go
+event-driven wakeups, main.go leaderelection.RunOrDie active/passive HA).
+"""
+
+import threading
+import time
+
+from kubernetes_autoscaler_tpu.core.loop import LoopTrigger, run_loop
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.leaderelection import FileLeaderElector
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+from test_runonce import autoscaler_for
+
+
+def test_trigger_poke_wakes_immediately():
+    t = LoopTrigger(scan_interval_s=30.0)
+    t.poke()
+    t0 = time.monotonic()
+    t.wait(last_productive=False)
+    assert time.monotonic() - t0 < 1.0, "poked trigger must not wait the tick"
+
+
+def test_trigger_immediate_rerun_after_productive():
+    t = LoopTrigger(scan_interval_s=30.0)
+    t0 = time.monotonic()
+    t.wait(last_productive=True)
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_run_loop_reruns_productive_loops():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=4)
+    fake.add_existing_node("ng1", build_test_node("seed", cpu_milli=4000,
+                                                  mem_mib=8192))
+    for i in range(8):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1800, mem_mib=128,
+                                    owner_name="rs"))
+    a = autoscaler_for(fake)
+    trigger = LoopTrigger(scan_interval_s=0.05)
+    history = run_loop(a, trigger, max_iterations=3)
+    assert len(history) == 3
+    assert history[0].scale_up is not None and history[0].scale_up.scaled_up
+    # capacity satisfied after the first productive loop; later loops no-op
+    assert history[-1].pending_pods == 0
+
+
+def test_leader_election_exclusive_and_failover(tmp_path):
+    lease = str(tmp_path / "lease.lock")
+    a = FileLeaderElector(lease, retry_period_s=0.05)
+    b = FileLeaderElector(lease, retry_period_s=0.05)
+    assert a.try_acquire()
+    assert a.is_leader()
+    assert not b.try_acquire(), "second elector must stay standby"
+    ran = []
+    stop = threading.Event()
+    th = threading.Thread(
+        target=lambda: b.run_or_die(lambda: ran.append("b-ran"), stop=stop),
+        daemon=True)
+    th.start()
+    time.sleep(0.15)
+    assert not ran, "standby must not run while the leader holds the lease"
+    a.release()
+    th.join(timeout=5.0)
+    assert ran == ["b-ran"], "standby must take over after release"
