@@ -412,6 +412,26 @@ int ka_apply_delta(void* handle, const uint8_t* buf, uint64_t len) {
 }
 
 uint64_t ka_version(void* handle) { return static_cast<State*>(handle)->version; }
+
+// Group row -> its equivalence key (for the python-side constraint
+// side-channel to map aux pod records onto exported rows). Returns the key
+// length, or -1 when out of range; truncates to cap.
+int ka_group_key(void* handle, int row, char* buf, int cap) {
+  State* st = static_cast<State*>(handle);
+  if (row < 0 || row >= static_cast<int>(st->groups.size())) return -1;
+  const std::string& k = st->groups[row].eqkey;
+  int n = static_cast<int>(k.size());
+  int c = n < cap ? n : cap;
+  std::memcpy(buf, k.data(), c);
+  return n;
+}
+
+// Node name -> row index (-1 when absent).
+int ka_node_row(void* handle, const char* name) {
+  State* st = static_cast<State*>(handle);
+  auto it = st->node_index.find(name);
+  return it == st->node_index.end() ? -1 : it->second;
+}
 int ka_num_nodes(void* handle) {
   return static_cast<int>(static_cast<State*>(handle)->nodes.size());
 }

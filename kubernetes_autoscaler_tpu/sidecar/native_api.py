@@ -48,6 +48,11 @@ def load() -> ctypes.CDLL:
     lib.ka_export_nodes.restype = ctypes.c_int
     lib.ka_export_groups.restype = ctypes.c_int
     lib.ka_export_pods.restype = ctypes.c_int
+    lib.ka_group_key.restype = ctypes.c_int
+    lib.ka_group_key.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                 ctypes.c_char_p, ctypes.c_int]
+    lib.ka_node_row.restype = ctypes.c_int
+    lib.ka_node_row.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.ka_fold32_batch.argtypes = [
         ctypes.c_char_p,
         np.ctypeslib.ndpointer(np.int64), ctypes.c_int,
@@ -116,6 +121,18 @@ class NativeSnapshotState:
         return (self.lib.ka_num_nodes(self.handle),
                 self.lib.ka_num_pods(self.handle),
                 self.lib.ka_num_groups(self.handle))
+
+    def group_key(self, row: int) -> str:
+        """Equivalence key of a group row ('' when out of range) — the join
+        key for the KAUX constraint side-channel (sidecar/constraints.py)."""
+        buf = ctypes.create_string_buffer(256)
+        n = self.lib.ka_group_key(self.handle, row, buf, 256)
+        if n < 0:
+            return ""
+        return buf.raw[: min(n, 256)].decode()
+
+    def node_row(self, name: str) -> int:
+        return int(self.lib.ka_node_row(self.handle, name.encode()))
 
     def export(self, node_bucket: int = 64, group_bucket: int = 64,
                pod_bucket: int = 256):

@@ -46,16 +46,39 @@ class SimulatorService:
         self._lock = threading.Lock()
         self._group_tensors = None
         self._zone_seed: dict[str, int] = {}
+        # KAUX constraint side-channel store (uid -> wire record)
+        self._aux: dict[str, dict] = {}
 
     # ---- rpc: ApplyDelta ----
 
     def apply_delta(self, payload: bytes) -> dict:
+        from kubernetes_autoscaler_tpu.sidecar.wire import split_aux
+
+        dense, aux = split_aux(payload)
         with self._lock:
             try:
-                self.state.apply_delta(payload)
+                self.state.apply_delta(dense)
+                if aux is not None:
+                    self._aux.update(aux.get("up", {}))
+                    for uid in aux.get("del", []):
+                        self._aux.pop(uid, None)
                 return {"version": self.state.version, "error": ""}
             except ValueError as e:
                 return {"version": self.state.version, "error": str(e)}
+
+    def _tensors_with_constraints(self):
+        """Exported tensors + the constraint overlay (side-channel specs +
+        resident planes) — what encode_cluster produces natively."""
+        from kubernetes_autoscaler_tpu.sidecar.constraints import (
+            attach_constraints,
+        )
+
+        nt, gt, pt = self.state.to_tensors(self.node_bucket, self.group_bucket)
+        planes, has_c = None, False
+        if self._aux:
+            gt, planes, has_c = attach_constraints(
+                self.state, gt, nt.n, self._aux)
+        return nt, gt, pt, planes, has_c
 
     # ---- rpc: ScaleUpSim ----
 
@@ -71,7 +94,7 @@ class SimulatorService:
         from kubernetes_autoscaler_tpu.ops.autoscale_step import scale_up_sim
 
         with self._lock:
-            nt, gt, pt = self.state.to_tensors(self.node_bucket, self.group_bucket)
+            nt, gt, pt, planes, has_c = self._tensors_with_constraints()
         templates = []
         ids = []
         for g in params.node_groups or []:
@@ -89,7 +112,8 @@ class SimulatorService:
             templates, ExtendedResourceRegistry(), ZoneTable(), self.dims
         )
         out = scale_up_sim(nt, gt, pt, groups, self.dims,
-                           params.max_new_nodes, params.strategy)
+                           params.max_new_nodes, params.strategy,
+                           planes=planes, with_constraints=has_c)
         best = int(out.best)
         return {
             "best": ids[best] if 0 <= best < len(ids) else "",
@@ -114,8 +138,10 @@ class SimulatorService:
         from kubernetes_autoscaler_tpu.ops.autoscale_step import scale_down_sim
 
         with self._lock:
-            nt, gt, pt = self.state.to_tensors(self.node_bucket, self.group_bucket)
-        out = scale_down_sim(nt, gt, pt, params.threshold)
+            nt, gt, pt, planes, has_c = self._tensors_with_constraints()
+        out = scale_down_sim(nt, gt, pt, params.threshold,
+                             planes=planes, max_zones=self.dims.max_zones,
+                             with_constraints=has_c)
         valid = np.asarray(nt.valid)
         return {
             "eligible": np.nonzero(np.asarray(out.eligible) & valid)[0].tolist(),

@@ -37,11 +37,22 @@ def _s(out: bytearray, s: str) -> None:
     out += b
 
 
+AUX_MAGIC = b"KAUX"
+
+
 class DeltaWriter:
     def __init__(self, registry: res.ExtendedResourceRegistry | None = None):
         self.registry = registry or res.ExtendedResourceRegistry()
         self._body = bytearray()
         self._count = 0
+        # constraint side-channel (v1.1): topology-coupled pod specs the dense
+        # KAD1 rows cannot carry ride a trailer the C++ codec skips (it reads
+        # exactly `count` ops) and the PYTHON sidecar consumes — so sidecar-fed
+        # clusters get the device constrained tier instead of blanket
+        # host-checking. Labels ship for every labeled pod: they are the
+        # TARGETS of other pods' selectors (plane counting).
+        self._aux_upserts: dict[str, dict] = {}
+        self._aux_deletes: list[str] = []
 
     def upsert_node(self, node: Node, group_id: int = -1) -> "DeltaWriter":
         b = self._body
@@ -118,15 +129,63 @@ class DeltaWriter:
         )
         b.append((1 if movable else 0) | (2 if blocks else 0)
                  | (4 if anti_self else 0) | (8 if lossy else 0))
-        _s(b, str(equivalence_key(pod)))
+        eqkey = str(equivalence_key(pod))
+        _s(b, eqkey)
+        self._maybe_aux(pod, eqkey)
         self._count += 1
         return self
+
+    def _maybe_aux(self, pod: Pod, eqkey: str) -> None:
+        has_topology = bool(pod.pod_affinity or pod.anti_affinity
+                            or pod.spread_constraints())
+        if not (has_topology or pod.labels):
+            return
+        rec: dict = {
+            "k": eqkey, "ns": pod.namespace, "l": dict(pod.labels),
+            "n": pod.node_name,
+        }
+        cons = pod.spread_constraints()
+        if cons:
+            c = cons[0]
+            rec["s"] = {"key": c.topology_key, "w": int(c.max_skew),
+                        "sel": dict(c.match_labels), "extra": len(cons) > 1}
+        if pod.pod_affinity:
+            t = pod.pod_affinity[0]
+            rec["a"] = {"key": t.topology_key, "sel": dict(t.match_labels),
+                        "nss": list(t.namespaces),
+                        "extra": len(pod.pod_affinity) > 1}
+        if pod.anti_affinity:
+            rec["x"] = [{"key": t.topology_key, "sel": dict(t.match_labels),
+                         "nss": list(t.namespaces)} for t in pod.anti_affinity]
+        self._aux_upserts[pod.uid or f"{pod.namespace}/{pod.name}"] = rec
 
     def delete_pod(self, uid: str) -> "DeltaWriter":
         self._body.append(DELETE_POD)
         _s(self._body, uid)
+        self._aux_deletes.append(uid)
         self._count += 1
         return self
 
     def payload(self) -> bytes:
-        return MAGIC + struct.pack("<I", self._count) + bytes(self._body)
+        import json
+
+        out = MAGIC + struct.pack("<I", self._count) + bytes(self._body)
+        if self._aux_upserts or self._aux_deletes:
+            doc = json.dumps({"up": self._aux_upserts,
+                              "del": self._aux_deletes}).encode()
+            # reverse-parsable trailer: [json][u32 len][KAUX]
+            out += doc + struct.pack("<I", len(doc)) + AUX_MAGIC
+        return out
+
+
+def split_aux(payload: bytes) -> tuple[bytes, dict | None]:
+    """(KAD1 bytes for the C++ codec, parsed aux doc or None)."""
+    import json
+
+    if len(payload) < 8 or payload[-4:] != AUX_MAGIC:
+        return payload, None
+    (n,) = struct.unpack("<I", payload[-8:-4])
+    if n > len(payload) - 8:
+        return payload, None
+    doc = json.loads(payload[-8 - n:-8])
+    return payload[: len(payload) - 8 - n], doc
