@@ -28,9 +28,11 @@ conservatively reported undrainable (n_failed counts the overflow pods).
 
 The final *selection* of nodes to delete must not double-book destination
 capacity across candidates; core/scaledown/planner.py re-simulates the
-accepted candidates sequentially on the host over the `feas` plane returned
-here, mirroring the reference's commit-on-success ordering
-(cluster.go:174-188).
+accepted candidates sequentially over the `feas` plane returned here —
+through the native C++ pass (sidecar/native/kaconfirm.cc) in the common
+case, or the Python group-block pass when PDBs/exact-oracle/atomic policy
+needs per-move host decisions — mirroring the reference's commit-on-success
+ordering (cluster.go:174-188).
 """
 
 from __future__ import annotations
