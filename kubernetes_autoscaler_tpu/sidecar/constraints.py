@@ -36,12 +36,17 @@ def _term_matches(sel: dict, namespaces: list[str], own_ns: str,
     return other_ns in nss and labels_match(sel, other_labels)
 
 
-def attach_constraints(state, specs, n_nodes: int, aux: dict[str, dict]):
+def attach_constraints(state, specs, n_nodes: int, aux: dict[str, dict],
+                       max_zones: int = 16):
     """(specs', planes, has_constraints) from the aux records.
 
     `state` is a NativeSnapshotState (needs group_key(row) and node_row(name));
     `specs` the exported PodGroupTensors; aux maps pod uid -> wire record.
     """
+    # zones_fit guard (mirrors encode_cluster): the codec's zone ids are
+    # unbounded; when they exceed the static Z dim the kernels would ALIAS
+    # distinct zones, so zone-kind constraints must fall back to host-check
+    zones_fit = state.num_zones() + 1 <= max_zones
     g_pad = specs.g
     row_of: dict[str, int] = {}
     for r in range(g_pad):
@@ -72,6 +77,8 @@ def attach_constraints(state, specs, n_nodes: int, aux: dict[str, dict]):
         s = rec.get("s")
         if s:
             k = _kind(s["key"])
+            if k == 2 and not zones_fit:
+                k = 0
             if k and not s.get("extra"):
                 spread_kind[row] = k
                 max_skew[row] = max(int(s["w"]), 1)
@@ -81,6 +88,8 @@ def attach_constraints(state, specs, n_nodes: int, aux: dict[str, dict]):
         a = rec.get("a")
         if a:
             k = _kind(a["key"])
+            if k == 2 and not zones_fit:
+                k = 0
             if k and not a.get("extra"):
                 aff_kind[row] = k
                 aff_self[row] = _term_matches(
@@ -89,6 +98,8 @@ def attach_constraints(state, specs, n_nodes: int, aux: dict[str, dict]):
                 exotic = True
         for t in rec.get("x", []):
             k = _kind(t["key"])
+            if k == 2 and not zones_fit:
+                k = 0
             if k == 0:
                 exotic = True
                 continue
@@ -102,6 +113,11 @@ def attach_constraints(state, specs, n_nodes: int, aux: dict[str, dict]):
             lossy[row] = True
         else:
             constrained = True
+            if rec.get("dok"):
+                # topology was the only reason the wire flagged lossy, and
+                # the overlay now models it — the device tier is exact here
+                # (cross-group coupling may re-flag below)
+                lossy[row] = False
 
     if not row_spec:
         return specs, None, False
@@ -119,7 +135,10 @@ def attach_constraints(state, specs, n_nodes: int, aux: dict[str, dict]):
         if a and aff_kind[row] and not aff_self[row]:
             sels.append((a["sel"], a.get("nss", []) or [rec["ns"]]))
         for other in pending:
-            if other is rec:
+            # siblings of the SAME equivalence group are the group's own
+            # placements — modeled on device (spread_self/anti caps), not a
+            # cross-group coupling (mirrors encode_cluster's hrow != grow)
+            if other is rec or other.get("k") == rec.get("k"):
                 continue
             if any(other["ns"] in nss and labels_match(sel, other["l"])
                    for sel, nss in sels):

@@ -432,6 +432,20 @@ int ka_node_row(void* handle, const char* name) {
   auto it = st->node_index.find(name);
   return it == st->node_index.end() ? -1 : it->second;
 }
+
+// Zone string -> the codec's interned id (-1 when the zone is unknown; 0 is
+// the reserved "no zone" id). Lets the python side encode TEMPLATES in the
+// same zone-id space as the exported node tensors.
+int ka_zone_id(void* handle, const char* zone) {
+  State* st = static_cast<State*>(handle);
+  if (zone == nullptr || *zone == '\0') return 0;
+  auto it = st->zone_ids.find(zone);
+  return it == st->zone_ids.end() ? -1 : it->second;
+}
+
+int ka_num_zones(void* handle) {
+  return static_cast<int>(static_cast<State*>(handle)->zone_ids.size());
+}
 int ka_num_nodes(void* handle) {
   return static_cast<int>(static_cast<State*>(handle)->nodes.size());
 }

@@ -53,6 +53,10 @@ def load() -> ctypes.CDLL:
                                  ctypes.c_char_p, ctypes.c_int]
     lib.ka_node_row.restype = ctypes.c_int
     lib.ka_node_row.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ka_zone_id.restype = ctypes.c_int
+    lib.ka_zone_id.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ka_num_zones.restype = ctypes.c_int
+    lib.ka_num_zones.argtypes = [ctypes.c_void_p]
     lib.ka_fold32_batch.argtypes = [
         ctypes.c_char_p,
         np.ctypeslib.ndpointer(np.int64), ctypes.c_int,
@@ -133,6 +137,33 @@ class NativeSnapshotState:
 
     def node_row(self, name: str) -> int:
         return int(self.lib.ka_node_row(self.handle, name.encode()))
+
+    def zone_id(self, zone: str) -> int:
+        """Codec-interned id for a zone string (-1 = unknown, 0 = none)."""
+        return int(self.lib.ka_zone_id(self.handle, zone.encode()))
+
+    def num_zones(self) -> int:
+        return int(self.lib.ka_num_zones(self.handle))
+
+    def zone_table_for_templates(self, zones: list[str]):
+        """A ZoneTable aligned with the codec's zone-id space: known zones
+        reuse the codec's ids; unknown template zones get fresh ids beyond
+        them (review finding: a fresh ZoneTable would intern template zones
+        in a DIFFERENT id space than the exported node tensors)."""
+        from kubernetes_autoscaler_tpu.models.encode import ZoneTable
+
+        ids: dict[str, int] = {}
+        next_id = self.num_zones() + 1
+        for z in zones:
+            if not z or z in ids:
+                continue
+            known = self.zone_id(z)
+            if known > 0:
+                ids[z] = known
+            else:
+                ids[z] = next_id
+                next_id += 1
+        return ZoneTable(ids=ids)
 
     def export(self, node_bucket: int = 64, group_bucket: int = 64,
                pod_bucket: int = 256):

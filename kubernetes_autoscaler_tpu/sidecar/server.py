@@ -54,16 +54,18 @@ class SimulatorService:
     def apply_delta(self, payload: bytes) -> dict:
         from kubernetes_autoscaler_tpu.sidecar.wire import split_aux
 
-        dense, aux = split_aux(payload)
         with self._lock:
             try:
+                # split INSIDE the guarded region: any malformed trailer must
+                # surface as an error dict, never an uncaught exception
+                dense, aux = split_aux(payload)
                 self.state.apply_delta(dense)
                 if aux is not None:
                     self._aux.update(aux.get("up", {}))
                     for uid in aux.get("del", []):
                         self._aux.pop(uid, None)
                 return {"version": self.state.version, "error": ""}
-            except ValueError as e:
+            except (ValueError, TypeError) as e:
                 return {"version": self.state.version, "error": str(e)}
 
     def _tensors_with_constraints(self):
@@ -77,7 +79,8 @@ class SimulatorService:
         planes, has_c = None, False
         if self._aux:
             gt, planes, has_c = attach_constraints(
-                self.state, gt, nt.n, self._aux)
+                self.state, gt, nt.n, self._aux,
+                max_zones=self.dims.max_zones)
         return nt, gt, pt, planes, has_c
 
     # ---- rpc: ScaleUpSim ----
@@ -109,7 +112,12 @@ class SimulatorService:
             templates.append((node, g.get("max_new", 1000), g.get("price", 1.0)))
             ids.append(g["id"])
         groups = encode_node_groups(
-            templates, ExtendedResourceRegistry(), ZoneTable(), self.dims
+            templates, ExtendedResourceRegistry(),
+            # align template zone ids with the codec's interning so the
+            # constrained tier compares zones in ONE id space
+            self.state.zone_table_for_templates(
+                [t.zone() for t, _, _ in templates]),
+            self.dims
         )
         out = scale_up_sim(nt, gt, pt, groups, self.dims,
                            params.max_new_nodes, params.strategy,

@@ -136,13 +136,26 @@ class DeltaWriter:
         return self
 
     def _maybe_aux(self, pod: Pod, eqkey: str) -> None:
+        uid = pod.uid or f"{pod.namespace}/{pod.name}"
         has_topology = bool(pod.pod_affinity or pod.anti_affinity
                             or pod.spread_constraints())
         if not (has_topology or pod.labels):
+            # a re-upsert that no longer qualifies must CLEAR any earlier
+            # record on the server, or stale labels keep feeding the planes
+            self._aux_upserts.pop(uid, None)
+            if uid not in self._aux_deletes:
+                self._aux_deletes.append(uid)
             return
         rec: dict = {
             "k": eqkey, "ns": pod.namespace, "l": dict(pod.labels),
             "n": pod.node_name,
+            # the wire's lossy bit is CONSERVATIVE (set for any topology
+            # constraint so aux-unaware servers host-check); "dok" tells the
+            # overlay whether topology was the ONLY cause — i.e. the bit may
+            # be cleared once the overlay models the constraints
+            "dok": not (pod_request_vector(pod, self.registry)[1]
+                        or pod.affinity_node_terms()
+                        or pod.resource_claims),
         }
         cons = pod.spread_constraints()
         if cons:
@@ -157,35 +170,58 @@ class DeltaWriter:
         if pod.anti_affinity:
             rec["x"] = [{"key": t.topology_key, "sel": dict(t.match_labels),
                          "nss": list(t.namespaces)} for t in pod.anti_affinity]
-        self._aux_upserts[pod.uid or f"{pod.namespace}/{pod.name}"] = rec
+        # within-payload coherence: a uid lives in exactly ONE list, with the
+        # LAST op winning (the server applies upserts then deletes, so mixed
+        # membership would net to deletion regardless of op order)
+        if uid in self._aux_deletes:
+            self._aux_deletes.remove(uid)
+        self._aux_upserts[uid] = rec
 
     def delete_pod(self, uid: str) -> "DeltaWriter":
         self._body.append(DELETE_POD)
         _s(self._body, uid)
-        self._aux_deletes.append(uid)
+        self._aux_upserts.pop(uid, None)
+        if uid not in self._aux_deletes:
+            self._aux_deletes.append(uid)
         self._count += 1
         return self
 
     def payload(self) -> bytes:
         import json
+        import zlib
 
         out = MAGIC + struct.pack("<I", self._count) + bytes(self._body)
         if self._aux_upserts or self._aux_deletes:
             doc = json.dumps({"up": self._aux_upserts,
                               "del": self._aux_deletes}).encode()
-            # reverse-parsable trailer: [json][u32 len][KAUX]
-            out += doc + struct.pack("<I", len(doc)) + AUX_MAGIC
+            # reverse-parsable trailer: [json][u32 len][u32 crc32][KAUX];
+            # the crc makes a coincidental 'KAUX' suffix in a plain payload
+            # statistically impossible to mis-split
+            out += (doc + struct.pack("<I", len(doc))
+                    + struct.pack("<I", zlib.crc32(doc)) + AUX_MAGIC)
         return out
 
 
 def split_aux(payload: bytes) -> tuple[bytes, dict | None]:
-    """(KAD1 bytes for the C++ codec, parsed aux doc or None)."""
+    """(KAD1 bytes for the C++ codec, parsed aux doc or None). A malformed or
+    coincidental trailer (bad length / crc / json shape) yields the payload
+    unchanged — never a truncated dense body."""
     import json
+    import zlib
 
-    if len(payload) < 8 or payload[-4:] != AUX_MAGIC:
+    if len(payload) < 12 or payload[-4:] != AUX_MAGIC:
         return payload, None
-    (n,) = struct.unpack("<I", payload[-8:-4])
-    if n > len(payload) - 8:
+    (crc,) = struct.unpack("<I", payload[-8:-4])
+    (n,) = struct.unpack("<I", payload[-12:-8])
+    if n > len(payload) - 12:
         return payload, None
-    doc = json.loads(payload[-8 - n:-8])
-    return payload[: len(payload) - 8 - n], doc
+    doc_bytes = payload[-12 - n:-12]
+    if zlib.crc32(doc_bytes) != crc:
+        return payload, None
+    try:
+        doc = json.loads(doc_bytes)
+    except ValueError:
+        return payload, None
+    if not isinstance(doc, dict) or not set(doc) <= {"up", "del"}:
+        return payload, None
+    return payload[: len(payload) - 12 - n], doc

@@ -48,6 +48,8 @@ class _State:
     node_names: list[str]
     node_index: dict[str, int]
     n_valid: int
+    planes: object = None   # AffinityPlanes | None — per-fork so growth
+                            # padding cannot leak across revert
 
 
 class SnapshotError(Exception):
@@ -67,6 +69,7 @@ class TensorClusterSnapshot:
                 node_names=list(enc.node_names),
                 node_index=dict(enc.node_index),
                 n_valid=len(enc.node_names),
+                planes=enc.planes,
             )
         ]
 
@@ -86,7 +89,7 @@ class TensorClusterSnapshot:
         s = self.state
         self._stack.append(
             _State(s.nodes, s.specs, s.scheduled, list(s.node_names),
-                   dict(s.node_index), s.n_valid)
+                   dict(s.node_index), s.n_valid, s.planes)
         )
 
     def revert(self) -> None:
@@ -127,12 +130,13 @@ class TensorClusterSnapshot:
         i = s.n_valid
         if i >= s.nodes.n:
             s.nodes = _grow_nodes(s.nodes)
-            if self.enc.planes is not None:
+            if s.planes is not None:
                 # constraint planes are [G, N]: keep the node axis in step
-                # (new columns are zero — fresh nodes carry no residents)
-                self.enc.planes = jax.tree_util.tree_map(
+                # (new columns are zero — fresh nodes carry no residents);
+                # per-FORK so a reverted growth cannot leak wider planes
+                s.planes = jax.tree_util.tree_map(
                     lambda x: jnp.pad(x, ((0, 0), (0, x.shape[1]))),
-                    self.enc.planes)
+                    s.planes)
         row = encode_node_row(node, self.enc.registry, self.enc.zone_table, self.enc.dims)
         nt = s.nodes
         s.nodes = nt.replace(
@@ -177,7 +181,7 @@ class TensorClusterSnapshot:
         s = self.state
         return schedule_pending_on_existing(
             s.nodes, s.specs, s.scheduled,
-            planes=self.enc.planes,
+            planes=s.planes,
             max_zones=self.enc.dims.max_zones,
             with_constraints=self.enc.has_constraints,
         )
@@ -208,7 +212,7 @@ class TensorClusterSnapshot:
             s.nodes, s.specs, s.scheduled,
             jnp.asarray(candidate_indices, jnp.int32), dest_allowed,
             max_pods_per_node=max_pods_per_node, chunk=chunk,
-            planes=self.enc.planes,
+            planes=s.planes,
             max_zones=self.enc.dims.max_zones,
             with_constraints=self.enc.has_constraints,
         )

@@ -98,3 +98,73 @@ def test_sidecar_aux_delete_clears_constraints():
     w2.delete_pod(p.uid or "default/w0")
     svc.apply_delta(w2.payload())
     assert not svc._aux
+
+
+def test_sibling_replicas_stay_on_device_tier():
+    """Multi-replica spread group: siblings of the SAME equivalence group are
+    not cross-group coupling — the device tier must engage (review finding)."""
+    svc = SimulatorService(node_bucket=16, group_bucket=16)
+    w = DeltaWriter()
+    w.upsert_node(build_test_node("a0", cpu_milli=4000, mem_mib=8192, zone="a"))
+    for i in range(3):
+        p = build_test_pod(f"s{i}", cpu_milli=100, mem_mib=64,
+                           labels={"app": "w"}, owner_name="w-rs")
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE, match_labels={"app": "w"})]
+        w.upsert_pod(p)
+    svc.apply_delta(w.payload())
+    nt, gt, pt, planes, has_c = svc._tensors_with_constraints()
+    assert has_c
+    counts = np.asarray(gt.count)
+    rows = np.nonzero(counts > 0)[0]
+    assert len(rows) == 1
+    assert not bool(np.asarray(gt.needs_host_check)[rows[0]]), (
+        "sibling replicas must not force host-check")
+    assert int(np.asarray(gt.spread_kind)[rows[0]]) == 2
+
+
+def test_aux_cleared_when_pod_loses_labels():
+    svc = SimulatorService(node_bucket=16, group_bucket=16)
+    w = DeltaWriter()
+    w.upsert_node(build_test_node("n0", cpu_milli=4000, mem_mib=8192, zone="a"))
+    p = build_test_pod("db", cpu_milli=100, mem_mib=64, labels={"app": "db"},
+                       node_name="n0")
+    p.phase = "Running"
+    w.upsert_pod(p)
+    svc.apply_delta(w.payload())
+    assert len(svc._aux) == 1
+    # re-upsert without labels: the stale record must clear
+    p2 = build_test_pod("db", cpu_milli=100, mem_mib=64, node_name="n0")
+    p2.uid = p.uid
+    p2.phase = "Running"
+    w2 = DeltaWriter()
+    w2.upsert_pod(p2)
+    svc.apply_delta(w2.payload())
+    assert not svc._aux
+
+
+def test_snapshot_fork_growth_keeps_planes_consistent():
+    """Growth inside a reverted fork must not widen the base state's planes
+    (review finding: shape mismatch in the constrained kernels)."""
+    from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+    from kubernetes_autoscaler_tpu.simulator.snapshot import TensorClusterSnapshot
+
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192, zone="a")
+             for i in range(8)]
+    p = build_test_pod("s0", cpu_milli=100, mem_mib=64, labels={"app": "w"},
+                       owner_name="w-rs")
+    p.topology_spread = [TopologySpreadConstraint(
+        max_skew=1, topology_key=ZONE, match_labels={"app": "w"})]
+    enc = encode_cluster(nodes, [p], node_bucket=8)   # padded == n -> next add grows
+    assert enc.has_constraints
+    snap = TensorClusterSnapshot(enc)
+    snap.fork()
+    snap.add_node(build_test_node("grown", cpu_milli=4000, mem_mib=8192,
+                                  zone="a"))
+    assert snap.state.nodes.n > 8
+    assert snap.state.planes.aff_cnt.shape[1] == snap.state.nodes.n
+    snap.revert()
+    assert snap.state.nodes.n == 8
+    assert snap.state.planes.aff_cnt.shape[1] == 8
+    # the constrained schedule still compiles/runs on the base state
+    snap.schedule_pending_on_existing()
