@@ -16,6 +16,8 @@ own split between simulated scheduling and real kubelet admission) is:
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import numpy as np
 
 _FNV_OFFSET = 0xCBF29CE484222325
@@ -34,6 +36,7 @@ def fnv1a64(data: str | bytes) -> int:
     return h
 
 
+@lru_cache(maxsize=1 << 18)
 def fold32(data: str | bytes) -> int:
     """64-bit FNV-1a folded to a nonzero signed int32 (0 is the padding sentinel).
 
@@ -42,6 +45,10 @@ def fold32(data: str | bytes) -> int:
     probability ~1e-3 per snapshot, and any collision can only *relax* a
     predicate — the host-side winner verification (exact string semantics)
     catches it before actuation.
+
+    Memoized: snapshot encoding re-hashes the same label/taint strings for
+    every node row (5k nodes × ~dozens of strings per loop, heavily repeated)
+    — the cache turns the per-byte Python FNV loop into a dict hit.
     """
     h = fnv1a64(data)
     h32 = (h ^ (h >> 32)) & 0xFFFFFFFF
