@@ -267,3 +267,41 @@ def test_history_batch_ingestion_matches_sequential():
                                np.asarray(bat.cpu.weights[:1]), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(seq.memory.weights[:1]),
                                np.asarray(bat.memory.weights[:1]), rtol=1e-5)
+
+
+def test_recommender_scale_10k_containers():
+    """The reference's KWOK VPA benchmark analog (test/benchmark/README.md):
+    thousands of aggregates feed + recommend through the vectorized histogram
+    bank in one batch — bounded wall time, sane outputs."""
+    import time
+
+    from kubernetes_autoscaler_tpu.vpa.model import (
+        ContainerUsageSample,
+        VerticalPodAutoscaler,
+    )
+    from kubernetes_autoscaler_tpu.vpa.recommender import Recommender
+
+    n_targets, pods_per = 500, 4
+    rec = Recommender()
+    samples = []
+    for t in range(n_targets):
+        for p in range(pods_per):
+            for k in range(5):
+                samples.append(ContainerUsageSample(
+                    namespace="default", pod_name=f"w{t}-{p}",
+                    container_name="app", owner_name=f"w{t}",
+                    cpu_cores=0.1 + (t % 10) * 0.1,
+                    memory_bytes=(64 + (t % 7) * 32) * 2**20,
+                    timestamp=float(k * 60)))
+    t0 = time.perf_counter()
+    rec.feed(samples, now=300.0)
+    vpas = [VerticalPodAutoscaler(name=f"v{t}", target_name=f"w{t}")
+            for t in range(n_targets)]
+    rec.recommend(vpas, {f"w{t}": ["app"] for t in range(n_targets)}, now=300.0)
+    dt = time.perf_counter() - t0
+    assert all(v.recommendation for v in vpas)
+    # targets with 10x the cpu usage get ~larger targets (monotone sanity)
+    lo = vpas[0].recommendation[0].target["cpu"]    # 0.1 cores observed
+    hi = vpas[9].recommendation[0].target["cpu"]    # 1.0 cores observed
+    assert hi > lo * 3
+    assert dt < 60, f"10k-sample feed+recommend took {dt:.1f}s"
