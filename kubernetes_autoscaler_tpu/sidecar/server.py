@@ -44,8 +44,6 @@ class SimulatorService:
         self.node_bucket = node_bucket
         self.group_bucket = group_bucket
         self._lock = threading.Lock()
-        self._group_tensors = None
-        self._zone_seed: dict[str, int] = {}
         # KAUX constraint side-channel store (uid -> wire record)
         self._aux: dict[str, dict] = {}
 
