@@ -145,3 +145,32 @@ def test_quota_flags_cap_scale_up():
     st = a.run_once(now=1000.0)
     assert st.scale_up is not None
     assert st.scale_up.increases == {"ng1": 2}
+
+
+def test_balancing_similarity_knobs():
+    from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
+    from kubernetes_autoscaler_tpu.core.scaleup.orchestrator import (
+        _similar_templates,
+    )
+
+    a = build_test_node("a", cpu_milli=4000, mem_mib=16384,
+                        labels={"pool": "x", "team": "red"})
+    b = build_test_node("b", cpu_milli=4000, mem_mib=16384,
+                        labels={"pool": "x", "team": "blue"})
+    # default: team label differs -> not similar
+    assert not _similar_templates(a, b, AutoscalingOptions())
+    # --balancing-ignore-label team -> similar
+    assert _similar_templates(a, b, AutoscalingOptions(
+        balancing_ignore_labels=["team"]))
+    # --balancing-label pool -> compare ONLY pool -> similar
+    assert _similar_templates(a, b, AutoscalingOptions(
+        balancing_labels=["pool"]))
+
+    # memory ratio: 1.5% default tolerance is tighter than the 5% cpu one
+    c = build_test_node("c", cpu_milli=4000, mem_mib=16384,
+                        labels={"pool": "x"})
+    d = build_test_node("d", cpu_milli=4000, mem_mib=int(16384 * 1.04),
+                        labels={"pool": "x"})
+    assert not _similar_templates(c, d, AutoscalingOptions())
+    assert _similar_templates(c, d, AutoscalingOptions(
+        memory_difference_ratio=0.05))
