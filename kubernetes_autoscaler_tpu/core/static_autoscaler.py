@@ -108,7 +108,17 @@ class StaticAutoscaler:
         limiter = merge_flag_limits(provider.get_resource_limiter(), self.options)
         self.quota = (QuotaTracker(limiter, None)  # registry set per loop
                       if self.options.capacity_quotas_enabled else None)
+        grpc_call = None
+        if self.options.grpc_expander_url and "grpc" in self.options.expander:
+            from kubernetes_autoscaler_tpu.expander.grpc_transport import (
+                grpc_expander_call,
+            )
+
+            grpc_call = grpc_expander_call(
+                url=self.options.grpc_expander_url,
+                cert_file=self.options.grpc_expander_cert)
         expander = build_expander(self.options.expander, expander_priorities,
+                                  grpc_call=grpc_call,
                                   pricing=provider.pricing())
         # auto-provisioning wiring (reference: builder picks the
         # autoprovisioning NodeGroupListProcessor when the flag is on)

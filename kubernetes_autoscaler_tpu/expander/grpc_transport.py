@@ -53,11 +53,22 @@ def serve_expander(best_options_fn, port: int = 0):
     return server, bound
 
 
-def grpc_expander_call(port: int):
-    """The injectable callable for GrpcFilter: dials BestOptions."""
+def grpc_expander_call(port: int | None = None, url: str = "",
+                       cert_file: str = ""):
+    """The injectable callable for GrpcFilter: dials BestOptions.
+
+    `url` + optional `cert_file` mirror the reference's --grpc-expander-url /
+    --grpc-expander-cert (expander/grpcplugin); `port` is the local-test
+    shorthand."""
     import grpc
 
-    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    target = url or f"127.0.0.1:{port}"
+    if cert_file:
+        with open(cert_file, "rb") as f:
+            creds = grpc.ssl_channel_credentials(f.read())
+        channel = grpc.secure_channel(target, creds)
+    else:
+        channel = grpc.insecure_channel(target)
     rpc = channel.unary_unary(
         f"/{_SERVICE}/BestOptions",
         request_serializer=lambda b: b,

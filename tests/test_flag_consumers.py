@@ -174,3 +174,31 @@ def test_balancing_similarity_knobs():
     assert not _similar_templates(c, d, AutoscalingOptions())
     assert _similar_templates(c, d, AutoscalingOptions(
         memory_difference_ratio=0.05))
+
+
+def test_grpc_expander_url_flag_dials_remote():
+    from kubernetes_autoscaler_tpu.expander.grpc_transport import serve_expander
+
+    fake = FakeCluster()
+    tmpl_small = build_test_node("tmpl-s", cpu_milli=4000, mem_mib=8192)
+    tmpl_big = build_test_node("tmpl-b", cpu_milli=8000, mem_mib=16384)
+    fake.add_node_group("ng-small", tmpl_small, max_size=10)
+    fake.add_node_group("ng-big", tmpl_big, max_size=10)
+    fake.add_existing_node("ng-small", build_test_node(
+        "seed", cpu_milli=100, mem_mib=128))
+    for i in range(4):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1500, mem_mib=256,
+                                    owner_name="rs"))
+
+    # out-of-process expander that always prefers ng-big
+    server, port = serve_expander(
+        lambda options: [o for o in options if o.group_id == "ng-big"])
+    try:
+        a = autoscaler_for(fake, expander="grpc",
+                           grpc_expander_url=f"127.0.0.1:{port}")
+        st = a.run_once(now=1000.0)
+        assert st.scale_up is not None
+        assert list(st.scale_up.increases) == ["ng-big"], (
+            "--grpc-expander-url must route the choice to the remote expander")
+    finally:
+        server.stop(0)
