@@ -23,6 +23,8 @@ from kubernetes_autoscaler_tpu.models.api import Node
 
 
 class TestNodeGroup(NodeGroup):
+    __test__ = False  # fixture class, not a pytest case (collection warning)
+
     def __init__(
         self,
         gid: str,
@@ -143,6 +145,8 @@ class TestNodeGroup(NodeGroup):
 
 @dataclass
 class TestCloudProvider(CloudProvider):
+    __test__ = False  # fixture class, not a pytest case (collection warning)
+
     on_scale_up: Callable[[str, int], None] | None = None
     on_scale_down: Callable[[str, str], None] | None = None
     resource_limiter: ResourceLimiter = field(default_factory=ResourceLimiter)

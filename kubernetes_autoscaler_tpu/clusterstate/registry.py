@@ -89,8 +89,11 @@ class ClusterStateRegistry:
 
         default_registry.counter("failed_scale_ups_total").inc()
         tmpl = group.template_node_info()
-        if any(r not in ("cpu", "memory", "pods", "ephemeral-storage")
-               for r in tmpl.alloc_or_cap()):
+        # specifically the provider's GPU resource — hugepages / DRA classes /
+        # CSI attach-limit slots are extended resources too and must not
+        # inflate the GPU failure metric
+        if float(tmpl.alloc_or_cap().get(
+                self.provider.gpu_resource_name(), 0)) > 0:
             default_registry.counter("failed_gpu_scale_ups_total").inc()
         """reference: RegisterFailedScaleUp → backoff the group."""
         self.failed_scale_ups[group.id()] = now

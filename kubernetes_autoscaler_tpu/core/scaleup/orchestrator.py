@@ -226,6 +226,7 @@ class ScaleUpOrchestrator:
         # than shipped unverified (reference: BinpackingLimiter stops
         # computing further options)
         deadline = time.monotonic() + self.options.max_binpacking_time_s
+        gpu_slot = enc.registry.try_slot_for(self.provider.gpu_resource_name())
         out = []
         for opt in options:
             g_t = groups[opt.group_index].template_node_info()
@@ -262,7 +263,10 @@ class ScaleUpOrchestrator:
                     template=opt.template, exists=opt.exists,
                     helped_cpu_milli=float(helped[i, CPU]),
                     helped_mem_mib=float(helped[i, MEMORY]),
-                    helped_gpus=opt.helped_gpus,
+                    # from the re-estimate, like cpu/mem — the pre-mask value
+                    # would overstate GPU help for options with refuted pods
+                    helped_gpus=(float(helped[i, gpu_slot])
+                                 if gpu_slot is not None else 0.0),
                 ))
         return out
 

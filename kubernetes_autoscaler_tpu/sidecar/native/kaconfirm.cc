@@ -167,12 +167,21 @@ int ka_confirm(
     }
 
     if (!ok) {
+      int min_reverted = n;
       for (const Move& m : placed) {
         const int32_t* req = greq + (int64_t)m.group * r;
         int64_t* fr = free_io + (int64_t)m.node * r;
         for (int k = 0; k < r; ++k) fr[k] += req[k];
-        if (m.node < hint[m.group]) hint[m.group] = m.node;
+        if (m.node < min_reverted) min_reverted = m.node;
       }
+      // Restoring capacity can re-open a node that ANOTHER group's frontier
+      // already skipped as full while this candidate was being placed, so
+      // every group's hint must rewind to the earliest reverted destination —
+      // not just the placing group's. (Hints are pure optimization: rewinding
+      // too far only costs a rescan of permanently-bad nodes.)
+      if (min_reverted < n)
+        for (int gg2 = 0; gg2 < g; ++gg2)
+          if (min_reverted < hint[gg2]) hint[gg2] = min_reverted;
       reason_out[c] = 1;
       continue;
     }

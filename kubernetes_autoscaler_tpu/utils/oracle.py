@@ -158,11 +158,13 @@ def spread_ok(
     pods_by_node: dict[str, list[Pod]],
 ) -> bool:
     """PodTopologySpread DoNotSchedule check (vendored plugin semantics):
-    for each constraint, skew after placing = count(node's domain) + 1 -
-    min(count over eligible domains) must stay <= max_skew. Eligible domains
-    are values present on nodes matching the pod's nodeSelector/affinity;
-    matching pods are counted in the pod's namespace across ALL nodes holding
-    the topology key."""
+    for each constraint, skew after placing = count(node's domain) +
+    selfMatchNum - min(count over eligible domains) must stay <= max_skew,
+    where selfMatchNum is 1 only if the incoming pod itself matches the
+    constraint's selector (vendored podtopologyspread/filtering.go:345-351).
+    Eligible domains are values present on nodes matching the pod's
+    nodeSelector/affinity; matching pods are counted in the pod's namespace
+    across ALL nodes holding the topology key."""
     for c in pod.spread_constraints():
         v_here = topology_value(node, c.topology_key)
         if v_here is None:
@@ -181,7 +183,8 @@ def spread_ok(
                     counts[v] += 1
         eligible.add(v_here)  # the candidate node itself is an eligible domain
         min_count = min((counts.get(v, 0) for v in eligible), default=0)
-        if counts.get(v_here, 0) + 1 - min_count > c.max_skew:
+        self_match = 1 if labels_match(c.match_labels, pod.labels) else 0
+        if counts.get(v_here, 0) + self_match - min_count > c.max_skew:
             return False
     return True
 

@@ -118,3 +118,38 @@ def test_native_consolidation_scenario(monkeypatch):
     b = _plan(fake, enc, nodes, False, monkeypatch, **kw)
     assert a == b
     assert len(a) == 12  # 60% consolidate
+
+
+def test_frontier_hint_rewinds_for_all_groups_on_revert():
+    """Regression: a failed candidate's revert must rewind EVERY group's
+    first-fit frontier, not only the placing group's.
+
+    Scenario: candidate 1 (node 3) drains one group-A pod and one group-B pod.
+    A lands on node 0 (the only free node), transiently filling it; B then
+    scans nodes 0-2 (all full) and advances its frontier to node 3 before
+    failing. The revert restores node 0's capacity. Candidate 2 (node 2)
+    drains a single group-B pod that fits node 0 — but with a polluted
+    hint[B]=3 the native pass skipped node 0 and wrongly rejected it
+    (the Python pass accepts). Advisor finding r3 (high), kaconfirm.cc:174.
+    """
+    free = np.array([[1], [0], [0], [0]], np.int64)
+    feas = np.ones((2, 4), np.uint8)
+    node_valid = np.ones((4,), np.uint8)
+    greq = np.array([[1], [1]], np.int32)
+    cand_node = np.array([3, 2], np.int32)
+    slot_ids = np.array([0, 1, 2], np.int32)
+    slot_group = np.array([0, 1, 1], np.int32)
+    slot_off = np.array([0, 2, 3], np.int32)
+    cand_group_idx = np.array([0, 0], np.int32)
+    group_room = np.array([10], np.int32)
+    node_cap = np.zeros((4, 1), np.int64)
+
+    accept, reason, dest = native_confirm.confirm(
+        free, feas, node_valid, greq, cand_node,
+        slot_ids, slot_group, slot_off, cand_group_idx, group_room,
+        None, None, node_cap,
+        empty_budget=10, drain_budget=10, total_budget=10, max_slot_id=2)
+
+    assert list(accept) == [0, 1], (list(accept), list(reason))
+    assert reason[0] == 1  # candidate 1 genuinely has no place for B
+    assert dest[2] == 0    # candidate 2's group-B pod lands on node 0

@@ -248,3 +248,29 @@ def test_multi_requirement_or_terms_stay_host_checked():
     enc = encode_cluster(nodes, [p])
     g = next(i for i, idxs in enumerate(enc.group_pods) if idxs)
     assert bool(np.asarray(enc.specs.needs_host_check)[g])
+
+
+def test_spread_self_match_num_selector_not_matching_pod():
+    """selfMatchNum semantics (vendored filtering.go:345-351): the incoming
+    pod counts toward skew only when it matches the constraint's selector.
+    Advisor finding r3 (medium): the oracle used to always add +1 and
+    over-rejected. Here the pod spreads app=web replicas but is itself
+    app=api, so placing it anywhere changes no count and every zone passes.
+    """
+    nodes = _cluster(zones=("a", "b", "c"))
+    pods = [
+        _resident("w1", "n0", {"app": "web"}),
+        _resident("w2", "n0", {"app": "web"}),
+        _resident("w3", "n1", {"app": "web"}),
+    ]
+    by_node = oracle.group_pods_by_node(pods)
+    incoming = build_test_pod("x1", cpu_milli=10, mem_mib=10,
+                              labels={"app": "api"})
+    incoming.topology_spread = [TopologySpreadConstraint(
+        max_skew=1, topology_key="topology.kubernetes.io/zone",
+        match_labels={"app": "web"})]
+    # counts: a=2, b=1, c=0; min=0. With selfMatchNum=0 the skew check is
+    # count[d] + 0 - 0 <= 1 -> zone a (2) still violates, b and c pass.
+    assert not oracle.check_pod_in_cluster(incoming, nodes[0], nodes, by_node)
+    assert oracle.check_pod_in_cluster(incoming, nodes[1], nodes, by_node)
+    assert oracle.check_pod_in_cluster(incoming, nodes[2], nodes, by_node)

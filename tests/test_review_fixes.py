@@ -106,3 +106,31 @@ def test_drain_sibling_anti_affinity_not_stacked():
     dests = np.asarray(r.dest_node[0])
     dests = dests[dests >= 0]
     assert len(dests) == 2 and len(set(dests)) == 2  # spread across n2, n3
+
+
+def test_failed_gpu_metric_counts_only_gpu_resource():
+    """Advisor r3 (low): failed_gpu_scale_ups_total must key on the
+    provider's GPU resource, not any extended resource (hugepages, DRA
+    classes and CSI attach slots are extended too)."""
+    from kubernetes_autoscaler_tpu.clusterstate.registry import (
+        ClusterStateRegistry,
+    )
+    from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
+    from kubernetes_autoscaler_tpu.metrics.metrics import default_registry
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+
+    fake = FakeCluster()
+    huge = build_test_node("huge-tmpl", cpu_milli=4000, mem_mib=8192)
+    huge.allocatable["hugepages-2Mi"] = 1024
+    fake.add_node_group("ng-huge", huge, min_size=0, max_size=5)
+    gpu = build_test_node("gpu-tmpl", cpu_milli=4000, mem_mib=8192, gpus=4)
+    fake.add_node_group("ng-gpu", gpu, min_size=0, max_size=5)
+
+    csr = ClusterStateRegistry(fake.provider, AutoscalingOptions())
+    groups = {g.id(): g for g in fake.provider.node_groups()}
+    ctr = default_registry.counter("failed_gpu_scale_ups_total")
+    before = ctr.value()
+    csr.register_failed_scale_up(groups["ng-huge"], now=10.0)
+    assert ctr.value() == before  # hugepages-only template: not a GPU failure
+    csr.register_failed_scale_up(groups["ng-gpu"], now=11.0)
+    assert ctr.value() == before + 1
