@@ -84,6 +84,16 @@ class TestNodeGroup(NodeGroup):
             self._instances = [i for i in self._instances if i.name != nd.name]
             self._target -= 1
 
+    def force_delete_nodes(self, nodes: list[Node]) -> None:
+        """Forceful path: bypasses the min-size guard (reference
+        ForceDeleteNodes bypasses termination protections)."""
+        for nd in nodes:
+            if self._provider.on_scale_down:
+                self._provider.on_scale_down(self._id, nd.name)
+            self._provider.remove_node(self._id, nd.name)
+            self._instances = [i for i in self._instances if i.name != nd.name]
+            self._target -= 1
+
     def decrease_target_size(self, delta: int) -> None:
         if delta >= 0:
             raise NodeGroupError("decrease_target_size: delta must be negative")

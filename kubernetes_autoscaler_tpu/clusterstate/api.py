@@ -56,6 +56,9 @@ class NodeGroupStatus:
 @dataclass
 class ClusterAutoscalerStatus:
     autoscaler_status: str = HEALTHY
+    # identity of the ConfigMap this document is written as (reference:
+    # --status-config-map-name names the object WriteStatusConfigMap updates)
+    config_map_name: str = "cluster-autoscaler-status"
     cluster_wide: NodeGroupStatus = field(
         default_factory=lambda: NodeGroupStatus(name="")
     )
@@ -79,6 +82,7 @@ class ClusterAutoscalerStatus:
             }
 
         return {
+            "configMapName": self.config_map_name,
             "autoscalerStatus": self.autoscaler_status,
             "message": self.message,
             "lastProbeTime": self.last_probe_time,
@@ -91,10 +95,13 @@ class ClusterAutoscalerStatus:
 
 
 def build_status(registry: ClusterStateRegistry, now: float,
-                 scale_down_candidates: list[str] | None = None) -> ClusterAutoscalerStatus:
+                 scale_down_candidates: list[str] | None = None,
+                 config_map_name: str | None = None) -> ClusterAutoscalerStatus:
     """Assemble the status document from the registry's health model
     (reference: clusterstate.GetStatus)."""
     st = ClusterAutoscalerStatus(last_probe_time=now)
+    if config_map_name:
+        st.config_map_name = config_map_name
     st.cluster_wide.node_counts = NodeCounts.from_readiness(
         registry.total_readiness
     )

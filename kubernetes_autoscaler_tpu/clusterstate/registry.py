@@ -142,14 +142,29 @@ class ClusterStateRegistry:
             by_group[g.id()] = r
         self.unregistered = still_unregistered
 
+        self.node_first_seen = {
+            n: t for n, t in getattr(self, "node_first_seen", {}).items()
+            if n in registered}
         for nd in nodes:
             g = self.provider.node_group_for_node(nd)
             r = by_group.setdefault(g.id() if g else "", Readiness())
             r.registered += 1
             total.registered += 1
+            # the node's own creation stamp when the source provides it
+            # (reference: CreationTimestamp, clusterstate.go:739); fall back
+            # to first-seen so fixture nodes without stamps still classify —
+            # a restart must NOT re-open the startup window for old nodes
+            since = (nd.creation_time if nd.creation_time > 0
+                     else self.node_first_seen.setdefault(nd.name, now))
             if nd.ready:
                 r.ready += 1
                 total.ready += 1
+            elif now - since <= self.options.max_node_startup_time_s:
+                # within the startup window an unready node is "not started"
+                # — it doesn't count against cluster health (reference:
+                # clusterstate.go:739 CreationTimestamp + MaxNodeStartupTime)
+                r.not_started += 1
+                total.not_started += 1
             else:
                 r.unready += 1
                 total.unready += 1

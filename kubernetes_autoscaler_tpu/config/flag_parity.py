@@ -47,13 +47,15 @@ IMPLEMENTED: dict[str, str] = {
     "ignore-mirror-pods-utilization": "ignore_mirror_pods_utilization",
     "initial-node-group-backoff-duration": "initial_node_group_backoff_s",
     "max-allocatable-difference-ratio": "max_allocatable_difference_ratio",
+    "force-delete-unregistered-nodes": "force_delete_unregistered_nodes (min-size-ignoring forceful reap)",
     "max-binpacking-time": "max_binpacking_time_s (verify/salvo deadline)",
     "max-bulk-soft-taint-count": "max_bulk_soft_taint_count",
     "max-bulk-soft-taint-time": "max_bulk_soft_taint_time_s",
     "max-drain-parallelism": "max_drain_parallelism",
     "max-failing-time": "max_failing_time_s (liveness)",
     "max-free-difference-ratio": "max_free_difference_ratio",
-    "max-graceful-termination-sec": "max_graceful_termination_s",
+    "max-graceful-termination-sec": "max_graceful_termination_s (eviction grace cap + termination wait)",
+    "max-pod-eviction-time": "max_pod_eviction_time_s (per-pod eviction retry window)",
     "max-inactivity": "max_inactivity_s (liveness)",
     "max-node-group-backoff-duration": "max_node_group_backoff_s",
     "max-node-provision-time": "node_group_defaults.max_node_provision_time_s",
@@ -121,7 +123,6 @@ REJECTED: dict[str, str] = {
     "enable-proactive-scaleup": "capacity buffers + pod injection cover proactive headroom",
     "fastpath-binpacking-enabled": "no fastpath exists: the full pack is one fused device program",
     "force-delete-failed-nodes": "failed-boot instances are force-reaped unconditionally (no apiserver finalizers to bypass)",
-    "force-delete-unregistered-nodes": "long-unregistered instances are force-reaped unconditionally",
     "frequent-loops-enabled": "the loop driver is always event-driven (core/loop.py LoopTrigger)",
     "gce-concurrent-refreshes": "GCE-SDK specific",
     "gce-mig-instances-min-refresh-wait-time": "GCE-SDK specific",
@@ -132,7 +133,6 @@ REJECTED: dict[str, str] = {
     "kubeconfig": "no kube API client",
     "max-nodegroup-binpacking-duration": "all groups estimate in ONE device dispatch; max-binpacking-time bounds the whole computation",
     "max-node-skip-eval-time-tracker-enabled": "no per-node eval-skip heuristic: the sweep is exhaustive on device",
-    "max-pod-eviction-time": "eviction completion is the eviction sink's contract",
     "namespace": "no kube API objects to namespace",
     "node-delete-delay-after-taint": "no apiserver propagation delay to wait out",
     "node-deletion-batcher-interval": "empty-node deletions batch per loop already (actuator delete_in_batch path)",

@@ -106,6 +106,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-drain-parallelism", type=int, default=1)
     p.add_argument("--max-empty-bulk-delete", type=int, default=10)
     p.add_argument("--max-graceful-termination-sec", type=int, default=600)
+    p.add_argument("--max-pod-eviction-time", type=float, default=120.0,
+                   help="seconds CA keeps retrying a failed pod eviction")
+    p.add_argument("--force-delete-unregistered-nodes", type=_bool,
+                   default=False)
     p.add_argument("--skip-nodes-with-system-pods", type=_bool, default=True)
     p.add_argument("--skip-nodes-with-local-storage", type=_bool, default=True)
     p.add_argument("--skip-nodes-with-custom-controller-pods", type=_bool,
@@ -168,6 +172,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-nodes-static", type=int, default=1024)
     p.add_argument("--drain-chunk", type=int, default=32)
     p.add_argument("--max-pods-per-node", type=int, default=128)
+    p.add_argument("--incremental-encode", type=_bool, default=True,
+                   help="maintain the tensor snapshot across loops and apply "
+                        "only deltas (reference rationale: DeltaSnapshotStore)")
+    p.add_argument("--incremental-resync-loops", type=int, default=240,
+                   help="compacting full re-encode every N loops (0 = never)")
 
     # runner (standalone mode)
     p.add_argument("--scenario", default="",
@@ -275,6 +284,10 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         max_new_nodes_static=args.max_new_nodes_static,
         drain_chunk=args.drain_chunk,
         max_pods_per_node=args.max_pods_per_node,
+        max_pod_eviction_time_s=args.max_pod_eviction_time,
+        force_delete_unregistered_nodes=args.force_delete_unregistered_nodes,
+        incremental_encode=args.incremental_encode,
+        incremental_resync_loops=args.incremental_resync_loops,
     )
 
 

@@ -97,6 +97,13 @@ class AutoscalingOptions:
     max_drain_parallelism: int = 1
     max_empty_bulk_delete: int = 10
     max_graceful_termination_s: float = 600.0
+    # per-pod eviction retry window (reference: --max-pod-eviction-time, 2m;
+    # drain.go:185 retryUntil)
+    max_pod_eviction_time_s: float = 120.0
+    # long-unregistered instances: use NodeGroup.force_delete_nodes and
+    # ignore group min size (reference: --force-delete-unregistered-nodes,
+    # static_autoscaler.go:990,1018)
+    force_delete_unregistered_nodes: bool = False
     skip_nodes_with_system_pods: bool = True
     skip_nodes_with_local_storage: bool = True
     skip_nodes_with_custom_controller_pods: bool = False
@@ -143,3 +150,10 @@ class AutoscalingOptions:
     group_shape_bucket: int = 64
     drain_chunk: int = 32
     max_pods_per_node: int = 128
+    # incremental tensor-snapshot maintenance across loops (the reference's
+    # DeltaSnapshotStore rationale, store/delta.go:33-54, moved to the
+    # string→tensor boundary); False = full encode_cluster every loop
+    incremental_encode: bool = True
+    # force a compacting full re-encode every N loops (0 = never); bounds
+    # ghost-row growth from long-running node/equivalence churn
+    incremental_resync_loops: int = 240

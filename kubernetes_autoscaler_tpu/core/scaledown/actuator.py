@@ -34,10 +34,30 @@ from kubernetes_autoscaler_tpu.models.api import (
 )
 
 
-class EvictionSink(Protocol):
-    """Where evictions land (reference: the eviction API in actuation/drain.go)."""
+# reference: actuation/drain.go:44-49 — retry cadence for failed evictions and
+# the extra wait for pods ignoring SIGTERM (killed at grace-period expiry)
+DEFAULT_EVICTION_RETRY_TIME_S = 10.0
+DEFAULT_POD_EVICTION_HEADROOM_S = 30.0
+# apiv1.DefaultTerminationGracePeriodSeconds
+DEFAULT_TERMINATION_GRACE_S = 30.0
 
-    def evict(self, pod: Pod, node: Node) -> None: ...
+
+class EvictionSink(Protocol):
+    """Where evictions land (reference: the eviction API in actuation/drain.go).
+
+    `evict` may RAISE to signal a failed eviction (PDB conflict, API error);
+    the actuator retries until --max-pod-eviction-time elapses
+    (drain.go:185,240). Optional extensions a sink may provide:
+      force_delete(pod, node)      — bypass eviction (reference
+                                     forceDeletePod, drain.go:295)
+      pods_gone(node_name, pod_names) -> bool
+                                   — poll hook for the post-eviction wait
+                                     (drain.go allGone loop); sinks whose
+                                     evict() is synchronous can omit it
+    """
+
+    def evict(self, pod: Pod, node: Node, grace_period_s: float | None = None
+              ) -> None: ...
 
 
 @dataclass
@@ -83,6 +103,8 @@ class Actuator:
         on_taint: Callable[[Node, str], None] | None = None,
         pdb_tracker=None,
         latency_tracker=None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
     ):
         self.provider = provider
         self.options = options
@@ -91,6 +113,82 @@ class Actuator:
         self.tracker = NodeDeletionTracker()
         self.pdb_tracker = pdb_tracker          # core/scaledown/pdb.RemainingPdbTracker
         self.latency_tracker = latency_tracker  # core/scaledown/latencytracker
+        self.clock = clock                      # injectable for retry tests
+        self.sleep = sleep
+        self.eviction_retry_time_s = DEFAULT_EVICTION_RETRY_TIME_S
+        self.pod_eviction_headroom_s = DEFAULT_POD_EVICTION_HEADROOM_S
+        self._sink_takes_grace: bool | None = None  # resolved on first evict
+
+    # ---- eviction with retry (reference: drain.go evictPod :240) ----
+
+    def _grace_for(self, pod: Pod) -> float:
+        """Grace period = pod's own, capped by --max-graceful-termination-sec
+        (reference: evictPod maxTermination clamp, drain.go:243-249)."""
+        g = (pod.termination_grace_s if pod.termination_grace_s is not None
+             else DEFAULT_TERMINATION_GRACE_S)
+        cap = self.options.max_graceful_termination_s
+        if cap and cap > 0:
+            g = min(g, cap)
+        return g
+
+    def _evict_once(self, pod: Pod, node: Node, grace_s: float) -> None:
+        if self._sink_takes_grace is None:
+            import inspect
+
+            try:
+                sig = inspect.signature(self.eviction_sink.evict)
+                self._sink_takes_grace = "grace_period_s" in sig.parameters
+            except (TypeError, ValueError):
+                self._sink_takes_grace = False
+        if self._sink_takes_grace:
+            self.eviction_sink.evict(pod, node, grace_period_s=grace_s)
+        else:  # minimal sinks only take (pod, node)
+            self.eviction_sink.evict(pod, node)
+
+    def _evict_with_retry(self, pod: Pod, node: Node, retry_until: float,
+                          force: bool = False) -> None:
+        """Retry eviction every eviction_retry_time_s until the
+        --max-pod-eviction-time deadline (drain.go:185 retryUntil, :240 retry
+        loop). Under force, a still-failing pod is force-deleted instead of
+        failing the drain (drain.go:263 forceDeletePod)."""
+        grace = self._grace_for(pod)
+        last: Exception | None = None
+        first = True
+        while first or self.clock() < retry_until:
+            if not first:
+                self.sleep(self.eviction_retry_time_s)
+            first = False
+            try:
+                self._evict_once(pod, node, grace)
+                return
+            except Exception as e:  # noqa: BLE001 — sink failure = retryable
+                last = e
+        if force:
+            fd = getattr(self.eviction_sink, "force_delete", None)
+            if fd is not None:
+                fd(pod, node)
+                return
+        raise NodeGroupError(
+            f"failed to evict pod {pod.namespace}/{pod.name} within allowed "
+            f"timeout (last error: {last})")
+
+    def _wait_pods_gone(self, node: Node, pods: list[Pod]) -> None:
+        """Post-eviction wait: up to max-graceful-termination + headroom for
+        the pods to actually terminate (drain.go allGone polling). Sinks
+        without a pods_gone hook are synchronous by contract — no wait."""
+        gone = getattr(self.eviction_sink, "pods_gone", None)
+        if gone is None or not pods:
+            return
+        grace = max((self._grace_for(p) for p in pods), default=0.0)
+        deadline = self.clock() + grace + self.pod_eviction_headroom_s
+        names = [f"{p.namespace}/{p.name}" for p in pods]
+        while True:
+            if gone(node.name, names):
+                return
+            if self.clock() >= deadline:
+                raise NodeGroupError(
+                    f"pods remaining on {node.name} after termination timeout")
+            self.sleep(min(self.eviction_retry_time_s, 5.0))
 
     # ---- taints (reference: utils/taints/taints.go) ----
 
@@ -133,6 +231,27 @@ class Actuator:
         pods_by_slot: dict[int, Pod] | None = None,
         now: float | None = None,
     ) -> list[DeletionResult]:
+        return self._start_deletion(to_remove, pods_by_slot, now, force=False)
+
+    def start_force_deletion(
+        self,
+        to_remove: list[NodeToRemove],
+        pods_by_slot: dict[int, Pod] | None = None,
+        now: float | None = None,
+    ) -> list[DeletionResult]:
+        """Forced variant (reference: Actuator.StartForceDeletion,
+        actuator.go:126): bypasses the PDB gate, force-deletes pods whose
+        eviction keeps failing (drain.go:263), and deletes nodes via
+        NodeGroup.force_delete_nodes (group_deletion_scheduler.go:105)."""
+        return self._start_deletion(to_remove, pods_by_slot, now, force=True)
+
+    def _start_deletion(
+        self,
+        to_remove: list[NodeToRemove],
+        pods_by_slot: dict[int, Pod] | None,
+        now: float | None,
+        force: bool,
+    ) -> list[DeletionResult]:
         now = time.time() if now is None else now
         empty = [r for r in to_remove if r.is_empty]
         drain = [r for r in to_remove if not r.is_empty]
@@ -155,7 +274,10 @@ class Actuator:
             for s in r.ds_to_evict:
                 pod = pods_by_slot.get(s)
                 if pod is not None:
-                    self.eviction_sink.evict(pod, r.node)
+                    try:  # DS eviction is best-effort (drain.go:106)
+                        self._evict_once(pod, r.node, self._grace_for(pod))
+                    except Exception:  # noqa: BLE001
+                        pass
 
         results: list[DeletionResult] = []
         # empty nodes: batched per group (reference: delete_in_batch.go)
@@ -176,7 +298,10 @@ class Actuator:
                 try:
                     for r in batch:
                         evict_daemonsets(r)
-                    g.delete_nodes([r.node for r in batch])
+                    if force:
+                        g.force_delete_nodes([r.node for r in batch])
+                    else:
+                        g.delete_nodes([r.node for r in batch])
                     for r in batch:
                         self.tracker.finish(r.node.name, True)
                         if self.latency_tracker is not None:
@@ -194,14 +319,23 @@ class Actuator:
                 if self.eviction_sink and pods_by_slot:
                     victims = [pods_by_slot[s] for s in r.pods_to_move
                                if s in pods_by_slot]
-                    if self.pdb_tracker is not None:
+                    if self.pdb_tracker is not None and not force:
                         # last-moment atomic PDB gate (reference: drain.go
                         # re-checks budgets at eviction time, not just plan
-                        # time); atomic because drains run in worker threads
+                        # time); atomic because drains run in worker threads.
+                        # Forced deletion bypasses PDBs (StartForceDeletion).
                         if not self.pdb_tracker.try_remove_pods(victims):
                             raise NodeGroupError("PDB budget exhausted")
                     for pod in priority_eviction_order(victims):
-                        self.eviction_sink.evict(pod, r.node)
+                        # per-POD retry window (the reference gets the same
+                        # effect by evicting pods in parallel goroutines that
+                        # each run until retryUntil; sequentially, the window
+                        # must restart per pod or later pods get no retries)
+                        retry_until = self.clock() + \
+                            self.options.max_pod_eviction_time_s
+                        self._evict_with_retry(pod, r.node, retry_until,
+                                               force=force)
+                    self._wait_pods_gone(r.node, victims)
                     from kubernetes_autoscaler_tpu.metrics.metrics import (
                         default_registry,
                     )
@@ -211,7 +345,10 @@ class Actuator:
                 g = self.provider.node_group_for_node(r.node)
                 if g is None:
                     raise NodeGroupError("no node group")
-                g.delete_nodes([r.node])
+                if force:
+                    g.force_delete_nodes([r.node])
+                else:
+                    g.delete_nodes([r.node])
                 self.tracker.finish(r.node.name, True)
                 if self.latency_tracker is not None:
                     self.latency_tracker.observe_deletion(r.node.name, now)

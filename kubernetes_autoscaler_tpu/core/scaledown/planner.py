@@ -327,6 +327,8 @@ class Planner:
         alloc = np.asarray(enc.nodes.alloc, dtype=np.float64)[:n_real].copy()
         reqs = np.asarray(enc.scheduled.req, dtype=np.float64)
         for j, p in enumerate(enc.scheduled_pods):
+            if p is None:  # freed slot (incremental encoder hole)
+                continue
             ni = enc.node_index.get(p.node_name, -1)
             if ni < 0 or ni >= n_real:
                 continue
@@ -386,6 +388,8 @@ class Planner:
                       & np.asarray(enc.nodes.schedulable))
         ds_by_node: dict[str, list[int]] = {}
         for j, p in enumerate(enc.scheduled_pods):
+            if p is None:  # freed slot (incremental encoder hole)
+                continue
             if p.is_daemonset():
                 ds_by_node.setdefault(p.node_name, []).append(j)
         ordered = sorted(self.state.unneeded, key=lambda n: self.unneeded_nodes.since.get(n, now))
@@ -486,6 +490,8 @@ class Planner:
             # oracle world for exact-checked moves (rebuilt per attempt)
             by_node: dict[str, list] = {}
             for q in enc.scheduled_pods:
+                if q is None:  # freed slot (incremental encoder hole)
+                    continue
                 by_node.setdefault(q.node_name, []).append(q)
             received_slots: dict[int, list[int]] = {}
             moved_marks: set[tuple[int, int]] = set()

@@ -155,7 +155,10 @@ class ScaleUpOrchestrator:
         )
         est = estimator.estimate_all_groups(enc.specs, group_tensors, nodes_count)
         scores = scoring.score_options(est, group_tensors, specs=enc.specs)
-        gpu_slot = enc.registry.try_slot_for(self.provider.gpu_resource_name())
+        # non-allocating lookup: try_slot_for would BURN one of the four
+        # extended slots for the GPU name even on GPU-less clusters (any
+        # GPU-bearing template/node already allocated it at encode time)
+        gpu_slot = enc.registry.slots.get(self.provider.gpu_resource_name())
         options = options_from_scores(scores, [g.id() for g in groups],
                                       groups=groups, gpu_slot=gpu_slot)
         options = self._verify_lossy_winners(
@@ -187,7 +190,7 @@ class ScaleUpOrchestrator:
             # similar-group balancing (reference: balanceScaleUps :652 via
             # BalancingNodeGroupSetProcessor) — split the winning delta
             # across groups similar to the winner.
-            plan = self._balance(best, groups, est)
+            plan = self._balance(best, groups, est, enc)
 
         # quota caps (reference: applyLimits :205-217)
         plan = self._apply_quota(plan, groups, enc)
@@ -226,7 +229,7 @@ class ScaleUpOrchestrator:
         # than shipped unverified (reference: BinpackingLimiter stops
         # computing further options)
         deadline = time.monotonic() + self.options.max_binpacking_time_s
-        gpu_slot = enc.registry.try_slot_for(self.provider.gpu_resource_name())
+        gpu_slot = enc.registry.slots.get(self.provider.gpu_resource_name())
         out = []
         for opt in options:
             g_t = groups[opt.group_index].template_node_info()
@@ -272,11 +275,13 @@ class ScaleUpOrchestrator:
 
     # ---- similar-group balancing (reference: compare_nodegroups.go:105) ----
 
-    def _balance(self, best: Option, groups: list[NodeGroup], est) -> dict[str, int]:
+    def _balance(self, best: Option, groups: list[NodeGroup], est,
+                 enc=None) -> dict[str, int]:
         if not self.options.balance_similar_node_groups:
             return {best.group_id: best.node_count}
         target = groups[best.group_index]
         tmpl = target.template_node_info()
+        free = _group_exemplar_free(enc, groups) if enc is not None else {}
         similar = [target]
         for i, g in enumerate(groups):
             if g.id() == target.id():
@@ -284,7 +289,9 @@ class ScaleUpOrchestrator:
             if self._ng_opts(g).zero_or_max_node_scaling:
                 continue  # an atomic sibling cannot absorb a partial split
             t = g.template_node_info()
-            if _similar_templates(tmpl, t, self.options) \
+            if _similar_templates(tmpl, t, self.options,
+                                  free_a=free.get(target.id()),
+                                  free_b=free.get(g.id())) \
                     and g.target_size() < g.max_size():
                 similar.append(g)
         total = best.node_count
@@ -396,12 +403,32 @@ class ScaleUpOrchestrator:
         return result
 
 
-def _similar_templates(a, b, options: AutoscalingOptions | None = None) -> bool:
+def _group_exemplar_free(enc, groups) -> dict[str, "np.ndarray"]:
+    """Per-group FREE resource vector from a live exemplar node (reference:
+    compare_nodegroups.go:109-121 builds free = allocatable - requested from
+    the groups' exemplar NodeInfos). Groups without a registered node have
+    no exemplar — free comparison is skipped for them (a template is empty
+    by construction, so template-vs-template free degenerates to allocatable,
+    which is already compared)."""
+    gid_arr = np.asarray(enc.nodes.group_id)
+    valid = np.asarray(enc.nodes.valid)
+    free_all = np.asarray(enc.nodes.cap) - np.asarray(enc.nodes.alloc)
+    out: dict[str, np.ndarray] = {}
+    for i, g in enumerate(groups):
+        rows = np.nonzero(valid & (gid_arr == i))[0]
+        if rows.size:
+            out[g.id()] = free_all[rows[0]]
+    return out
+
+
+def _similar_templates(a, b, options: AutoscalingOptions | None = None,
+                       free_a=None, free_b=None) -> bool:
     """Reference similarity: capacity within --max-allocatable-difference-ratio
-    (memory within --memory-difference-ratio), same labels ignoring
-    zone/hostname plus --balancing-ignore-label entries; --balancing-label
-    switches to comparing ONLY the listed labels
-    (processors/nodegroupset/compare_nodegroups.go:105 + flags)."""
+    (memory within --memory-difference-ratio), exemplar FREE resources within
+    --max-free-difference-ratio, same labels ignoring zone/hostname plus
+    --balancing-ignore-label entries; --balancing-label switches to comparing
+    ONLY the listed labels
+    (processors/nodegroupset/compare_nodegroups.go:100-153 + flags)."""
     IGNORE = {"kubernetes.io/hostname", "topology.kubernetes.io/zone",
               "failure-domain.beta.kubernetes.io/zone"}
     ratio = options.max_allocatable_difference_ratio if options else 0.05
@@ -420,6 +447,12 @@ def _similar_templates(a, b, options: AutoscalingOptions | None = None) -> bool:
         limit = mem_ratio if k == "memory" else ratio
         if hi > 0 and abs(ca[k] - cb[k]) / hi > limit:
             return False
+    if free_a is not None and free_b is not None and options is not None:
+        free_ratio = options.max_free_difference_ratio
+        for fa, fb in zip(free_a.tolist(), free_b.tolist()):
+            hi = max(fa, fb)
+            if hi > 0 and abs(fa - fb) / hi > free_ratio:
+                return False
     if options and options.balancing_labels:
         keys = options.balancing_labels
         return all(a.labels.get(k) == b.labels.get(k) for k in keys)

@@ -166,6 +166,9 @@ class StaticAutoscaler:
         # one-time crash recovery on the first loop (reference:
         # cleanUpIfRequired static_autoscaler.go:258 + planner.go:91-93)
         self._startup_recovery_done = False
+        # incremental snapshot maintenance (models/incremental.py); created
+        # lazily so DrainOptions reflect the live flag values
+        self._encoder = None
 
         # ProvisioningRequest wiring (reference: builder/autoscaler.go wraps
         # the scale-up orchestrator when ProvReq support is on) — active when
@@ -316,23 +319,46 @@ class StaticAutoscaler:
 
                 apply_csi(nodes, pods, csi_snapshot_fn())
 
-            # tensor snapshot
+            # tensor snapshot — incrementally maintained across loops by
+            # default (models/incremental.py; reference rationale:
+            # DeltaSnapshotStore, store/delta.go:33-54), full re-encode when
+            # --incremental-encode=false
             node_group_ids = self._node_group_index(nodes)
+            drain_opts = DrainOptions(
+                skip_nodes_with_system_pods=self.options.skip_nodes_with_system_pods,
+                skip_nodes_with_local_storage=self.options.skip_nodes_with_local_storage,
+                skip_nodes_with_custom_controller_pods=self.options.skip_nodes_with_custom_controller_pods,
+                min_replica_count=self.options.min_replica_count,
+            )
+            pdb_names = self.pdb_tracker.namespaced_names_with_pdb(
+                [p for p in pods if p.node_name]
+            )
             with self.metrics.time_function("snapshot_build"):
-                enc = encode_cluster(
-                    nodes, pods,
-                    node_group_ids=node_group_ids,
-                    node_bucket=self.options.node_shape_bucket,
-                    group_bucket=self.options.group_shape_bucket,
-                )
-                apply_drainability(enc, DrainOptions(
-                    skip_nodes_with_system_pods=self.options.skip_nodes_with_system_pods,
-                    skip_nodes_with_local_storage=self.options.skip_nodes_with_local_storage,
-                    skip_nodes_with_custom_controller_pods=self.options.skip_nodes_with_custom_controller_pods,
-                ), now=now,
-                    pdb_namespaced_names=self.pdb_tracker.namespaced_names_with_pdb(
-                        [p for p in pods if p.node_name]
-                    ))
+                if self.options.incremental_encode:
+                    if self._encoder is None or \
+                            self._encoder.drain_opts != drain_opts:
+                        from kubernetes_autoscaler_tpu.models.incremental import (
+                            IncrementalEncoder,
+                        )
+
+                        self._encoder = IncrementalEncoder(
+                            node_bucket=self.options.node_shape_bucket,
+                            group_bucket=self.options.group_shape_bucket,
+                            drain_opts=drain_opts,
+                            resync_loops=self.options.incremental_resync_loops,
+                        )
+                    enc = self._encoder.encode(
+                        nodes, pods, node_group_ids=node_group_ids,
+                        now=now, pdb_namespaced_names=frozenset(pdb_names))
+                else:
+                    enc = encode_cluster(
+                        nodes, pods,
+                        node_group_ids=node_group_ids,
+                        node_bucket=self.options.node_shape_bucket,
+                        group_bucket=self.options.group_shape_bucket,
+                    )
+                    apply_drainability(enc, drain_opts, now=now,
+                                       pdb_namespaced_names=pdb_names)
             if self.quota is not None:
                 self.quota.registry = enc.registry
             self.scale_up_orchestrator.quota = self.quota
@@ -430,10 +456,12 @@ class StaticAutoscaler:
                 self.metrics.gauge("unneeded_nodes_count").set(
                     len(status.unneeded_nodes)
                 )
-                to_remove = self.planner.nodes_to_delete(enc, nodes, now)
+                with self.metrics.time_function("scale_down_confirm"):
+                    to_remove = self.planner.nodes_to_delete(enc, nodes, now)
                 if to_remove:
                     pods_by_slot = {
                         j: p for j, p in enumerate(enc.scheduled_pods)
+                        if p is not None  # incremental-encoder slot holes
                     }
                     # group membership resolved BEFORE deletion unmaps the node
                     group_of = {}
@@ -481,6 +509,7 @@ class StaticAutoscaler:
             self.last_status = build_status(
                 self.cluster_state, now,
                 scale_down_candidates=status.unneeded_nodes,
+                config_map_name=self.options.status_config_map_name,
             )
             if self.status_sink is not None and self.options.write_status_configmap:
                 try:
@@ -662,13 +691,32 @@ class StaticAutoscaler:
         return True
 
     def _clean_long_unregistered(self, now: float) -> None:
+        """reference: removeOldUnregisteredNodes (static_autoscaler.go:976):
+        without --force-delete-unregistered-nodes, removal is capped by group
+        min size; with it, min size is ignored and the provider's forceful
+        path is used (ForceDeleteNodes, :1018 — base impl falls back to
+        DeleteNodes)."""
+        by_group: dict[str, list] = {}
         for u in self.cluster_state.long_unregistered(now):
-            g = next((x for x in self.provider.node_groups() if x.id() == u.group_id), None)
+            by_group.setdefault(u.group_id, []).append(u)
+        for gid, us in by_group.items():
+            g = next((x for x in self.provider.node_groups() if x.id() == gid),
+                     None)
             if g is None:
                 continue
+            if not self.options.force_delete_unregistered_nodes:
+                possible = g.target_size() - g.min_size()
+                if possible <= 0:
+                    continue
+                us = us[:possible]
             try:
-                g.delete_nodes([Node(name=u.name)])
-                self.metrics.counter("old_unregistered_nodes_removed_count").inc()
+                nodes = [Node(name=u.name) for u in us]
+                if self.options.force_delete_unregistered_nodes:
+                    g.force_delete_nodes(nodes)
+                else:
+                    g.delete_nodes(nodes)
+                self.metrics.counter(
+                    "old_unregistered_nodes_removed_count").inc(len(us))
             except Exception:
                 pass
 

@@ -95,7 +95,7 @@ def emit_cluster_metrics(registry, cluster_state, provider, options, enc,
     registry.gauge("nodes_count").set(float(t.unready), state="unready")
     registry.gauge("nodes_count").set(float(t.not_started), state="notStarted")
     n_tainted = sum(
-        1 for nd in enc.node_objs for t in nd.taints
+        1 for nd in enc.node_objs if nd is not None for t in nd.taints
     ) if enc.node_objs else 0
     registry.gauge("node_taints_count").set(float(n_tainted), type="any")
     if health is not None:

@@ -122,6 +122,9 @@ class Pod:
     node_name: str = ""                                       # scheduled destination ("" = pending)
     phase: str = "Pending"                                    # Pending|Running|Succeeded|Failed
     deletion_timestamp: Optional[float] = None
+    # spec.terminationGracePeriodSeconds (None = kubelet default 30 s); the
+    # actuator caps it by --max-graceful-termination-sec at eviction time
+    termination_grace_s: Optional[float] = None
     restart_policy: str = "Always"
     volumes_with_local_storage: int = 0                       # emptyDir/hostPath count (drain rule)
     pvc_refs: tuple[str, ...] = ()

@@ -321,6 +321,86 @@ def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
     return enc
 
 
+def resident_plane_hits(
+    enc_row: _PodSpecEncoding, q: Pod
+) -> tuple[int, int, int, int]:
+    """One resident pod's contribution to group `enc_row`'s constraint planes:
+    (aff_cnt, anti_host_cnt, anti_zone_cnt, spread_cnt) 0/1 hits. Shared by
+    the full encode (summed over all residents) and the incremental encoder
+    (applied as ±1 deltas on resident add/remove)."""
+    ex = enc_row.exemplar
+    if ex is None:
+        return (0, 0, 0, 0)
+    aff = int(enc_row.aff_term is not None
+              and term_matches_pod(enc_row.aff_term, ex, q))
+    anti_h = int(any(term_matches_pod(t, ex, q) for t in enc_row.anti_host_terms))
+    anti_z = int(any(term_matches_pod(t, ex, q) for t in enc_row.anti_zone_terms))
+    spread = int(enc_row.spread_selector is not None
+                 and q.namespace == ex.namespace
+                 and labels_match(enc_row.spread_selector, q.labels))
+    return (aff, anti_h, anti_z, spread)
+
+
+def cross_group_hostcheck(
+    row_encodings: list[tuple[np.ndarray, _PodSpecEncoding]],
+    pending_rows: list[int],
+) -> set[int]:
+    """Rows whose constraint selectors match pods of a DIFFERENT pending group:
+    their placements couple mid-pack, which the device does not model →
+    host-check tier. Shared by encode_cluster and the incremental encoder."""
+    out: set[int] = set()
+    for grow in pending_rows:
+        enc_g = row_encodings[grow][1]
+        ex_g = enc_g.exemplar
+        if ex_g is None:
+            continue
+        selectors: list[tuple[AffinityTerm | None, dict[str, str] | None]] = []
+        if enc_g.spread_kind:
+            selectors.append((None, enc_g.spread_selector))
+        selectors.extend(
+            (t, None) for t in enc_g.anti_host_terms + enc_g.anti_zone_terms)
+        if enc_g.aff_term is not None and not enc_g.aff_self:
+            # positive affinity satisfiable only by ANOTHER pending group's
+            # placements: not modeled on device → host-check tier
+            selectors.append((enc_g.aff_term, None))
+        if not selectors:
+            continue
+        for hrow in pending_rows:
+            if hrow == grow:
+                continue
+            ex_h = row_encodings[hrow][1].exemplar
+            if ex_h is None:
+                continue
+            for term, sel in selectors:
+                if term is not None:
+                    hit = term_matches_pod(term, ex_g, ex_h)
+                else:
+                    hit = (ex_h.namespace == ex_g.namespace
+                           and labels_match(sel or {}, ex_h.labels))
+                if hit:
+                    out.add(grow)
+                    break
+            if grow in out:
+                break
+    return out
+
+
+def apply_zone_overflow(enc: _PodSpecEncoding, zones_fit: bool) -> None:
+    """When the cluster has more zones than Dims.max_zones, zone-scoped
+    constraints cannot ride the dense planes: drop the zone coupling and flag
+    host-check (the oracle is exact there). Shared with the incremental path."""
+    uses_zones = (enc.spread_kind == 2 or enc.aff_kind == 2
+                  or enc.anti_self_zone or enc.anti_zone_terms)
+    if uses_zones and not zones_fit:
+        enc.lossy = True
+        if enc.spread_kind == 2:
+            enc.spread_kind = 0
+        if enc.aff_kind == 2:
+            enc.aff_kind = 0
+        enc.anti_self_zone = False
+        enc.anti_zone_terms = []
+
+
 def equivalence_key(pod: Pod) -> int:
     """Pods with equal keys are schedulable-equivalent (reference:
     core/scaleup/equivalence/groups.go:40 — controller UID + drop-irrelevant-
@@ -413,13 +493,21 @@ class EncodedCluster:
                                     # constraint (selects the constrained
                                     # kernel variants — a STATIC choice)
     node_objs: list[Node] = field(default_factory=list)
+    # pre-device numpy arrays, keyed "section.field" — kept so the incremental
+    # encoder (models/incremental.py) can seed its mirrors without a device
+    # round-trip (device readback over the TPU tunnel is ~70 ms/sync)
+    host_arrays: dict | None = None
 
     def all_nodes_and_pods(self) -> tuple[list[Node], dict[str, list[Pod]]]:
-        """Host view for the exact oracle (utils/oracle.check_pod_in_cluster)."""
+        """Host view for the exact oracle (utils/oracle.check_pod_in_cluster).
+
+        None entries are slot/row holes left by the incremental encoder
+        (freed scheduled slots / removed nodes) — skipped."""
         by_node: dict[str, list[Pod]] = {}
         for p in self.scheduled_pods:
-            by_node.setdefault(p.node_name, []).append(p)
-        return list(self.node_objs), by_node
+            if p is not None and p.node_name:
+                by_node.setdefault(p.node_name, []).append(p)
+        return [nd for nd in self.node_objs if nd is not None], by_node
 
 
 def encode_cluster(
@@ -567,16 +655,7 @@ def encode_cluster(
         g_ports[row] = enc.port_hash
         g_anti_self[row] = enc.anti_affinity_self
         g_valid[row] = True
-        uses_zones = (enc.spread_kind == 2 or enc.aff_kind == 2
-                      or enc.anti_self_zone or enc.anti_zone_terms)
-        if uses_zones and not zones_fit:
-            enc.lossy = True
-            if enc.spread_kind == 2:
-                enc.spread_kind = 0
-            if enc.aff_kind == 2:
-                enc.aff_kind = 0
-            enc.anti_self_zone = False
-            enc.anti_zone_terms = []
+        apply_zone_overflow(enc, zones_fit)
         g_spread_kind[row] = enc.spread_kind
         g_max_skew[row] = enc.max_skew
         g_spread_self[row] = enc.spread_self
@@ -590,38 +669,8 @@ def encode_cluster(
     # change g's constraint state mid-pack) -> host-check tier. ----
     pending_rows = [row for row in range(len(row_encodings))
                     if row_pending_count[row] > 0]
-    for grow in pending_rows:
-        enc_g = row_encodings[grow][1]
-        ex_g = enc_g.exemplar
-        if ex_g is None:
-            continue
-        selectors: list[tuple[AffinityTerm | None, dict[str, str] | None]] = []
-        if enc_g.spread_kind:
-            selectors.append((None, enc_g.spread_selector))
-        selectors.extend((t, None) for t in enc_g.anti_host_terms + enc_g.anti_zone_terms)
-        if enc_g.aff_term is not None and not enc_g.aff_self:
-            # positive affinity satisfiable only by ANOTHER pending group's
-            # placements: not modeled on device → host-check tier
-            selectors.append((enc_g.aff_term, None))
-        if not selectors:
-            continue
-        for hrow in pending_rows:
-            if hrow == grow:
-                continue
-            ex_h = row_encodings[hrow][1].exemplar
-            if ex_h is None:
-                continue
-            for term, sel in selectors:
-                if term is not None:
-                    hit = term_matches_pod(term, ex_g, ex_h)
-                else:
-                    hit = (ex_h.namespace == ex_g.namespace
-                           and labels_match(sel or {}, ex_h.labels))
-                if hit:
-                    g_hostcheck[grow] = True
-                    break
-            if g_hostcheck[grow]:
-                break
+    for grow in cross_group_hostcheck(row_encodings, pending_rows):
+        g_hostcheck[grow] = True
 
     # ---- resident-derived constraint planes ----
     constrained_rows = [
@@ -637,23 +686,36 @@ def encode_cluster(
         for q in resident:
             ni = node_index[q.node_name]
             for row in constrained_rows:
-                enc_row = row_encodings[row][1]
-                ex = enc_row.exemplar
-                if ex is None:
-                    continue
-                if enc_row.aff_term is not None and term_matches_pod(
-                        enc_row.aff_term, ex, q):
-                    p_aff[row, ni] += 1
-                if any(term_matches_pod(t, ex, q) for t in enc_row.anti_host_terms):
-                    p_anti_host[row, ni] += 1
-                if any(term_matches_pod(t, ex, q) for t in enc_row.anti_zone_terms):
-                    p_anti_zone[row, ni] += 1
-                if (enc_row.spread_selector is not None
-                        and q.namespace == ex.namespace
-                        and labels_match(enc_row.spread_selector, q.labels)):
-                    p_spread[row, ni] += 1
+                aff, anti_h, anti_z, spread = resident_plane_hits(
+                    row_encodings[row][1], q)
+                p_aff[row, ni] += aff
+                p_anti_host[row, ni] += anti_h
+                p_anti_zone[row, ni] += anti_z
+                p_spread[row, ni] += spread
         g_aff_any[:] = p_aff.sum(axis=1) > 0
     has_constraints = bool(constrained_rows)
+
+    host_arrays = {
+        "nodes.cap": cap, "nodes.alloc": alloc, "nodes.label_hash": label_hash,
+        "nodes.taint_exact": taint_exact, "nodes.taint_key": taint_key,
+        "nodes.used_ports": used_ports, "nodes.zone_id": zone_id,
+        "nodes.group_id": group_id, "nodes.ready": ready,
+        "nodes.schedulable": schedulable, "nodes.valid": valid,
+        "specs.req": g_req, "specs.count": g_count, "specs.sel_req": g_sel_req,
+        "specs.sel_neg": g_sel_neg, "specs.tol_exact": g_tol_exact,
+        "specs.tol_key": g_tol_key, "specs.tolerate_all": g_tol_all,
+        "specs.port_hash": g_ports, "specs.anti_affinity_self": g_anti_self,
+        "specs.valid": g_valid, "specs.needs_host_check": g_hostcheck,
+        "specs.spread_kind": g_spread_kind, "specs.max_skew": g_max_skew,
+        "specs.spread_self": g_spread_self, "specs.aff_kind": g_aff_kind,
+        "specs.aff_self": g_aff_self, "specs.aff_match_any": g_aff_any,
+        "specs.anti_self_zone": g_anti_self_zone,
+        "scheduled.req": s_req, "scheduled.node_idx": s_node,
+        "scheduled.group_ref": s_group, "scheduled.movable": s_movable,
+        "scheduled.blocks": s_blocks, "scheduled.valid": s_valid,
+        "planes.aff_cnt": p_aff, "planes.anti_host_cnt": p_anti_host,
+        "planes.anti_zone_cnt": p_anti_zone, "planes.spread_cnt": p_spread,
+    }
 
     return EncodedCluster(
         nodes=_device(NodeTensors(
@@ -688,6 +750,7 @@ def encode_cluster(
         )),
         has_constraints=has_constraints,
         node_objs=list(nodes),
+        host_arrays=host_arrays,
     )
 
 

@@ -43,6 +43,9 @@ class DrainOptions:
     skip_nodes_with_system_pods: bool = True
     skip_nodes_with_local_storage: bool = True
     skip_nodes_with_custom_controller_pods: bool = False
+    # reference: rules/replicacount — a replicated pod whose controller runs
+    # fewer than this many replicas blocks the drain (--min-replica-count)
+    min_replica_count: int = 0
 
     # namespaces whose pods are "system" for the system rule
     system_namespace: str = "kube-system"
@@ -56,6 +59,7 @@ def classify_pod(
     opts: DrainOptions = DrainOptions(),
     now: float | None = None,
     has_pdb: bool = False,
+    owner_replicas: int | None = None,
 ) -> Verdict:
     """Ordered rule chain; first decisive rule wins (reference rules.go order)."""
     now = time.time() if now is None else now
@@ -92,6 +96,13 @@ def classify_pod(
         # custom-controller pods block unless the operator opted out
         return Verdict.BLOCK
 
+    # replicacount rule: a controller running below --min-replica-count
+    # cannot spare a disruption (reference: rules/replicacount/rule.go —
+    # desired replicas approximated by the controller's live pod count)
+    if (opts.min_replica_count > 0 and owner_replicas is not None
+            and owner_replicas < opts.min_replica_count):
+        return Verdict.BLOCK
+
     # system rule: kube-system pods without a PDB block (reference: rules/system)
     if (
         opts.skip_nodes_with_system_pods
@@ -107,6 +118,19 @@ def classify_pod(
     return Verdict.DRAIN
 
 
+def owner_replica_counts(*pod_lists) -> dict[str, int]:
+    """Live pod count per controller uid (the observed stand-in for the
+    controller's desired replicas, reference rules/replicacount)."""
+    counts: dict[str, int] = {}
+    for pods in pod_lists:
+        for p in pods:
+            if p is None or p.owner is None or p.phase in ("Succeeded",
+                                                           "Failed"):
+                continue
+            counts[p.owner.uid] = counts.get(p.owner.uid, 0) + 1
+    return counts
+
+
 def apply_drainability(enc, opts: DrainOptions = DrainOptions(),
                        now: float | None = None, pdb_namespaced_names=frozenset()):
     """Populate ScheduledPodTensors.movable/blocks on an EncodedCluster in place."""
@@ -115,14 +139,22 @@ def apply_drainability(enc, opts: DrainOptions = DrainOptions(),
 
     movable = np.zeros((enc.scheduled.p,), bool)
     blocks = np.zeros((enc.scheduled.p,), bool)
+    owner_counts = owner_replica_counts(
+        enc.scheduled_pods, enc.pending_pods) \
+        if opts.min_replica_count > 0 else {}
     for j, pod in enumerate(enc.scheduled_pods):
         v = classify_pod(
             pod, opts, now=now,
             has_pdb=f"{pod.namespace}/{pod.name}" in pdb_namespaced_names,
+            owner_replicas=(owner_counts.get(pod.owner.uid)
+                            if pod.owner is not None else None),
         )
         movable[j] = v is Verdict.DRAIN
         blocks[j] = v is Verdict.BLOCK
     enc.scheduled = enc.scheduled.replace(
         movable=jnp.asarray(movable), blocks=jnp.asarray(blocks)
     )
+    if enc.host_arrays is not None:  # keep the host mirror coherent
+        enc.host_arrays["scheduled.movable"] = movable
+        enc.host_arrays["scheduled.blocks"] = blocks
     return enc

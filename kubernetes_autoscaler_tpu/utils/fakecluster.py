@@ -43,6 +43,7 @@ class FakeCluster:
         self._csi = None
         self.provision_delay_s = provision_delay_s
         self.evicted: list[str] = []
+        self.eviction_graces: dict[str, float | None] = {}
         self._pending: list[_PendingProvision] = []
         self._seq = itertools.count()
         self._now = 0.0
@@ -157,8 +158,10 @@ class FakeCluster:
 
     # ---- EvictionSink ----
 
-    def evict(self, pod: Pod, node: Node) -> None:
+    def evict(self, pod: Pod, node: Node,
+              grace_period_s: float | None = None) -> None:
         self.evicted.append(pod.name)
+        self.eviction_graces[pod.name] = grace_period_s
         live = self.pods.get(f"{pod.namespace}/{pod.name}")
         if live is not None:
             live.node_name = ""
@@ -182,6 +185,9 @@ class FakeCluster:
 
     def add_pod(self, pod: Pod) -> None:
         self.pods[f"{pod.namespace}/{pod.name}"] = pod
+
+    def remove_pod(self, name: str, namespace: str = "default") -> None:
+        self.pods.pop(f"{namespace}/{name}", None)
 
     def bind(self, pod_name: str, node_name: str, namespace: str = "default") -> None:
         p = self.pods[f"{namespace}/{pod_name}"]

@@ -1,0 +1,201 @@
+"""Eviction execution semantics (round-3 review item #3).
+
+Reference: core/scaledown/actuation/drain.go —
+  * per-pod grace period capped by --max-graceful-termination-sec (:243-249)
+  * retry-until-deadline eviction, --max-pod-eviction-time window, retrying
+    every EvictionRetryTime (:185 retryUntil, :240 loop)
+  * post-eviction wait for pods to actually terminate (allGone polling)
+  * forced deletion bypassing PDBs + force-deleting stuck pods + provider
+    ForceDeleteNodes (StartForceDeletion actuator.go:126,
+    group_deletion_scheduler.go:105)
+  * --force-delete-unregistered-nodes (static_autoscaler.go:990,1018)
+"""
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.scaledown.actuator import Actuator
+from kubernetes_autoscaler_tpu.core.scaledown.pdb import (
+    PodDisruptionBudget,
+    RemainingPdbTracker,
+)
+from kubernetes_autoscaler_tpu.core.scaledown.planner import NodeToRemove
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+class _FlakySink:
+    """Fails the first `fail_n` evictions of each pod, then succeeds."""
+
+    def __init__(self, fail_n=0, fail_forever=()):  # names that never evict
+        self.fail_n = fail_n
+        self.fail_forever = set(fail_forever)
+        self.attempts = {}
+        self.evicted = []
+        self.graces = {}
+        self.force_deleted = []
+
+    def evict(self, pod, node, grace_period_s=None):
+        n = self.attempts[pod.name] = self.attempts.get(pod.name, 0) + 1
+        if pod.name in self.fail_forever or n <= self.fail_n:
+            raise RuntimeError("PDB conflict (429)")
+        self.evicted.append(pod.name)
+        self.graces[pod.name] = grace_period_s
+
+    def force_delete(self, pod, node):
+        self.force_deleted.append(pod.name)
+
+
+def _world(n_pods=1, **pod_kw):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    node = build_test_node("victim-node", cpu_milli=4000, mem_mib=8192)
+    fake.add_existing_node("ng1", node)
+    pods = []
+    for i in range(n_pods):
+        p = build_test_pod(f"p{i}", cpu_milli=100, mem_mib=64,
+                           owner_name="rs", node_name="victim-node", **pod_kw)
+        fake.add_pod(p)
+        pods.append(p)
+    return fake, node, pods
+
+
+def _actuator(fake, sink, clock, **opt_kw):
+    opts = AutoscalingOptions(node_group_defaults=NodeGroupDefaults(),
+                              **opt_kw)
+    return Actuator(fake.provider, opts, sink, clock=clock, sleep=clock.sleep)
+
+
+def _remove(node, pods):
+    return [NodeToRemove(node=node, is_empty=not pods,
+                         pods_to_move=list(range(len(pods))),
+                         destinations={}, ds_to_evict=[])]
+
+
+def test_grace_period_capped_by_max_graceful_termination():
+    fake, node, pods = _world(n_pods=2)
+    pods[0].termination_grace_s = 900.0    # longer than the cap
+    pods[1].termination_grace_s = None     # kubelet default 30
+    sink = _FlakySink()
+    clock = _Clock()
+    a = _actuator(fake, sink, clock, max_graceful_termination_s=600.0)
+    res = a.start_deletion(_remove(node, pods),
+                           {i: p for i, p in enumerate(pods)}, now=0.0)
+    assert all(r.ok for r in res)
+    assert sink.graces["p0"] == 600.0      # capped
+    assert sink.graces["p1"] == 30.0       # pod default, under the cap
+
+
+def test_eviction_retries_until_success_within_deadline():
+    fake, node, pods = _world(n_pods=1)
+    sink = _FlakySink(fail_n=3)
+    clock = _Clock()
+    a = _actuator(fake, sink, clock, max_pod_eviction_time_s=120.0)
+    res = a.start_deletion(_remove(node, pods), {0: pods[0]}, now=0.0)
+    assert res[0].ok
+    assert sink.attempts["p0"] == 4
+    # retried on the reference cadence (10 s, drain.go:45)
+    assert sink.sleeps if hasattr(sink, "sleeps") else clock.sleeps[:3] == [
+        a.eviction_retry_time_s] * 3
+
+
+def test_eviction_gives_up_at_deadline_and_rolls_back():
+    from kubernetes_autoscaler_tpu.models.api import TO_BE_DELETED_TAINT
+
+    fake, node, pods = _world(n_pods=1)
+    sink = _FlakySink(fail_forever={"p0"})
+    clock = _Clock()
+    a = _actuator(fake, sink, clock, max_pod_eviction_time_s=60.0)
+    res = a.start_deletion(_remove(node, pods), {0: pods[0]}, now=0.0)
+    assert not res[0].ok and "failed to evict" in res[0].reason
+    # bounded attempts: 1 + retries within the 60 s window at 10 s cadence
+    assert sink.attempts["p0"] <= 8
+    assert "victim-node" in fake.nodes            # node NOT deleted
+    assert all(t.key != TO_BE_DELETED_TAINT for t in node.taints)  # rollback
+
+
+def test_force_deletion_bypasses_pdbs_and_uses_force_delete_nodes():
+    fake, node, pods = _world(n_pods=1)
+    sink = _FlakySink(fail_forever={"p0"})     # eviction never succeeds
+    clock = _Clock()
+    tracker = RemainingPdbTracker([PodDisruptionBudget(
+        "pdb", match_labels={}, disruptions_allowed=0)])
+    forced = []
+    g = next(iter(fake.provider.node_groups()))
+    orig_force = g.force_delete_nodes
+    g.force_delete_nodes = lambda nodes: (forced.extend(n.name for n in nodes),
+                                          orig_force(nodes))[1]
+    a = Actuator(fake.provider,
+                 AutoscalingOptions(max_pod_eviction_time_s=30.0),
+                 sink, pdb_tracker=tracker, clock=clock, sleep=clock.sleep)
+    res = a.start_force_deletion(_remove(node, pods), {0: pods[0]}, now=0.0)
+    assert res[0].ok
+    assert sink.force_deleted == ["p0"]        # stuck pod force-deleted
+    assert forced == ["victim-node"]           # provider forceful path
+    assert "victim-node" not in fake.nodes
+
+
+def test_post_eviction_wait_times_out_when_pods_stick():
+    fake, node, pods = _world(n_pods=1)
+
+    class StickySink(_FlakySink):
+        def pods_gone(self, node_name, pod_names):
+            return False                       # pod ignores SIGTERM forever
+
+    sink = StickySink()
+    clock = _Clock()
+    a = _actuator(fake, sink, clock, max_graceful_termination_s=60.0)
+    res = a.start_deletion(_remove(node, pods), {0: pods[0]}, now=0.0)
+    assert not res[0].ok and "remaining" in res[0].reason
+    assert "victim-node" in fake.nodes
+    # waited ~grace + headroom before giving up
+    assert clock.t >= 60.0
+
+
+def test_force_delete_unregistered_nodes_flag():
+    from test_runonce import autoscaler_for
+
+    def world():
+        fake = FakeCluster()
+        tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+        fake.add_node_group("ng2", tmpl, min_size=1, max_size=10)
+        fake.add_existing_node("ng2", build_test_node(
+            "live-0", cpu_milli=4000, mem_mib=8192))
+        g = next(x for x in fake.provider.node_groups() if x.id() == "ng2")
+        g.add_unregistered_instance("ghost-0")
+        return fake, g
+
+    # without the flag: group min size caps removal (target==min → no room)
+    fake, g = world()
+    g._min = g._target = 1
+    a = autoscaler_for(fake)
+    a.run_once(now=1000.0)        # registers the ghost (since=1000)
+    a.run_once(now=2000.0)        # past the 900 s removal cutoff
+    assert "ghost-0" in {i.name for i in g.nodes()}   # capped, kept
+
+    fake, g = world()
+    g._min = g._target = 1
+    forced = []
+    orig = g.force_delete_nodes
+    g.force_delete_nodes = lambda ns: (forced.extend(n.name for n in ns),
+                                       orig(ns))[1]
+    b = autoscaler_for(fake, force_delete_unregistered_nodes=True)
+    b.run_once(now=1000.0)
+    b.run_once(now=2000.0)
+    assert forced == ["ghost-0"]                      # min size ignored
+    assert "ghost-0" not in {i.name for i in g.nodes()}

@@ -202,3 +202,83 @@ def test_grpc_expander_url_flag_dials_remote():
             "--grpc-expander-url must route the choice to the remote expander")
     finally:
         server.stop(0)
+
+
+def test_min_replica_count_blocks_small_controllers():
+    """--min-replica-count (reference rules/replicacount): a ReplicaSet
+    running fewer than N replicas blocks draining its node."""
+    def world():
+        fake = FakeCluster()
+        tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+        fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+        # a 2-replica controller, one pod per node: draining either node
+        # moves its pod to the other
+        for name in ("a", "b"):
+            fake.add_existing_node("ng1", build_test_node(
+                name, cpu_milli=4000, mem_mib=8192))
+            fake.add_pod(build_test_pod(f"p-{name}", cpu_milli=100, mem_mib=64,
+                                        owner_name="small-rs", node_name=name))
+        return fake
+
+    fake = world()
+    a = autoscaler_for(fake, node_group_defaults=IDLE_DEFAULTS)
+    st = a.run_once(now=1000.0)
+    assert st.scale_down_deleted      # a 2-replica controller drains fine
+
+    fake = world()
+    b = autoscaler_for(fake, min_replica_count=3,
+                       node_group_defaults=IDLE_DEFAULTS)
+    st = b.run_once(now=1000.0)
+    assert not st.scale_down_deleted  # 2 < 3 replicas: drain blocked
+    assert len(fake.nodes) == 2
+
+
+def test_max_node_startup_time_defers_unready_classification():
+    """--max-node-startup-time: an unready node inside the startup window is
+    notStarted (no health impact); past the window it turns unready."""
+    fake = _idle_world(1)
+    fake.nodes["idle-0"].ready = False
+    a = autoscaler_for(fake, max_node_startup_time_s=900.0,
+                       scale_down_enabled=False,
+                       node_group_defaults=IDLE_DEFAULTS)
+    a.run_once(now=1000.0)
+    t = a.cluster_state.total_readiness
+    assert (t.not_started, t.unready) == (1, 0)     # within the window
+    a.run_once(now=2000.0)
+    t = a.cluster_state.total_readiness
+    assert (t.not_started, t.unready) == (0, 1)     # window elapsed
+    # and a tight window flips immediately
+    fake = _idle_world(1)
+    fake.nodes["idle-0"].ready = False
+    b = autoscaler_for(fake, max_node_startup_time_s=0.0,
+                       scale_down_enabled=False,
+                       node_group_defaults=IDLE_DEFAULTS)
+    b.run_once(now=1000.0)
+    b.run_once(now=1001.0)
+    assert b.cluster_state.total_readiness.unready == 1
+
+
+def test_max_free_difference_ratio_gates_balancing():
+    """--max-free-difference-ratio: two label-identical groups whose live
+    exemplars differ in free capacity beyond the ratio must NOT balance."""
+    import numpy as np
+
+    from kubernetes_autoscaler_tpu.core.scaleup.orchestrator import (
+        _similar_templates,
+    )
+    from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
+
+    tmpl_a = build_test_node("ta", cpu_milli=4000, mem_mib=8192)
+    tmpl_b = build_test_node("tb", cpu_milli=4000, mem_mib=8192)
+    tmpl_a.labels.pop("kubernetes.io/hostname", None)
+    tmpl_b.labels.pop("kubernetes.io/hostname", None)
+    free_same = np.array([4000, 8192, 0, 110], np.int64)
+    free_far = np.array([400, 8192, 0, 110], np.int64)   # 10x busier
+    loose = AutoscalingOptions(max_free_difference_ratio=0.95)
+    tight = AutoscalingOptions(max_free_difference_ratio=0.05)
+    assert _similar_templates(tmpl_a, tmpl_b, tight,
+                              free_a=free_same, free_b=free_same)
+    assert not _similar_templates(tmpl_a, tmpl_b, tight,
+                                  free_a=free_same, free_b=free_far)
+    assert _similar_templates(tmpl_a, tmpl_b, loose,
+                              free_a=free_same, free_b=free_far)

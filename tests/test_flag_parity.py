@@ -114,3 +114,33 @@ def test_implemented_flags_reach_options():
     assert opts.cordon_node_before_terminating
     assert opts.max_gpu_total == 16
     assert opts.emit_per_nodegroup_metrics
+
+
+def test_every_implemented_flag_has_a_consumer_outside_config():
+    """Round-3 review Weak #1: the IMPLEMENTED bucket contained a lie
+    (max-graceful-termination-sec mapped to an option no code consumed).
+    This audit makes the whole class unrepresentable: every IMPLEMENTED
+    entry's option field must be referenced somewhere OUTSIDE config/ —
+    a flag that only round-trips parser→options is not implemented."""
+    import os
+    import re
+
+    pkg = os.path.join(os.path.dirname(flag_parity.__file__), "..")
+    sources = []
+    for root, _dirs, files in os.walk(pkg):
+        if os.path.basename(root) == "config" or "__pycache__" in root:
+            continue
+        for f in files:
+            if f.endswith((".py", ".cc")):
+                with open(os.path.join(root, f), encoding="utf-8") as fh:
+                    sources.append(fh.read())
+    blob = "\n".join(sources)
+
+    missing = []
+    for flag, mapping in flag_parity.IMPLEMENTED.items():
+        # mapping text is "field_name (optional commentary)"; possibly dotted
+        field = mapping.split()[0].split(",")[0]
+        leaf = field.split(".")[-1]
+        if not re.search(rf"\b{re.escape(leaf)}\b", blob):
+            missing.append((flag, field))
+    assert not missing, f"IMPLEMENTED flags with no consumer: {missing}"

@@ -1,0 +1,141 @@
+"""Loop-latency SLO proxy at the bench shape (5k nodes) on the CPU backend.
+
+Reference analog: the kubemark loop-latency target — ≤20 s per loop at 1000
+nodes (FAQ.md:166-171). Our north star is 50k pods × 5k nodes < 200 ms on
+TPU (BASELINE.json); the tunnel-independent regression guard here bounds the
+HOST-side share of the loop — tensor-snapshot maintenance (encode) and the
+scale-down confirmation pass — which is the same on CPU and TPU. Device
+kernel time is backend-dependent (seconds on the CPU backend, ms on TPU) and
+gets a generous gross-regression ceiling only.
+
+Budgets (steady-state loop, measured ~45 ms encode + ~100 ms confirm on the
+CI machine; asserted with ~4x headroom against noise):
+  snapshot_build   < 400 ms   (incremental maintenance; was 2.2 s/loop on
+                               real TPU in round 3 with from-scratch encode)
+  scale_down_confirm < 800 ms
+  whole RunOnce    < 60 s     (CPU-backend ceiling; catches runaway host loops)
+"""
+
+import time
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+N_NODES = 5000
+N_LOW_UTIL = 300       # scale-down candidates (bounds CPU device-sweep time)
+N_PENDING = 1500
+
+
+def _phase_sums(metrics):
+    h = metrics.histogram("function_duration_seconds")
+    return {k[0][1]: v for k, v in h._sums.items()}
+
+
+def test_runonce_host_side_budget_at_bench_shape():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=16000, mem_mib=65536, pods=110)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=4 * N_NODES)
+    for i in range(N_NODES):
+        nd = build_test_node(f"n{i}", cpu_milli=16000, mem_mib=65536, pods=110)
+        fake.add_existing_node("ng1", nd)
+        # high-utilization bulk + a low-utilization consolidation band
+        per_pod = 1600 if i < N_LOW_UTIL else 6400
+        for j in range(2):
+            fake.add_pod(build_test_pod(
+                f"r{i}-{j}", cpu_milli=per_pod, mem_mib=1024,
+                owner_name=f"rs{i % 17}", node_name=nd.name))
+    for i in range(N_PENDING):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=500, mem_mib=512,
+                                    owner_name=f"prs{i % 20}"))
+
+    opts = AutoscalingOptions(
+        node_shape_bucket=256, group_shape_bucket=64,
+        max_new_nodes_static=256, max_pods_per_node=16, drain_chunk=256,
+        scale_down_delay_after_add_s=0.0, scale_down_delay_after_failure_s=0.0,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=3600.0,  # plan, never actuate: steady
+            scale_down_unready_time_s=3600.0),
+    )
+    a = StaticAutoscaler(fake.provider, fake, options=opts, eviction_sink=fake)
+
+    a.run_once(now=1000.0)               # cold: compiles + seeds the encoder
+    before = _phase_sums(a.metrics)
+    t0 = time.perf_counter()
+    status = a.run_once(now=1010.0)      # steady state
+    loop_s = time.perf_counter() - t0
+    after = _phase_sums(a.metrics)
+
+    assert status.ran
+    # most of the band is planned (pending placements soak up its head)
+    assert len(status.unneeded_nodes) >= N_LOW_UTIL - 100
+    encode_s = after["snapshot_build"] - before["snapshot_build"]
+    confirm_s = (after.get("scale_down_confirm", 0.0)
+                 - before.get("scale_down_confirm", 0.0))
+    if encode_s >= 0.4 or confirm_s >= 0.8:
+        # one re-measure: a co-scheduled process can steal the CPU during a
+        # single loop; a genuine regression fails both measurements
+        before = _phase_sums(a.metrics)
+        a.run_once(now=1020.0)
+        after = _phase_sums(a.metrics)
+        encode_s = after["snapshot_build"] - before["snapshot_build"]
+        confirm_s = (after.get("scale_down_confirm", 0.0)
+                     - before.get("scale_down_confirm", 0.0))
+    assert encode_s < 0.4, f"steady-state encode {encode_s * 1e3:.0f}ms"
+    assert confirm_s < 0.8, f"steady-state confirm {confirm_s * 1e3:.0f}ms"
+    assert loop_s < 60.0, f"steady-state RunOnce {loop_s:.1f}s (CPU ceiling)"
+    # incremental path actually engaged (one seed, no silent resyncs)
+    assert a._encoder is not None and a._encoder.full_encodes == 1
+
+
+def test_runonce_steady_churn_host_budget():
+    """Same shape with per-loop churn (the production steady state): pods
+    come and go, a node appears — host share must stay bounded."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=16000, mem_mib=65536, pods=110)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=4 * N_NODES)
+    for i in range(N_NODES):
+        nd = build_test_node(f"n{i}", cpu_milli=16000, mem_mib=65536, pods=110)
+        fake.add_existing_node("ng1", nd)
+        for j in range(2):
+            fake.add_pod(build_test_pod(
+                f"r{i}-{j}", cpu_milli=6400, mem_mib=1024,
+                owner_name=f"rs{i % 17}", node_name=nd.name))
+    for i in range(N_PENDING):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=500, mem_mib=512,
+                                    owner_name=f"prs{i % 20}"))
+    opts = AutoscalingOptions(
+        node_shape_bucket=256, group_shape_bucket=64,
+        max_new_nodes_static=256, max_pods_per_node=16, drain_chunk=256,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=3600.0,
+            scale_down_unready_time_s=3600.0),
+    )
+    a = StaticAutoscaler(fake.provider, fake, options=opts, eviction_sink=fake)
+    a.run_once(now=1000.0)
+    # churn: 200 pending deleted, 200 added, 30 rebinds — then two loops so
+    # the second hits every compile/scatter cache
+    for k in range(200):
+        fake.remove_pod(f"p{k}")
+        fake.add_pod(build_test_pod(f"q{k}", cpu_milli=500, mem_mib=512,
+                                    owner_name=f"prs{k % 20}"))
+    a.run_once(now=1010.0)
+    for k in range(200, 400):
+        fake.remove_pod(f"p{k}")
+        fake.add_pod(build_test_pod(f"q{k}", cpu_milli=500, mem_mib=512,
+                                    owner_name=f"prs{k % 20}"))
+    before = _phase_sums(a.metrics)
+    a.run_once(now=1020.0)
+    after = _phase_sums(a.metrics)
+    encode_s = after["snapshot_build"] - before["snapshot_build"]
+    if encode_s >= 0.4:  # one re-measure under CPU contention (see above)
+        before = _phase_sums(a.metrics)
+        a.run_once(now=1030.0)
+        after = _phase_sums(a.metrics)
+        encode_s = after["snapshot_build"] - before["snapshot_build"]
+    assert encode_s < 0.4, f"churn-loop encode {encode_s * 1e3:.0f}ms"
+    assert a._encoder.full_encodes == 1
