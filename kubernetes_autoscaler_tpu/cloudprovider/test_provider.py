@@ -75,24 +75,23 @@ class TestNodeGroup(NodeGroup):
         if self._target - len(nodes) < self._min:
             raise NodeGroupError("delete_nodes: would go below min size")
         for nd in nodes:
-            if self._provider.on_scale_down:
-                self._provider.on_scale_down(self._id, nd.name)
-            self._provider.remove_node(self._id, nd.name)
-            # deleting a never-registered instance clears its cloud-side
-            # record too (otherwise a reaped create-error instance would be
-            # re-reaped — and the target re-decremented — every loop)
-            self._instances = [i for i in self._instances if i.name != nd.name]
-            self._target -= 1
+            self._remove_one(nd)
 
     def force_delete_nodes(self, nodes: list[Node]) -> None:
         """Forceful path: bypasses the min-size guard (reference
         ForceDeleteNodes bypasses termination protections)."""
         for nd in nodes:
-            if self._provider.on_scale_down:
-                self._provider.on_scale_down(self._id, nd.name)
-            self._provider.remove_node(self._id, nd.name)
-            self._instances = [i for i in self._instances if i.name != nd.name]
-            self._target -= 1
+            self._remove_one(nd)
+
+    def _remove_one(self, nd: Node) -> None:
+        if self._provider.on_scale_down:
+            self._provider.on_scale_down(self._id, nd.name)
+        self._provider.remove_node(self._id, nd.name)
+        # deleting a never-registered instance clears its cloud-side
+        # record too (otherwise a reaped create-error instance would be
+        # re-reaped — and the target re-decremented — every loop)
+        self._instances = [i for i in self._instances if i.name != nd.name]
+        self._target -= 1
 
     def decrease_target_size(self, delta: int) -> None:
         if delta >= 0:

@@ -100,6 +100,12 @@ class AutoscalingOptions:
     # per-pod eviction retry window (reference: --max-pod-eviction-time, 2m;
     # drain.go:185 retryUntil)
     max_pod_eviction_time_s: float = 120.0
+    # run evict+delete on a background executor so eviction retries never
+    # block the control loop (the reference ALWAYS detaches —
+    # deleteNodesAsync goroutines, actuator.go:287; default off here because
+    # synchronous in-process sinks complete instantly and tests read results
+    # from the same loop)
+    async_node_deletion: bool = False
     # long-unregistered instances: use NodeGroup.force_delete_nodes and
     # ignore group min size (reference: --force-delete-unregistered-nodes,
     # static_autoscaler.go:990,1018)

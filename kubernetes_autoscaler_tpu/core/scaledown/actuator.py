@@ -105,6 +105,7 @@ class Actuator:
         latency_tracker=None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        on_result: Callable[[DeletionResult], None] | None = None,
     ):
         self.provider = provider
         self.options = options
@@ -118,6 +119,10 @@ class Actuator:
         self.eviction_retry_time_s = DEFAULT_EVICTION_RETRY_TIME_S
         self.pod_eviction_headroom_s = DEFAULT_POD_EVICTION_HEADROOM_S
         self._sink_takes_grace: bool | None = None  # resolved on first evict
+        # detached-deletion support (reference: deleteNodesAsync goroutines,
+        # actuator.go:287 — deletions never block the control loop there)
+        self.on_result = on_result
+        self._bg: concurrent.futures.ThreadPoolExecutor | None = None
 
     # ---- eviction with retry (reference: drain.go evictPod :240) ----
 
@@ -230,8 +235,17 @@ class Actuator:
         to_remove: list[NodeToRemove],
         pods_by_slot: dict[int, Pod] | None = None,
         now: float | None = None,
+        detach: bool = False,
     ) -> list[DeletionResult]:
-        return self._start_deletion(to_remove, pods_by_slot, now, force=False)
+        """detach=True runs the evict+delete work on a background executor
+        (the reference's deleteNodesAsync goroutines, actuator.go:287): the
+        call taints the nodes and returns [] immediately; completed results
+        flow through the tracker and the on_result callback. Synchronous
+        mode (default) blocks until every node resolves — eviction retries
+        can then hold RunOnce for up to --max-pod-eviction-time per pod,
+        which is only acceptable with an in-process synchronous sink."""
+        return self._start_deletion(to_remove, pods_by_slot, now, force=False,
+                                    detach=detach)
 
     def start_force_deletion(
         self,
@@ -251,18 +265,53 @@ class Actuator:
         pods_by_slot: dict[int, Pod] | None,
         now: float | None,
         force: bool,
+        detach: bool = False,
     ) -> list[DeletionResult]:
         now = time.time() if now is None else now
+        if detach:
+            # taints must land synchronously — the NEXT loop's planner and
+            # filter-out-schedulable must see the nodes as leaving
+            for r in to_remove:
+                if self.options.cordon_node_before_terminating:
+                    r.node.unschedulable = True
+                self.taint_to_be_deleted(r.node)
+                self.tracker.start(r.node.name, now)
+            if self._bg is None:
+                self._bg = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=max(self.options.max_scale_down_parallelism,
+                                    1),
+                    thread_name_prefix="ka-delete")
+
+            def run():
+                results = self._execute_deletion(
+                    to_remove, pods_by_slot, now, force, pre_tainted=True)
+                if self.on_result is not None:
+                    for res in results:
+                        self.on_result(res)
+
+            self._bg.submit(run)
+            return []
+        return self._execute_deletion(to_remove, pods_by_slot, now, force)
+
+    def _execute_deletion(
+        self,
+        to_remove: list[NodeToRemove],
+        pods_by_slot: dict[int, Pod] | None,
+        now: float,
+        force: bool,
+        pre_tainted: bool = False,
+    ) -> list[DeletionResult]:
         empty = [r for r in to_remove if r.is_empty]
         drain = [r for r in to_remove if not r.is_empty]
 
-        for r in to_remove:
-            if self.options.cordon_node_before_terminating:
-                # reference: --cordon-node-before-terminating marks the node
-                # unschedulable before the taint lands
-                r.node.unschedulable = True
-            self.taint_to_be_deleted(r.node)
-            self.tracker.start(r.node.name, now)
+        if not pre_tainted:
+            for r in to_remove:
+                if self.options.cordon_node_before_terminating:
+                    # reference: --cordon-node-before-terminating marks the
+                    # node unschedulable before the taint lands
+                    r.node.unschedulable = True
+                self.taint_to_be_deleted(r.node)
+                self.tracker.start(r.node.name, now)
 
         def evict_daemonsets(r: NodeToRemove) -> None:
             """--daemonset-eviction-for-{empty,occupied}-nodes."""

@@ -623,7 +623,8 @@ class Planner:
                             for cand_d in np.nonzero(fits)[0]:
                                 if _oracle.check_pod_in_cluster(
                                         pod_obj, nodes[int(cand_d)], alive, by_node,
-                                        registry=enc.registry):
+                                        registry=enc.registry,
+                                        namespaces=enc.namespaces):
                                     d = int(cand_d)
                                     break
                             if d < 0:

@@ -241,7 +241,8 @@ class ScaleUpOrchestrator:
                     exemplar = enc.pending_pods[enc.group_pods[gi][0]]
                     if not oracle.check_pod_on_new_node(
                             exemplar, g_t, all_nodes, pods_by_node,
-                            registry=enc.registry):
+                            registry=enc.registry,
+                            namespaces=enc.namespaces):
                         refuted.append(int(gi))
             if not refuted:
                 out.append(opt)
@@ -281,7 +282,8 @@ class ScaleUpOrchestrator:
             return {best.group_id: best.node_count}
         target = groups[best.group_index]
         tmpl = target.template_node_info()
-        free = _group_exemplar_free(enc, groups) if enc is not None else {}
+        free = _group_exemplar_free(enc, groups, self.provider) \
+            if enc is not None else {}
         similar = [target]
         for i, g in enumerate(groups):
             if g.id() == target.id():
@@ -403,19 +405,27 @@ class ScaleUpOrchestrator:
         return result
 
 
-def _group_exemplar_free(enc, groups) -> dict[str, "np.ndarray"]:
+def _group_exemplar_free(enc, groups, provider) -> dict[str, "np.ndarray"]:
     """Per-group FREE resource vector from a live exemplar node (reference:
     compare_nodegroups.go:109-121 builds free = allocatable - requested from
     the groups' exemplar NodeInfos). Groups without a registered node have
     no exemplar — free comparison is skipped for them (a template is empty
     by construction, so template-vs-template free degenerates to allocatable,
-    which is already compared)."""
+    which is already compared).
+
+    nodes.group_id holds indices into the FULL provider.node_groups()
+    enumeration (static_autoscaler._node_group_index), not into the filtered
+    `groups` list — map through the provider ordering."""
     gid_arr = np.asarray(enc.nodes.group_id)
     valid = np.asarray(enc.nodes.valid)
     free_all = np.asarray(enc.nodes.cap) - np.asarray(enc.nodes.alloc)
+    provider_index = {g.id(): i for i, g in enumerate(provider.node_groups())}
     out: dict[str, np.ndarray] = {}
-    for i, g in enumerate(groups):
-        rows = np.nonzero(valid & (gid_arr == i))[0]
+    for g in groups:
+        pi = provider_index.get(g.id())
+        if pi is None:
+            continue
+        rows = np.nonzero(valid & (gid_arr == pi))[0]
         if rows.size:
             out[g.id()] = free_all[rows[0]]
     return out

@@ -158,9 +158,11 @@ class StaticAutoscaler:
         self.planner = Planner(provider, self.options, None,
                                pdb_tracker=self.pdb_tracker,
                                latency_tracker=self.latency_tracker)
+        self._async_group_of: dict[str, str] = {}
         self.actuator = Actuator(provider, self.options, eviction_sink,
                                  pdb_tracker=self.pdb_tracker,
-                                 latency_tracker=self.latency_tracker)
+                                 latency_tracker=self.latency_tracker,
+                                 on_result=self._on_deletion_result)
         self.last_scale_down_delete: float = 0.0
         self.last_scale_down_fail: float = 0.0
         # one-time crash recovery on the first loop (reference:
@@ -333,6 +335,10 @@ class StaticAutoscaler:
             pdb_names = self.pdb_tracker.namespaced_names_with_pdb(
                 [p for p in pods if p.node_name]
             )
+            # namespace labels (for affinity namespaceSelector exactness);
+            # sources without Namespace objects leave it None
+            list_ns = getattr(self.source, "list_namespaces", None)
+            ns_labels = list_ns() if list_ns is not None else None
             with self.metrics.time_function("snapshot_build"):
                 if self.options.incremental_encode:
                     if self._encoder is None or \
@@ -349,13 +355,15 @@ class StaticAutoscaler:
                         )
                     enc = self._encoder.encode(
                         nodes, pods, node_group_ids=node_group_ids,
-                        now=now, pdb_namespaced_names=frozenset(pdb_names))
+                        now=now, pdb_namespaced_names=frozenset(pdb_names),
+                        namespaces=ns_labels)
                 else:
                     enc = encode_cluster(
                         nodes, pods,
                         node_group_ids=node_group_ids,
                         node_bucket=self.options.node_shape_bucket,
                         group_bucket=self.options.group_shape_bucket,
+                        namespaces=ns_labels,
                     )
                     apply_drainability(enc, drain_opts, now=now,
                                        pdb_namespaced_names=pdb_names)
@@ -468,9 +476,12 @@ class StaticAutoscaler:
                     for r in to_remove:
                         g = self.provider.node_group_for_node(r.node)
                         group_of[r.node.name] = g.id() if g else ""
+                    if self.options.async_node_deletion:
+                        self._async_group_of.update(group_of)
                     with self.metrics.time_function("scale_down_actuate"):
                         results = self.actuator.start_deletion(
-                            to_remove, pods_by_slot, now
+                            to_remove, pods_by_slot, now,
+                            detach=self.options.async_node_deletion,
                         )
                     for r in results:
                         if r.ok:
@@ -541,6 +552,25 @@ class StaticAutoscaler:
         return status
 
     # ---- scale-up dispatch (single vs salvo) ----
+
+    def _on_deletion_result(self, res) -> None:
+        """Completion hook for DETACHED deletions (reference: the result
+        observation the deleteNodesAsync goroutines perform through the
+        NodeDeletionTracker). Runs on the actuator's background thread."""
+        import time as _time
+
+        now = _time.time()
+        gid = self._async_group_of.pop(res.node, "")
+        if res.ok:
+            self.cluster_state.register_scale_down(res.node, now, gid)
+            self.last_scale_down_delete = now
+            self.node_group_change_observers.register_scale_down(
+                gid, res.node, now)
+            self.metrics.counter("scaled_down_nodes_total").inc()
+        else:
+            self.last_scale_down_fail = now
+            self.node_group_change_observers.register_failed_scale_down(
+                gid, res.node, res.reason, now)
 
     def _dispatch_scale_up(self, enc, snapshot, nodes: list[Node],
                            now: float) -> ScaleUpResult:

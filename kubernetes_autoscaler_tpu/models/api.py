@@ -67,11 +67,17 @@ class AffinityTerm:
     """One required pod-(anti-)affinity term: selector over pod labels within a
     topology domain (reference: vendored InterPodAffinity filter semantics).
 
-    `namespaces` empty means "the pod's own namespace" (k8s default)."""
+    `namespaces` empty means "the pod's own namespace" (k8s default) unless a
+    `namespace_selector` is set, which selects namespaces by THEIR labels
+    (reference: interpodaffinity/filtering.go:192 merges the selector into the
+    namespace set using live Namespace objects; {} selects ALL namespaces).
+    Evaluating it needs the cluster's namespace→labels map, so terms carrying
+    one ride the host-check tier with the oracle given that map."""
 
     match_labels: dict[str, str] = field(default_factory=dict)
     topology_key: str = "kubernetes.io/hostname"
     namespaces: tuple[str, ...] = ()
+    namespace_selector: Optional[dict[str, str]] = None
 
 
 @dataclass
@@ -83,6 +89,26 @@ class TopologySpreadConstraint:
     max_skew: int = 1
     topology_key: str = "topology.kubernetes.io/zone"
     match_labels: dict[str, str] = field(default_factory=dict)
+    # pod label keys whose (key, pod-value) pairs merge into the selector
+    # (reference: podtopologyspread/common.go:96-104 mergeLabelSetWithSelector)
+    match_label_keys: tuple[str, ...] = ()
+    # global minimum becomes 0 while fewer domains exist than this
+    # (filtering.go:54-67; nil → 1)
+    min_domains: int = 1
+    # node inclusion policies (common.go:42-56; defaults Honor / Ignore)
+    node_affinity_policy: str = "Honor"    # Honor | Ignore
+    node_taints_policy: str = "Ignore"     # Honor | Ignore
+
+    def merged_selector(self, pod_labels: dict[str, str]) -> dict[str, str]:
+        """match_labels + the pod's values for match_label_keys (a key absent
+        from the pod contributes nothing — common.go:98-101)."""
+        if not self.match_label_keys:
+            return self.match_labels
+        sel = dict(self.match_labels)
+        for k in self.match_label_keys:
+            if k in pod_labels:
+                sel[k] = pod_labels[k]
+        return sel
 
 
 @dataclass
@@ -102,6 +128,10 @@ class Pod:
     # Sum of container requests, pre-aggregated (reference aggregates via
     # resourcehelpers; init-container max() rule applied by the caller/builder).
     requests: dict[str, float] = field(default_factory=dict)  # name -> amount (cpu in cores, memory in bytes)
+    # spec.overhead (RuntimeClass pod overhead): ADDED to requests for every
+    # fit decision (reference: noderesources/fit.go:299 — "resources defined
+    # for Overhead should be added to the calculated Resource request sum")
+    overhead: dict[str, float] = field(default_factory=dict)
     node_selector: dict[str, str] = field(default_factory=dict)
     # Single-term sugar: one ANDed requirement list. For the full k8s shape
     # (nodeSelectorTerms = OR of terms, each an AND of requirements) set
@@ -169,10 +199,33 @@ def labels_match(selector: dict[str, str], labels: dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
-def term_matches_pod(term: AffinityTerm, pod: "Pod", other: "Pod") -> bool:
-    """Does `other` match `term` of `pod` (selector + namespace scoping)?"""
-    namespaces = term.namespaces or (pod.namespace,)
-    return other.namespace in namespaces and labels_match(term.match_labels, other.labels)
+def term_matches_pod(term: AffinityTerm, pod: "Pod", other: "Pod",
+                     namespaces: dict[str, dict[str, str]] | None = None
+                     ) -> bool:
+    """Does `other` match `term` of `pod` (selector + namespace scoping)?
+
+    `namespaces` maps namespace name → its labels, needed only when the term
+    carries a namespace_selector (reference merges that selector into the
+    namespace set from live Namespace objects, filtering.go:82,192). Without
+    the map, a namespace_selector term matches conservatively: nothing — the
+    dense/host tiers flag such terms needs_host_check and the control plane
+    passes the map where the source provides one."""
+    if term.namespace_selector is not None:
+        if len(term.namespace_selector) == 0:
+            # {} selects ALL namespaces (filtering.go:192 semantics) — no
+            # namespace labels needed
+            in_ns = True
+        else:
+            in_ns = other.namespace in term.namespaces
+            if not in_ns and namespaces is not None:
+                lbls = namespaces.get(other.namespace)
+                in_ns = lbls is not None and labels_match(
+                    term.namespace_selector, lbls)
+        if not in_ns:
+            return False
+        return labels_match(term.match_labels, other.labels)
+    scope = term.namespaces or (pod.namespace,)
+    return other.namespace in scope and labels_match(term.match_labels, other.labels)
 
 
 @dataclass

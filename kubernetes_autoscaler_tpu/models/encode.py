@@ -110,7 +110,9 @@ def pod_request_vector(
     v = np.zeros((res.NUM_RESOURCES,), dtype=np.int64)
     v[res.PODS] = 1
     lossy = False
-    for name, amount in pod.requests.items():
+    # pod overhead adds to every fit decision (noderesources/fit.go:299)
+    items = list(pod.requests.items()) + list(pod.overhead.items())
+    for name, amount in items:
         if name == "cpu":
             v[res.CPU] += res.cpu_request_to_milli(amount)
         elif name == "memory":
@@ -285,6 +287,11 @@ def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
         if kind == 0:
             enc.lossy = True
             continue
+        if term.namespace_selector is not None:
+            # namespace-by-labels scoping needs the Namespace world — the
+            # dense planes under-count (conservative: over-admits) and the
+            # winner rides the host-check tier with the namespaces map
+            enc.lossy = True
         self_match = term_matches_pod(term, pod, pod)
         if kind == 1:
             enc.anti_affinity_self = enc.anti_affinity_self or self_match
@@ -297,6 +304,8 @@ def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
         if len(pod.pod_affinity) > 1:
             enc.lossy = True
         term = pod.pod_affinity[0]
+        if term.namespace_selector is not None:
+            enc.lossy = True
         kind = _domain_kind(term.topology_key)
         if kind == 0:
             enc.lossy = True
@@ -316,8 +325,19 @@ def _encode_pod_spec(pod: Pod, dims: Dims) -> _PodSpecEncoding:
         else:
             enc.spread_kind = kind
             enc.max_skew = max(int(c.max_skew), 1)
-            enc.spread_selector = dict(c.match_labels)
-            enc.spread_self = labels_match(c.match_labels, pod.labels)
+            # matchLabelKeys lowers EXACTLY: the merged selector is static
+            # per pod (common.go:96-104)
+            sel = c.merged_selector(pod.labels)
+            enc.spread_selector = dict(sel)
+            enc.spread_self = labels_match(sel, pod.labels)
+            # knobs the dense kernel does not model (it assumes the default
+            # policies: affinity Honor via s_elig, taints Ignore; and a
+            # global minimum over currently-populated domains ≡ minDomains=1)
+            # → exact host-check tier
+            if (int(c.min_domains) > 1
+                    or c.node_affinity_policy == "Ignore"
+                    or c.node_taints_policy == "Honor"):
+                enc.lossy = True
     return enc
 
 
@@ -411,16 +431,23 @@ def equivalence_key(pod: Pod) -> int:
         # spread selectors and decide self-matching
         repr(sorted(pod.labels.items())),
         repr(sorted(pod.requests.items())),
+        repr(sorted(pod.overhead.items())),
         repr(sorted(pod.node_selector.items())),
         repr([[(r.key, r.operator, tuple(r.values)) for r in term]
               for term in pod.affinity_node_terms()]),
         repr([(t.key, t.operator, t.value, t.effect) for t in pod.tolerations]),
         repr(pod.host_ports),
-        repr([(sorted(t.match_labels.items()), t.topology_key, t.namespaces)
+        repr([(sorted(t.match_labels.items()), t.topology_key, t.namespaces,
+               sorted(t.namespace_selector.items())
+               if t.namespace_selector is not None else None)
               for t in pod.anti_affinity]),
-        repr([(sorted(t.match_labels.items()), t.topology_key, t.namespaces)
+        repr([(sorted(t.match_labels.items()), t.topology_key, t.namespaces,
+               sorted(t.namespace_selector.items())
+               if t.namespace_selector is not None else None)
               for t in pod.pod_affinity]),
-        repr([(c.max_skew, c.topology_key, sorted(c.match_labels.items()))
+        repr([(c.max_skew, c.topology_key, sorted(c.match_labels.items()),
+               c.match_label_keys, c.min_domains,
+               c.node_affinity_policy, c.node_taints_policy)
               for c in pod.spread_constraints()]),
         pod.owner.uid if pod.owner else pod.name,
     ]
@@ -493,6 +520,11 @@ class EncodedCluster:
                                     # constraint (selects the constrained
                                     # kernel variants — a STATIC choice)
     node_objs: list[Node] = field(default_factory=list)
+    # namespace name → labels (from the source's Namespace objects, when it
+    # provides them) — makes affinity namespace_selector terms exact in the
+    # host-check tier (reference merges the selector into the namespace set
+    # from live Namespace objects, interpodaffinity/filtering.go:192)
+    namespaces: dict[str, dict[str, str]] | None = None
     # pre-device numpy arrays, keyed "section.field" — kept so the incremental
     # encoder (models/incremental.py) can seed its mirrors without a device
     # round-trip (device readback over the TPU tunnel is ~70 ms/sync)
@@ -519,6 +551,7 @@ def encode_cluster(
     node_bucket: int = 64,
     group_bucket: int = 64,
     pod_bucket: int = 256,
+    namespaces: dict[str, dict[str, str]] | None = None,
 ) -> EncodedCluster:
     """Lower a (nodes, pods) world into one EncodedCluster.
 
@@ -750,6 +783,7 @@ def encode_cluster(
         )),
         has_constraints=has_constraints,
         node_objs=list(nodes),
+        namespaces=namespaces,
         host_arrays=host_arrays,
     )
 

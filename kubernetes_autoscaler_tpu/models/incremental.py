@@ -155,9 +155,11 @@ class IncrementalEncoder:
         node_group_ids: dict[str, int] | None = None,
         now: float | None = None,
         pdb_namespaced_names: frozenset = frozenset(),
+        namespaces: dict[str, dict[str, str]] | None = None,
     ) -> EncodedCluster:
         self.loops += 1
         node_group_ids = node_group_ids or {}
+        self._namespaces = namespaces
         if (not self._seeded
                 or (self.resync_loops and self.loops % self.resync_loops == 0)):
             return self._full(nodes, pods, node_group_ids, now,
@@ -168,6 +170,13 @@ class IncrementalEncoder:
         except _ResyncNeeded:
             return self._full(nodes, pods, node_group_ids, now,
                               pdb_namespaced_names)
+        except Exception:
+            # an exception mid-diff (e.g. hostPort/dims overflow) leaves the
+            # mirrors half-mutated — poison the state so the NEXT loop full-
+            # rebuilds instead of silently diffing from corruption, and let
+            # the error surface exactly as encode_cluster would
+            self._seeded = False
+            raise
         return self._handout()
 
     # ----------------------------------------------------------- full build
@@ -179,11 +188,23 @@ class IncrementalEncoder:
             nodes, pods, registry=self.registry, dims=self.dims,
             node_group_ids=node_group_ids, node_bucket=self.node_bucket,
             group_bucket=self.group_bucket, pod_bucket=self.pod_bucket,
+            namespaces=self._namespaces,
         )
         # mirrors: own copies (device arrays must never alias a mutating mirror)
         self._m = {k: v.copy() for k, v in enc.host_arrays.items()}
+        # seed the device cache from the arrays encode_cluster ALREADY
+        # uploaded (identical content) — re-uploading the multi-MB planes a
+        # second time would double the seed-loop tunnel cost. Only the
+        # drainability verdicts (classified below, after this seed) differ.
         self._dev: dict[str, object] = {}
-        self._dirty: set[str] = set(self._m)
+        for section, tree in (("nodes", enc.nodes), ("specs", enc.specs),
+                              ("scheduled", enc.scheduled),
+                              ("planes", enc.planes)):
+            for f in {"nodes": _NODE_FIELDS, "specs": _SPEC_FIELDS,
+                      "scheduled": _SCHED_FIELDS,
+                      "planes": _PLANE_FIELDS}[section]:
+                self._dev[f"{section}.{f}"] = getattr(tree, f)
+        self._dirty: set[str] = {"scheduled.movable", "scheduled.blocks"}
         self._dirty_rows: dict[str, set[int] | None] = {}
 
         self.zone_table = enc.zone_table
@@ -901,6 +922,7 @@ class IncrementalEncoder:
             planes=planes,
             has_constraints=bool(self._constrained_rows),
             node_objs=list(self._node_objs),
+            namespaces=self._namespaces,
             host_arrays=self._m,
         )
 

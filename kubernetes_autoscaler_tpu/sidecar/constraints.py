@@ -79,7 +79,13 @@ def attach_constraints(state, specs, n_nodes: int, aux: dict[str, dict],
             k = _kind(s["key"])
             if k == 2 and not zones_fit:
                 k = 0
-            if k and not s.get("extra"):
+            # the dense tier models the DEFAULT inclusion policies and
+            # minDomains=1 only; non-defaults go to the exact host tier
+            # (mirrors models/encode._encode_pod_spec)
+            nondefault = (int(s.get("md", 1)) > 1
+                          or s.get("nap", "Honor") == "Ignore"
+                          or s.get("ntp", "Ignore") == "Honor")
+            if k and not s.get("extra") and not nondefault:
                 spread_kind[row] = k
                 max_skew[row] = max(int(s["w"]), 1)
                 spread_self[row] = labels_match(s["sel"], rec["l"])
@@ -90,6 +96,8 @@ def attach_constraints(state, specs, n_nodes: int, aux: dict[str, dict],
             k = _kind(a["key"])
             if k == 2 and not zones_fit:
                 k = 0
+            if a.get("nssel") is not None:
+                k = 0  # namespace-by-labels scoping → exact host tier
             if k and not a.get("extra"):
                 aff_kind[row] = k
                 aff_self[row] = _term_matches(
@@ -100,6 +108,8 @@ def attach_constraints(state, specs, n_nodes: int, aux: dict[str, dict],
             k = _kind(t["key"])
             if k == 2 and not zones_fit:
                 k = 0
+            if t.get("nssel") is not None:
+                k = 0  # namespace-by-labels scoping → exact host tier
             if k == 0:
                 exotic = True
                 continue

@@ -160,16 +160,31 @@ class DeltaWriter:
         cons = pod.spread_constraints()
         if cons:
             c = cons[0]
+            # matchLabelKeys merges into "sel" AT THE ENCODER (the Go shim
+            # does the same — common.go:96-104 is a static per-pod merge);
+            # minDomains / non-default inclusion policies ride as fields the
+            # overlay routes to the exact host-check tier
             rec["s"] = {"key": c.topology_key, "w": int(c.max_skew),
-                        "sel": dict(c.match_labels), "extra": len(cons) > 1}
+                        "sel": dict(c.merged_selector(pod.labels)),
+                        "extra": len(cons) > 1,
+                        "md": int(c.min_domains),
+                        "nap": c.node_affinity_policy,
+                        "ntp": c.node_taints_policy}
         if pod.pod_affinity:
             t = pod.pod_affinity[0]
             rec["a"] = {"key": t.topology_key, "sel": dict(t.match_labels),
                         "nss": list(t.namespaces),
+                        "nssel": (dict(t.namespace_selector)
+                                  if t.namespace_selector is not None
+                                  else None),
                         "extra": len(pod.pod_affinity) > 1}
         if pod.anti_affinity:
             rec["x"] = [{"key": t.topology_key, "sel": dict(t.match_labels),
-                         "nss": list(t.namespaces)} for t in pod.anti_affinity]
+                         "nss": list(t.namespaces),
+                         "nssel": (dict(t.namespace_selector)
+                                   if t.namespace_selector is not None
+                                   else None)}
+                        for t in pod.anti_affinity]
         # within-payload coherence: a uid lives in exactly ONE list, with the
         # LAST op winning (the server applies upserts then deletes, so mixed
         # membership would net to deletion regardless of op order)

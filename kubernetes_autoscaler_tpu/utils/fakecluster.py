@@ -44,6 +44,7 @@ class FakeCluster:
         self.provision_delay_s = provision_delay_s
         self.evicted: list[str] = []
         self.eviction_graces: dict[str, float | None] = {}
+        self.namespace_labels: dict[str, dict[str, str]] = {}
         self._pending: list[_PendingProvision] = []
         self._seq = itertools.count()
         self._now = 0.0
@@ -100,6 +101,10 @@ class FakeCluster:
 
     def list_pods(self) -> list[Pod]:
         return list(self.pods.values())
+
+    def list_namespaces(self) -> dict[str, dict[str, str]]:
+        """Namespace name -> labels (affinity namespaceSelector support)."""
+        return dict(self.namespace_labels)
 
     def list_pdbs(self) -> list:
         """Effective budgets, the way the API server maintains

@@ -157,33 +157,44 @@ def spread_ok(
     nodes: list[Node],
     pods_by_node: dict[str, list[Pod]],
 ) -> bool:
-    """PodTopologySpread DoNotSchedule check (vendored plugin semantics):
-    for each constraint, skew after placing = count(node's domain) +
-    selfMatchNum - min(count over eligible domains) must stay <= max_skew,
-    where selfMatchNum is 1 only if the incoming pod itself matches the
-    constraint's selector (vendored podtopologyspread/filtering.go:345-351).
-    Eligible domains are values present on nodes matching the pod's
-    nodeSelector/affinity; matching pods are counted in the pod's namespace
-    across ALL nodes holding the topology key."""
+    """PodTopologySpread DoNotSchedule check (vendored plugin semantics,
+    podtopologyspread/{common,filtering}.go):
+
+      * the constraint selector is match_labels merged with the pod's values
+        for match_label_keys (common.go:96-104);
+      * domains and their match counts are computed over nodes passing the
+        node INCLUSION POLICIES — nodeAffinityPolicy=Honor (default) keeps
+        only nodes matching the pod's nodeSelector/affinity,
+        nodeTaintsPolicy=Honor keeps only nodes whose DoNotSchedule taints
+        the pod tolerates (common.go:42-56);
+      * global minimum = min match count over those domains, treated as 0
+        while fewer domains exist than min_domains (filtering.go:54-67);
+      * verdict: count(candidate's domain) + selfMatchNum - min <= max_skew,
+        selfMatchNum = 1 iff the pod matches the (merged) selector
+        (filtering.go:337-351). A candidate node without the topology key
+        can never satisfy the constraint (filtering.go:330-335)."""
     for c in pod.spread_constraints():
         v_here = topology_value(node, c.topology_key)
         if v_here is None:
             return False  # node without the key cannot satisfy the constraint
+        sel = c.merged_selector(pod.labels)
         counts: dict[str, int] = {}
-        eligible: set[str] = set()
         for nd in nodes:
             v = topology_value(nd, c.topology_key)
             if v is None:
                 continue
+            if c.node_affinity_policy != "Ignore" and not selector_matches(pod, nd):
+                continue
+            if c.node_taints_policy == "Honor" and not taints_tolerated(pod, nd):
+                continue
             counts.setdefault(v, 0)
-            if selector_matches(pod, nd):
-                eligible.add(v)
             for q in pods_by_node.get(nd.name, []):
-                if q.namespace == pod.namespace and labels_match(c.match_labels, q.labels):
+                if q.namespace == pod.namespace and labels_match(sel, q.labels):
                     counts[v] += 1
-        eligible.add(v_here)  # the candidate node itself is an eligible domain
-        min_count = min((counts.get(v, 0) for v in eligible), default=0)
-        self_match = 1 if labels_match(c.match_labels, pod.labels) else 0
+        min_count = min(counts.values(), default=0)
+        if len(counts) < max(int(c.min_domains), 1):
+            min_count = 0  # not enough eligible domains yet (filtering.go:61)
+        self_match = 1 if labels_match(sel, pod.labels) else 0
         if counts.get(v_here, 0) + self_match - min_count > c.max_skew:
             return False
     return True
@@ -194,6 +205,7 @@ def pod_affinity_ok(
     node: Node,
     nodes: list[Node],
     pods_by_node: dict[str, list[Pod]],
+    namespaces: dict[str, dict[str, str]] | None = None,
 ) -> bool:
     """Required inter-pod affinity: each term needs >=1 matching pod in the
     candidate node's topology domain. First-pod exception (vendored
@@ -208,13 +220,14 @@ def pod_affinity_ok(
         for nd in nodes:
             v = topology_value(nd, term.topology_key)
             for q in pods_by_node.get(nd.name, []):
-                if _term_matches_pod(term, pod, q):
+                if _term_matches_pod(term, pod, q, namespaces):
                     matched_anywhere = True
                     if v == v_here:
                         matched_here = True
         if matched_here:
             continue
-        if not matched_anywhere and _term_matches_pod(term, pod, pod):
+        if not matched_anywhere and _term_matches_pod(term, pod, pod,
+                                                      namespaces):
             continue  # first-pod exception
         return False
     return True
@@ -225,6 +238,7 @@ def anti_affinity_ok(
     node: Node,
     nodes: list[Node],
     pods_by_node: dict[str, list[Pod]],
+    namespaces: dict[str, dict[str, str]] | None = None,
 ) -> bool:
     """Required inter-pod anti-affinity: no matching pod may share the
     candidate node's topology domain. A node without the key has no domain,
@@ -237,7 +251,7 @@ def anti_affinity_ok(
             if topology_value(nd, term.topology_key) != v_here:
                 continue
             for q in pods_by_node.get(nd.name, []):
-                if _term_matches_pod(term, pod, q):
+                if _term_matches_pod(term, pod, q, namespaces):
                     return False
     return True
 
@@ -273,8 +287,13 @@ def check_pod_in_cluster(
     nodes: list[Node],
     pods_by_node: dict[str, list[Pod]],
     registry: res.ExtendedResourceRegistry | None = None,
+    namespaces: dict[str, dict[str, str]] | None = None,
 ) -> bool:
-    """Exact verdict with full cluster context: can `pod` schedule on `node`?"""
+    """Exact verdict with full cluster context: can `pod` schedule on `node`?
+
+    `namespaces` (name → labels) makes affinity namespace_selector terms
+    exact; without it such terms match nothing beyond their explicit
+    namespace lists (models/api.term_matches_pod contract)."""
     registry = registry or res.ExtendedResourceRegistry()
     if not node_schedulable(node):
         return False
@@ -293,9 +312,11 @@ def check_pod_in_cluster(
     req, _ = pod_request_vector(pod, registry)
     if not bool((req.astype(int) <= cap - used).all()):
         return False
-    if pod.anti_affinity and not anti_affinity_ok(pod, node, nodes, pods_by_node):
+    if pod.anti_affinity and not anti_affinity_ok(pod, node, nodes,
+                                                  pods_by_node, namespaces):
         return False
-    if pod.pod_affinity and not pod_affinity_ok(pod, node, nodes, pods_by_node):
+    if pod.pod_affinity and not pod_affinity_ok(pod, node, nodes,
+                                                pods_by_node, namespaces):
         return False
     if not spread_ok(pod, node, nodes, pods_by_node):
         return False
@@ -309,6 +330,7 @@ def check_pod_on_new_node(
     pods_by_node: dict[str, list[Pod]],
     registry: res.ExtendedResourceRegistry | None = None,
     fresh_name: str = "template-fresh-node",
+    namespaces: dict[str, dict[str, str]] | None = None,
 ) -> bool:
     """Can `pod` schedule on a FRESH node stamped from `template`, given the
     current cluster? This is the scale-up winner-verification question
@@ -325,5 +347,6 @@ def check_pod_on_new_node(
         unschedulable=False,
     )
     return check_pod_in_cluster(
-        pod, fresh, list(nodes) + [fresh], pods_by_node, registry
+        pod, fresh, list(nodes) + [fresh], pods_by_node, registry,
+        namespaces=namespaces,
     )

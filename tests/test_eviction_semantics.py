@@ -199,3 +199,33 @@ def test_force_delete_unregistered_nodes_flag():
     b.run_once(now=2000.0)
     assert forced == ["ghost-0"]                      # min size ignored
     assert "ghost-0" not in {i.name for i in g.nodes()}
+
+
+def test_detached_deletion_does_not_block_and_reports_results():
+    """--async-node-deletion / Actuator detach=True (reference deletes in
+    goroutines, actuator.go:287): a drain whose evictions retry for a while
+    must not stall the caller; results arrive via tracker + callback."""
+    import threading
+    import time as _time
+
+    fake, node, pods = _world(n_pods=1)
+    sink = _FlakySink(fail_n=2)
+    done = threading.Event()
+    got = []
+
+    a = Actuator(fake.provider,
+                 AutoscalingOptions(max_pod_eviction_time_s=30.0),
+                 sink, on_result=lambda r: (got.append(r), done.set()))
+    a.eviction_retry_time_s = 0.05  # real sleeps in the worker thread
+    t0 = _time.perf_counter()
+    res = a.start_deletion(_remove(node, pods), {0: pods[0]}, now=0.0,
+                           detach=True)
+    took = _time.perf_counter() - t0
+    assert res == [] and took < 0.05          # returned before retries ran
+    from kubernetes_autoscaler_tpu.models.api import TO_BE_DELETED_TAINT
+
+    assert any(t.key == TO_BE_DELETED_TAINT for t in node.taints)  # sync taint
+    assert done.wait(10.0)
+    assert got and got[0].ok and got[0].node == "victim-node"
+    assert sink.attempts["p0"] == 3
+    assert "victim-node" not in fake.nodes
