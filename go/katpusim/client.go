@@ -1,0 +1,134 @@
+// Thin gRPC client for katpu.simulator.v1.TpuSimulator.
+//
+// ON-WIRE CONVENTION: the service passes RAW bytes — the KAD1 payload for
+// ApplyDelta, UTF-8 JSON for sim params — and returns UTF-8 JSON documents.
+// protos/simulator.proto documents the rpc SHAPE; the implementation on
+// both sides uses identity serializers (no protobuf framing), exactly so no
+// codegen is needed anywhere (see the proto's own header comment and
+// sidecar/server.py make_grpc_server). Mirrors the reference's
+// out-of-process precedent (expander/grpcplugin, externalgrpc).
+package katpusim
+
+import (
+	"context"
+	"encoding/json"
+	"fmt"
+
+	"google.golang.org/grpc"
+	"google.golang.org/grpc/encoding"
+)
+
+const (
+	methodApplyDelta   = "/katpu.simulator.v1.TpuSimulator/ApplyDelta"
+	methodScaleUpSim   = "/katpu.simulator.v1.TpuSimulator/ScaleUpSim"
+	methodScaleDownSim = "/katpu.simulator.v1.TpuSimulator/ScaleDownSim"
+	methodHealth       = "/katpu.simulator.v1.TpuSimulator/Health"
+)
+
+// rawCodec moves bytes through grpc-go untouched (identity serialization —
+// the same convention sidecar/server.py registers).
+type rawCodec struct{}
+
+func (rawCodec) Marshal(v any) ([]byte, error) { return v.([]byte), nil }
+func (rawCodec) Unmarshal(d []byte, v any) error {
+	*(v.(*[]byte)) = append([]byte(nil), d...)
+	return nil
+}
+func (rawCodec) Name() string { return "katpu-raw" }
+
+func init() { encoding.RegisterCodec(rawCodec{}) }
+
+// Ack is the JSON response of ApplyDelta/Health.
+type Ack struct {
+	Version uint64 `json:"version"`
+	Error   string `json:"error,omitempty"`
+}
+
+// Client talks to the TPU simulation sidecar.
+type Client struct{ cc *grpc.ClientConn }
+
+// Dial connects (use grpc.WithTransportCredentials for TLS — the sidecar
+// serves TLS when started with --grpc-cert/--grpc-key).
+func Dial(target string, opts ...grpc.DialOption) (*Client, error) {
+	opts = append(opts,
+		grpc.WithDefaultCallOptions(grpc.CallContentSubtype("katpu-raw")))
+	cc, err := grpc.NewClient(target, opts...)
+	if err != nil {
+		return nil, err
+	}
+	return &Client{cc: cc}, nil
+}
+
+func (c *Client) Close() error { return c.cc.Close() }
+
+func (c *Client) invoke(ctx context.Context, method string, payload []byte,
+) ([]byte, error) {
+	var resp []byte
+	if err := c.cc.Invoke(ctx, method, payload, &resp); err != nil {
+		return nil, err
+	}
+	return resp, nil
+}
+
+// ApplyDelta uploads one KAD1(/KAUX) payload; returns the snapshot version
+// after applying it.
+func (c *Client) ApplyDelta(ctx context.Context, payload []byte) (uint64, error) {
+	resp, err := c.invoke(ctx, methodApplyDelta, payload)
+	if err != nil {
+		return 0, err
+	}
+	var ack Ack
+	if err := json.Unmarshal(resp, &ack); err != nil {
+		return 0, fmt.Errorf("bad ack: %w", err)
+	}
+	if ack.Error != "" {
+		return 0, fmt.Errorf("sidecar: %s", ack.Error)
+	}
+	return ack.Version, nil
+}
+
+func (c *Client) sim(ctx context.Context, method string, params any,
+	out any) error {
+	p, err := json.Marshal(params)
+	if err != nil {
+		return err
+	}
+	resp, err := c.invoke(ctx, method, p)
+	if err != nil {
+		return err
+	}
+	var probe struct {
+		Error string `json:"error"`
+	}
+	if err := json.Unmarshal(resp, &probe); err == nil && probe.Error != "" {
+		return fmt.Errorf("sidecar: %s", probe.Error)
+	}
+	return json.Unmarshal(resp, out)
+}
+
+// ScaleUpSim runs loop A+B (filter-out-schedulable + all expansion options +
+// expander scoring). params/result shapes: protos/simulator.proto comments.
+func (c *Client) ScaleUpSim(ctx context.Context, params any, out any) error {
+	return c.sim(ctx, methodScaleUpSim, params, out)
+}
+
+// ScaleDownSim runs loop C (eligibility + batched drain sweep).
+func (c *Client) ScaleDownSim(ctx context.Context, params any, out any) error {
+	return c.sim(ctx, methodScaleDownSim, params, out)
+}
+
+// Health pings the service.
+func (c *Client) Health(ctx context.Context) error {
+	resp, err := c.invoke(ctx, methodHealth, nil)
+	if err != nil {
+		return err
+	}
+	var ack Ack
+	if err := json.Unmarshal(resp, &ack); err != nil {
+		return fmt.Errorf("bad health ack: %w", err)
+	}
+	if ack.Error != "" {
+		return fmt.Errorf("sidecar: %s", ack.Error)
+	}
+	return nil
+}

@@ -1,0 +1,366 @@
+// Package katpusim is the Go half of the TPU-simulator sidecar boundary:
+// a KAD1/KAUX delta encoder plus a thin gRPC client for the
+// katpu.simulator.v1.TpuSimulator service (protos/simulator.proto).
+//
+// The byte format is specified in docs/SIDECAR_WIRE.md and pinned by the
+// golden fixtures under kubernetes_autoscaler_tpu/sidecar/goldens/: encode
+// the inputs listed in goldens/manifest.json with this writer, byte-compare
+// the KAD1 dense body against the committed payload_N arrays, and
+// parse-compare the KAUX JSON trailer against the manifest's aux documents
+// (the repo's own CI replays the same bytes through the native codec,
+// tests/test_wire_conformance.py).
+//
+// This package deliberately has no protobuf dependency: the service moves
+// RAW bytes with identity serializers on both sides — see client.go.
+package katpusim
+
+import (
+	"encoding/binary"
+	"encoding/json"
+	"fmt"
+	"hash/crc32"
+	"math"
+)
+
+// Resource-vector slot layout (models/resources.py): cpu-milli, memory-MiB,
+// ephemeral-MiB, pods, then up to four extended-resource slots assigned
+// first-come-first-served per snapshot.
+const NumResources = 8
+
+// Taint/toleration effect encodings on the wire.
+const (
+	EffectNoSchedule = 0
+	EffectNoExecute  = 1
+	EffectOther      = 2 // PreferNoSchedule etc. — never filters
+)
+
+// Op codes.
+const (
+	opUpsertNode = 1
+	opDeleteNode = 2
+	opUpsertPod  = 3
+	opDeletePod  = 4
+)
+
+// Fold32 is the label/taint/port hash shared with the Python encoder and the
+// C++ codec (utils/hashing.py / kacodec.cc): FNV-1a 64 folded to a nonzero
+// signed int32. Exposed so Go-side tooling can precompute hashes; the WIRE
+// itself carries strings, not hashes.
+func Fold32(s string) int32 {
+	const offset = 0xCBF29CE484222325
+	const prime = 0x100000001B3
+	h := uint64(offset)
+	for i := 0; i < len(s); i++ {
+		h ^= uint64(s[i])
+		h *= prime
+	}
+	h32 := uint32(h ^ (h >> 32))
+	if h32 == 0 {
+		h32 = 1
+	}
+	return int32(h32)
+}
+
+// Taint is one node taint as the wire carries it.
+type Taint struct {
+	Key, Value string
+	Effect     byte // EffectNoSchedule / EffectNoExecute / EffectOther
+}
+
+// Toleration is one pod toleration as the wire carries it.
+type Toleration struct {
+	Key    string
+	Exists bool // operator: false=Equal, true=Exists
+	Value  string
+	Effect byte // EffectOther means "all effects" (empty effect in k8s)
+}
+
+// HostPort is one requested host port.
+type HostPort struct {
+	Port uint16
+	UDP  bool
+}
+
+// Node is the dense node record. Cap is the allocatable vector in the
+// NumResources slot layout; the encoder side owns slot assignment for
+// extended resources and must keep it stable across deltas.
+type Node struct {
+	Name          string
+	Labels        [][2]string // ordered; ordering is part of the byte stream
+	Taints        []Taint
+	Cap           [NumResources]int32
+	Ready         bool
+	Unschedulable bool
+	GroupID       int32 // index into the control plane's node-group list, -1 none
+	Zone          string
+}
+
+// Pod is the dense pod record. Req includes pod overhead
+// (noderesources/fit.go:299). EqKey is the equivalence-group key: an OPAQUE
+// writer-chosen string — pods with equal keys must be schedulable-equivalent
+// (reference: core/scaleup/equivalence/groups.go:40, controller UID + spec
+// hash); "" means the pod is its own group.
+type Pod struct {
+	UID       string
+	NodeName  string // "" = pending
+	Req       [NumResources]int32
+	Selector  [][2]string // ordered (sort by key for canonical bytes)
+	Tols      []Toleration
+	Ports     []HostPort
+	Movable   bool // drainability: evictable, must reschedule
+	Blocks    bool // drainability: forbids draining its node
+	AntiSelf  bool // hostname self-anti-affinity (one per node)
+	Lossy     bool // dense row incomplete -> host-check tier
+	EqKey     string
+}
+
+// AuxRecord is the KAUX constraint side-channel record for one pod
+// (docs/SIDECAR_WIRE.md §KAUX). JSON field names are the wire contract.
+type AuxRecord struct {
+	EqKey     string            `json:"k"`
+	Namespace string            `json:"ns"`
+	Labels    map[string]string `json:"l"`
+	NodeName  string            `json:"n"`
+	DenseOK   bool              `json:"dok"`
+	Spread    *AuxSpread        `json:"s,omitempty"`
+	Affinity  *AuxTerm          `json:"a,omitempty"`
+	Anti      []AuxTerm         `json:"x,omitempty"`
+}
+
+// AuxSpread carries the pod's first DoNotSchedule topologySpreadConstraint.
+// Sel must already contain the matchLabelKeys merge (vendored
+// common.go:96-104 — a static per-pod operation the encoder performs).
+type AuxSpread struct {
+	TopologyKey string            `json:"key"`
+	MaxSkew     int               `json:"w"`
+	Sel         map[string]string `json:"sel"`
+	Extra       bool              `json:"extra"` // more constraints exist
+	MinDomains  int               `json:"md"`
+	NodeAffinityPolicy string     `json:"nap"` // "Honor" | "Ignore"
+	NodeTaintsPolicy   string     `json:"ntp"` // "Ignore" | "Honor"
+}
+
+// AuxTerm is one required (anti-)affinity term.
+type AuxTerm struct {
+	TopologyKey string             `json:"key"`
+	Sel         map[string]string  `json:"sel"`
+	Namespaces  []string           `json:"nss"`
+	NamespaceSelector *map[string]string `json:"nssel"` // nil = absent
+	Extra       bool               `json:"extra"`
+}
+
+// DeltaWriter builds one KAD1 payload (optionally with a KAUX trailer).
+// Mirrors kubernetes_autoscaler_tpu/sidecar/wire.py DeltaWriter. The KAD1
+// body is byte-stable across implementations; the KAUX trailer is JSON and
+// compared semantically (docs/SIDECAR_WIRE.md §Conformance).
+type DeltaWriter struct {
+	body   []byte
+	count  uint32
+	auxUp  map[string]AuxRecord
+	auxDel []string
+	err    error // first overflow/validation error; surfaced by Payload()
+}
+
+func (w *DeltaWriter) fail(format string, args ...any) {
+	if w.err == nil {
+		w.err = fmt.Errorf(format, args...)
+	}
+}
+
+func NewDeltaWriter() *DeltaWriter {
+	return &DeltaWriter{auxUp: map[string]AuxRecord{}}
+}
+
+func (w *DeltaWriter) str(s string) {
+	if len(s) > math.MaxUint16 {
+		// the Python reference raises on overflow; emitting a truncated
+		// string would desync the stream for the decoder
+		w.fail("string field exceeds %d bytes", math.MaxUint16)
+		s = s[:0]
+	}
+	w.body = binary.LittleEndian.AppendUint16(w.body, uint16(len(s)))
+	w.body = append(w.body, s...)
+}
+
+func (w *DeltaWriter) countU8(n int, what string) byte {
+	if n > math.MaxUint8 {
+		w.fail("%s count %d exceeds %d", what, n, math.MaxUint8)
+		return 0
+	}
+	return byte(n)
+}
+
+func (w *DeltaWriter) countU16(n int, what string) uint16 {
+	if n > math.MaxUint16 {
+		w.fail("%s count %d exceeds %d", what, n, math.MaxUint16)
+		return 0
+	}
+	return uint16(n)
+}
+
+func (w *DeltaWriter) i32(v int32) {
+	w.body = binary.LittleEndian.AppendUint32(w.body, uint32(v))
+}
+
+// UpsertNode appends op=1.
+func (w *DeltaWriter) UpsertNode(n Node) *DeltaWriter {
+	w.body = append(w.body, opUpsertNode)
+	w.str(n.Name)
+	w.body = binary.LittleEndian.AppendUint16(
+		w.body, w.countU16(len(n.Labels), "label"))
+	for _, kv := range n.Labels {
+		w.str(kv[0])
+		w.str(kv[1])
+	}
+	w.body = append(w.body, w.countU8(len(n.Taints), "taint"))
+	for _, t := range n.Taints {
+		w.str(t.Key)
+		w.str(t.Value)
+		w.body = append(w.body, t.Effect)
+	}
+	for _, c := range n.Cap {
+		w.i32(c)
+	}
+	var flags byte
+	if n.Ready {
+		flags |= 1
+	}
+	if n.Unschedulable {
+		flags |= 2
+	}
+	w.body = append(w.body, flags)
+	w.i32(n.GroupID)
+	w.str(n.Zone)
+	w.count++
+	return w
+}
+
+// DeleteNode appends op=2.
+func (w *DeltaWriter) DeleteNode(name string) *DeltaWriter {
+	w.body = append(w.body, opDeleteNode)
+	w.str(name)
+	w.count++
+	return w
+}
+
+// UpsertPod appends op=3. aux, when non-nil, rides the KAUX trailer (labels
+// and topology constraints feed the constrained device tier; see
+// docs/SIDECAR_WIRE.md for when a record is required).
+func (w *DeltaWriter) UpsertPod(p Pod, aux *AuxRecord) *DeltaWriter {
+	w.body = append(w.body, opUpsertPod)
+	w.str(p.UID)
+	w.str(p.NodeName)
+	for _, c := range p.Req {
+		w.i32(c)
+	}
+	w.body = binary.LittleEndian.AppendUint16(
+		w.body, w.countU16(len(p.Selector), "selector"))
+	for _, kv := range p.Selector {
+		w.str(kv[0])
+		w.str(kv[1])
+	}
+	w.body = append(w.body, w.countU8(len(p.Tols), "toleration"))
+	for _, t := range p.Tols {
+		w.str(t.Key)
+		if t.Exists {
+			w.body = append(w.body, 1)
+		} else {
+			w.body = append(w.body, 0)
+		}
+		w.str(t.Value)
+		w.body = append(w.body, t.Effect)
+	}
+	w.body = append(w.body, w.countU8(len(p.Ports), "hostPort"))
+	for _, hp := range p.Ports {
+		w.body = binary.LittleEndian.AppendUint16(w.body, hp.Port)
+		if hp.UDP {
+			w.body = append(w.body, 1)
+		} else {
+			w.body = append(w.body, 0)
+		}
+	}
+	var flags byte
+	if p.Movable {
+		flags |= 1
+	}
+	if p.Blocks {
+		flags |= 2
+	}
+	if p.AntiSelf {
+		flags |= 4
+	}
+	if p.Lossy {
+		flags |= 8
+	}
+	w.body = append(w.body, flags)
+	w.str(p.EqKey)
+	w.count++
+	if aux != nil {
+		if aux.Anti != nil {
+			for i := range aux.Anti {
+				if aux.Anti[i].Namespaces == nil {
+					aux.Anti[i].Namespaces = []string{}
+				}
+			}
+		}
+		if aux.Affinity != nil && aux.Affinity.Namespaces == nil {
+			aux.Affinity.Namespaces = []string{}
+		}
+		for i, d := range w.auxDel {
+			if d == p.UID {
+				w.auxDel = append(w.auxDel[:i], w.auxDel[i+1:]...)
+				break
+			}
+		}
+		w.auxUp[p.UID] = *aux
+	} else {
+		if _, had := w.auxUp[p.UID]; had {
+			delete(w.auxUp, p.UID)
+		}
+		w.auxDel = appendUnique(w.auxDel, p.UID)
+	}
+	return w
+}
+
+// DeletePod appends op=4.
+func (w *DeltaWriter) DeletePod(uid string) *DeltaWriter {
+	w.body = append(w.body, opDeletePod)
+	w.str(uid)
+	w.count++
+	delete(w.auxUp, uid)
+	w.auxDel = appendUnique(w.auxDel, uid)
+	return w
+}
+
+func appendUnique(xs []string, s string) []string {
+	for _, x := range xs {
+		if x == s {
+			return xs
+		}
+	}
+	return append(xs, s)
+}
+
+// Payload assembles [KAD1][u32 count][records] with the optional
+// [json][u32 len][u32 crc32][KAUX] trailer.
+func (w *DeltaWriter) Payload() ([]byte, error) {
+	if w.err != nil {
+		return nil, w.err
+	}
+	out := append([]byte("KAD1"), 0, 0, 0, 0)
+	binary.LittleEndian.PutUint32(out[4:], w.count)
+	out = append(out, w.body...)
+	if len(w.auxUp) > 0 || len(w.auxDel) > 0 {
+		doc, err := json.Marshal(map[string]any{
+			"up": w.auxUp, "del": w.auxDel,
+		})
+		if err != nil {
+			return nil, err
+		}
+		out = append(out, doc...)
+		out = binary.LittleEndian.AppendUint32(out, uint32(len(doc)))
+		out = binary.LittleEndian.AppendUint32(out, crc32.ChecksumIEEE(doc))
+		out = append(out, "KAUX"...)
+	}
+	return out, nil
+}

@@ -1,0 +1,94 @@
+"""KAD1/KAUX golden-fixture conformance: the COMMITTED bytes in
+sidecar/goldens/ must decode through the live native codec into the
+COMMITTED tensors, byte for byte. This pins the wire format for independent
+(Go) encoders — any codec or writer change that would break them fails here
+(round-3 review item #5; see docs/SIDECAR_WIRE.md)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetes_autoscaler_tpu.sidecar import conformance
+from kubernetes_autoscaler_tpu.sidecar.native_api import available
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native toolchain unavailable")
+
+_NAMES = [s[0] for s in conformance.scenarios()]
+
+
+def _golden(name):
+    path = os.path.join(conformance.GOLDEN_DIR, f"{name}.npz")
+    assert os.path.exists(path), (
+        f"missing committed golden {path}; regenerate with "
+        f"python -m kubernetes_autoscaler_tpu.sidecar.conformance")
+    return np.load(path)
+
+
+@pytest.mark.parametrize("name", _NAMES)
+def test_committed_goldens_replay_exactly(name):
+    g = _golden(name)
+    payloads = []
+    i = 0
+    while f"payload_{i}" in g:
+        payloads.append(g[f"payload_{i}"].tobytes())
+        i += 1
+    st, (nodes, groups, pods) = conformance.replay(payloads)
+    n, p, grp = st.counts()
+    assert [n, p, grp, st.version] == g["counts"].tolist()
+    for section, got in (("nodes", nodes), ("groups", groups),
+                         ("pods", pods)):
+        for field, arr in got.items():
+            want = g[f"{section}.{field}"]
+            assert np.array_equal(arr, want), (
+                f"{name}: {section}.{field} diverged from committed golden "
+                f"— the wire format or codec semantics changed; if "
+                f"intentional, bump the format and regenerate goldens")
+
+
+def test_writers_still_produce_committed_bytes():
+    """The PYTHON writer's serialization is itself part of the contract: a
+    Go encoder byte-compares against these payloads (manifest.json documents
+    the inputs). DeltaWriter changes that alter bytes must bump the format
+    version and regenerate."""
+    for name, writers, _desc in conformance.scenarios():
+        g = _golden(name)
+        for i, w in enumerate(writers):
+            want = g[f"payload_{i}"].tobytes()
+            assert w.payload() == want, (
+                f"{name} delta {i}: DeltaWriter output changed vs committed "
+                f"golden bytes")
+
+
+def test_manifest_matches_goldens():
+    with open(os.path.join(conformance.GOLDEN_DIR, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert set(manifest) == set(_NAMES)
+    for name, writers, _desc in conformance.scenarios():
+        entries = manifest[name]["deltas"]
+        assert len(entries) == len(writers)
+        for e, w in zip(entries, writers):
+            assert e["bytes"] == len(w.payload())
+
+
+def test_aux_constraints_fixture_carries_round4_fields():
+    g = _golden("aux_constraints")
+    from kubernetes_autoscaler_tpu.sidecar.wire import split_aux
+
+    _body, aux = split_aux(g["payload_0"].tobytes())
+    recs = list(aux["up"].values())
+    spreads = [r["s"] for r in recs if "s" in r]
+    assert any(s.get("md", 1) > 1 or s.get("ntp") == "Honor"
+               for s in spreads)
+    assert any(s["sel"].get("rev") == "r1" for s in spreads)  # merged mlk
+    affs = [r["a"] for r in recs if "a" in r]
+    assert any(a.get("nssel") == {"tier": "prod"} for a in affs)
+
+
+def test_equivalence_fixture_groups_and_alloc():
+    g = _golden("equivalence_and_alloc")
+    counts = g["groups.count"]
+    assert 3 in counts.tolist()            # the three twins share one row
+    assert (g["nodes.alloc"][:2] > 0).any()  # residents charged their hosts
