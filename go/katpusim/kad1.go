@@ -123,8 +123,8 @@ type AuxRecord struct {
 	NodeName  string            `json:"n"`
 	DenseOK   bool              `json:"dok"`
 	Spread    *AuxSpread        `json:"s,omitempty"`
-	Affinity  *AuxTerm          `json:"a,omitempty"`
-	Anti      []AuxTerm         `json:"x,omitempty"`
+	Affinity  *AuxAffinity      `json:"a,omitempty"`
+	Anti      []AuxAnti         `json:"x,omitempty"`
 }
 
 // AuxSpread carries the pod's first DoNotSchedule topologySpreadConstraint.
@@ -140,13 +140,24 @@ type AuxSpread struct {
 	NodeTaintsPolicy   string     `json:"ntp"` // "Ignore" | "Honor"
 }
 
-// AuxTerm is one required (anti-)affinity term.
-type AuxTerm struct {
+// AuxAffinity is the required pod-affinity record ("a"): it carries
+// "extra" (more terms exist than the dense tier models).
+type AuxAffinity struct {
 	TopologyKey string             `json:"key"`
 	Sel         map[string]string  `json:"sel"`
 	Namespaces  []string           `json:"nss"`
 	NamespaceSelector *map[string]string `json:"nssel"` // nil = absent
 	Extra       bool               `json:"extra"`
+}
+
+// AuxAnti is one required anti-affinity term ("x" entries): the Python
+// encoder emits NO "extra" key here, and conformance is a parse-compare —
+// the shapes are deliberately distinct types.
+type AuxAnti struct {
+	TopologyKey string             `json:"key"`
+	Sel         map[string]string  `json:"sel"`
+	Namespaces  []string           `json:"nss"`
+	NamespaceSelector *map[string]string `json:"nssel"` // nil = absent
 }
 
 // DeltaWriter builds one KAD1 payload (optionally with a KAUX trailer).
@@ -296,6 +307,9 @@ func (w *DeltaWriter) UpsertPod(p Pod, aux *AuxRecord) *DeltaWriter {
 	w.str(p.EqKey)
 	w.count++
 	if aux != nil {
+		if w.auxUp == nil {
+			w.auxUp = map[string]AuxRecord{}
+		}
 		if aux.Anti != nil {
 			for i := range aux.Anti {
 				if aux.Anti[i].Namespaces == nil {
