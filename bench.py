@@ -417,6 +417,34 @@ def bench_scaledown(args) -> None:
         file=sys.stderr,
     )
 
+    # worst-case confirm variant: every resident pod PDB-guarded (round-3
+    # review item #6 — this shape used to abandon the native path entirely)
+    from kubernetes_autoscaler_tpu.core.scaledown.pdb import (
+        PodDisruptionBudget,
+        RemainingPdbTracker,
+    )
+
+    budgets = [PodDisruptionBudget("all", match_labels={},
+                                   disruptions_allowed=len(pods))]
+    budgets += [PodDisruptionBudget(f"rs{k}", match_labels={},
+                                    namespace="default",
+                                    disruptions_allowed=len(pods))
+                for k in range(17)]
+    pdb_planner = Planner(fake.provider, opts,
+                          pdb_tracker=RemainingPdbTracker(budgets))
+    pdb_planner.update(enc, nodes, now=2000.0)
+    pdb_planner.nodes_to_delete(enc, nodes, now=2000.0)  # warm
+    pdb_planner.update(enc, nodes, now=2001.0)
+    t0 = time.perf_counter()
+    plan_pdb = pdb_planner.nodes_to_delete(enc, nodes, now=2001.0)
+    pdb_ms = (time.perf_counter() - t0) * 1000.0
+    print(
+        f"[bench-scaledown] all-PDB confirm ({len(budgets)} budgets): "
+        f"{pdb_ms:.1f}ms planned={len(plan_pdb)} "
+        f"within_50ms_target={'yes' if pdb_ms <= 50.0 else 'no'}",
+        file=sys.stderr,
+    )
+
 
 def e2e_metric(args) -> str:
     kp = args.pods // 1000

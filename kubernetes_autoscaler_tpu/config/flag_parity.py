@@ -48,6 +48,7 @@ IMPLEMENTED: dict[str, str] = {
     "initial-node-group-backoff-duration": "initial_node_group_backoff_s",
     "max-allocatable-difference-ratio": "max_allocatable_difference_ratio",
     "force-delete-unregistered-nodes": "force_delete_unregistered_nodes (min-size-ignoring forceful reap)",
+    "scale-down-simulation-timeout": "scale_down_simulation_timeout_s (confirmation-pass deadline)",
     "max-binpacking-time": "max_binpacking_time_s (verify/salvo deadline)",
     "max-bulk-soft-taint-count": "max_bulk_soft_taint_count",
     "max-bulk-soft-taint-time": "max_bulk_soft_taint_time_s",
@@ -147,7 +148,6 @@ REJECTED: dict[str, str] = {
     "record-duplicated-events": "no kube events API",
     "regional": "GCE-SDK specific",
     "scale-down-delay-type-local": "single-process autoscaler; delays are always local",
-    "scale-down-simulation-timeout": "the drain sweep is one bounded device dispatch; a wall-clock timeout cannot trigger",
     "scaleup-simulation-for-skipped-node-groups-enabled": "no groups are skipped: every group's option is computed in the same kernel",
     "startup-taint": "node readiness comes from the data source; startup taints are a kubelet-lifecycle concern",
     "status-taint": "same as startup-taint",

@@ -102,6 +102,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scale-down-gpu-utilization-threshold", type=float, default=0.5)
     p.add_argument("--scale-down-candidates-pool-ratio", type=float, default=1.0)
     p.add_argument("--scale-down-candidates-pool-min-count", type=int, default=50)
+    p.add_argument("--scale-down-simulation-timeout", type=dur, default=30.0)
     p.add_argument("--max-scale-down-parallelism", type=int, default=10)
     p.add_argument("--max-drain-parallelism", type=int, default=1)
     p.add_argument("--max-empty-bulk-delete", type=int, default=10)
@@ -285,6 +286,7 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         drain_chunk=args.drain_chunk,
         max_pods_per_node=args.max_pods_per_node,
         max_pod_eviction_time_s=args.max_pod_eviction_time,
+        scale_down_simulation_timeout_s=args.scale_down_simulation_timeout,
         force_delete_unregistered_nodes=args.force_delete_unregistered_nodes,
         incremental_encode=args.incremental_encode,
         incremental_resync_loops=args.incremental_resync_loops,

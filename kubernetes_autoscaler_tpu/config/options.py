@@ -93,6 +93,13 @@ class AutoscalingOptions:
     # device sweep evaluates every candidate in one dispatch, so the default
     # here is 0 (unlimited). Setting the flag still caps the pool.
     scale_down_non_empty_candidates_count: int = 0
+    # wall-clock budget for the host-side CONFIRMATION pass (reference:
+    # --scale-down-simulation-timeout bounds its serial simulation,
+    # planner.go:297; our device sweep needs no bound, but the sequential
+    # confirm loop over pathological shapes — thousands of accepted drains
+    # with exact-oracle groups — does). Candidates not reached are simply
+    # retried next loop.
+    scale_down_simulation_timeout_s: float = 30.0
     max_scale_down_parallelism: int = 10
     max_drain_parallelism: int = 1
     max_empty_bulk_delete: int = 10

@@ -33,6 +33,7 @@ def _load():
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
     lib.ka_confirm.restype = ctypes.c_int
+    u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
     lib.ka_confirm.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
         i64p, u8p, u8p, i32p,
@@ -40,6 +41,7 @@ def _load():
         ctypes.c_int, i32p,
         ctypes.c_void_p, ctypes.c_void_p, i64p,
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
         u8p, u8p, i32p,
     ]
     _lib = lib
@@ -73,8 +75,11 @@ def confirm(
     node_cap: np.ndarray,        # i64[N, R]
     empty_budget: int, drain_budget: int, total_budget: int,
     max_slot_id: int,
+    slot_pdb_mask: np.ndarray | None = None,   # u64[max_slot_id+1]
+    pdb_remaining: np.ndarray | None = None,   # i64[n_pdbs] — mutated
 ):
-    """Run the native pass; returns (accept u8[C], reason u8[C], dest i32[S])."""
+    """Run the native pass; returns (accept u8[C], reason u8[C], dest i32[S]).
+    Reasons: 0 ok, 1 no-place, 2 group-room, 3 quota, 4 budget, 5 pdb."""
     lib = _load()
     n, r = free.shape
     g = feas.shape[0]
@@ -86,6 +91,12 @@ def confirm(
           if quota_totals is not None else None)
     qm = (quota_min.ctypes.data_as(ctypes.c_void_p)
           if quota_min is not None else None)
+    n_pdbs = int(pdb_remaining.shape[0]) if pdb_remaining is not None else 0
+    sp = (np.ascontiguousarray(slot_pdb_mask, np.uint64)
+          .ctypes.data_as(ctypes.c_void_p)
+          if n_pdbs > 0 else None)
+    pr = (pdb_remaining.ctypes.data_as(ctypes.c_void_p)
+          if n_pdbs > 0 else None)
     rc = lib.ka_confirm(
         n, r, g,
         np.ascontiguousarray(free),
@@ -103,6 +114,7 @@ def confirm(
         qt, qm,
         np.ascontiguousarray(node_cap.astype(np.int64)),
         int(empty_budget), int(drain_budget), int(total_budget),
+        n_pdbs, sp, pr,
         accept, reason, dest,
     )
     if rc < 0:

@@ -57,6 +57,12 @@ class RemainingPdbTracker:
     def get_pdbs(self) -> list[PodDisruptionBudget]:
         return list(self._pdbs)
 
+    def remaining_snapshot(self) -> list[int]:
+        """The LIVE remaining budgets (deductions by concurrent actuator
+        drains included) — what any planning pass must gate against."""
+        with self._lock:
+            return list(self._remaining)
+
     def matching_pdbs(self, pod: Pod) -> list[int]:
         return [i for i, p in enumerate(self._pdbs) if p.matches(pod)]
 

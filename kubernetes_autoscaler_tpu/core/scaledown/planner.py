@@ -199,8 +199,10 @@ class Planner:
     def _native_confirm_pass(self, enc, nodes, ordered, drainable, by_index,
                              name_to_i, node_gid, seen_groups, defaults,
                              ds_by_node, feas, node_valid, greq, pod_slot,
-                             movable_f, group_ref, now):
-        """Marshal the pre-screened candidate list into the C++ pass."""
+                             movable_f, group_ref, now, pdbs=()):
+        """Marshal the pre-screened candidate list into the C++ pass. PDB
+        budgets (≤64) ride as a per-slot membership bitmask — the all-PDB
+        cluster stays on the millisecond native path."""
         from kubernetes_autoscaler_tpu.core.scaledown import native_confirm
 
         # policy pre-screen: drainable verdict + matured unneeded clock
@@ -259,6 +261,30 @@ class Planner:
                 - np.asarray(enc.nodes.alloc)).astype(np.int64)
         group_room = np.asarray(room_vals, np.int32)
         max_slot = int(slot_ids.max()) if slot_ids.size else 0
+        slot_pdb_mask = pdb_remaining = None
+        if pdbs:
+            slot_pdb_mask = np.zeros((max_slot + 1,), np.uint64)
+            # memoized by (namespace, label signature): clusters have few
+            # distinct label sets, so the per-slot cost collapses to a dict
+            # hit (the naive per-pod matching loop was ~80% of the pass)
+            mask_cache: dict[tuple, int] = {}
+            for s in np.unique(slot_ids):
+                pod = (enc.scheduled_pods[int(s)]
+                       if int(s) < len(enc.scheduled_pods) else None)
+                if pod is None:
+                    continue
+                key = (pod.namespace, tuple(sorted(pod.labels.items())))
+                mask = mask_cache.get(key)
+                if mask is None:
+                    mask = 0
+                    for pi in self.pdb_tracker.matching_pdbs(pod):
+                        mask |= 1 << pi
+                    mask_cache[key] = mask
+                slot_pdb_mask[int(s)] = mask
+            # the tracker's LIVE remaining counts, not the static allowance
+            # — concurrent actuator drains may have deducted already
+            pdb_remaining = np.asarray(
+                self.pdb_tracker.remaining_snapshot(), np.int64)
         accept, reason, dest = native_confirm.confirm(
             free, feas, node_valid, greq,
             np.asarray(cand_node, np.int32),
@@ -270,9 +296,10 @@ class Planner:
             self.options.max_drain_parallelism,
             self.options.max_scale_down_parallelism,
             max_slot,
+            slot_pdb_mask=slot_pdb_mask, pdb_remaining=pdb_remaining,
         )
         reasons = {1: "NoPlaceToMovePods", 2: "NodeGroupMinSizeReached",
-                   3: "MinimalResourceLimitExceeded"}
+                   3: "MinimalResourceLimitExceeded", 5: "NotEnoughPdb"}
         out: list[NodeToRemove] = []
         for j, (i, _) in enumerate(cand_rows):
             nd = nodes[i]
@@ -354,6 +381,10 @@ class Planner:
         feas = np.asarray(removal.feas)              # bool[G, N]
         by_index = {int(c): k for k, c in enumerate(cand)}
         name_to_i = {nd.name: i for i, nd in enumerate(nodes)}
+        # host-pass wall-clock budget (reference: ScaleDownSimulationTimeout,
+        # planner.go:297) — candidates not reached retry next loop
+        confirm_deadline = (time.monotonic()
+                            + self.options.scale_down_simulation_timeout_s)
 
         # Sequential confirmation: walk unneeded nodes (oldest clock first),
         # re-placing each candidate's pods — original AND any received from
@@ -441,21 +472,21 @@ class Planner:
         # Milliseconds at 5k nodes / 50k pods where Python/numpy takes
         # seconds; tests/test_native_confirm.py proves plan-equality vs the
         # Python pass below.
-        pdb_active = (self.pdb_tracker is not None
-                      and len(self.pdb_tracker.get_pdbs()) > 0)
-        if not atomic_gids and not pdb_active:
+        pdbs = self.pdb_tracker.get_pdbs() if self.pdb_tracker else []
+        if not atomic_gids and len(pdbs) <= 64:
             from kubernetes_autoscaler_tpu.core.scaledown import native_confirm
 
             moved_groups = np.unique(group_ref[
                 np.asarray(enc.scheduled.valid) & movable_f])
             special = (need_exact[moved_groups].any()
                        or limit_g[moved_groups].any()) if moved_groups.size else False
-            if not special and native_confirm.available():
+            if (not special and native_confirm.available()
+                    and time.monotonic() <= confirm_deadline):
                 out = self._native_confirm_pass(
                     enc, nodes, ordered, drainable, by_index, name_to_i,
                     node_gid, seen_groups, defaults, ds_by_node,
                     feas, node_valid, greq, pod_slot, movable_f, group_ref,
-                    now)
+                    now, pdbs)
                 if out is not None:
                     return out
 
@@ -469,9 +500,7 @@ class Planner:
         excluded_gids: set[str] = set()
 
         def attempt(names: list[str]) -> tuple[list[NodeToRemove], dict[int, int], set[str]]:
-            import copy as _copy
 
-            from kubernetes_autoscaler_tpu.utils import oracle as _oracle
 
             free = (np.asarray(enc.nodes.cap)
                     - np.asarray(enc.nodes.alloc)).astype(np.int64)
@@ -487,12 +516,23 @@ class Planner:
                 free[d] -= sign * req_vec
                 fits_m[:, d] = (feas[:, d] & node_valid[d]
                                 & (free[d][None, :] >= greq).all(axis=1))
-            # oracle world for exact-checked moves (rebuilt per attempt)
+            # oracle world for exact-checked moves (rebuilt per attempt):
+            # the ConfirmOracle maintains per-constraint domain counts
+            # incrementally, so each destination verdict is O(domains)
+            # instead of O(nodes x pods) (round-3 review Weak #4)
+            from kubernetes_autoscaler_tpu.utils.oracle_cache import (
+                ConfirmOracle,
+            )
+
             by_node: dict[str, list] = {}
             for q in enc.scheduled_pods:
                 if q is None:  # freed slot (incremental encoder hole)
                     continue
                 by_node.setdefault(q.node_name, []).append(q)
+            oracle_world = ConfirmOracle(
+                list(nodes), by_node, registry=enc.registry,
+                namespaces=enc.namespaces)
+            del by_node  # the oracle world owns it from here
             received_slots: dict[int, list[int]] = {}
             moved_marks: set[tuple[int, int]] = set()
             final_dest: dict[int, int] = {}
@@ -508,6 +548,8 @@ class Planner:
             for name in names:
                 if len(out) >= total_budget:
                     break
+                if time.monotonic() > confirm_deadline:
+                    break  # --scale-down-simulation-timeout: retry next loop
                 i = name_to_i.get(name)
                 if i is None or i not in by_index:
                     continue
@@ -614,27 +656,22 @@ class Planner:
                         if need_exact[g_ref] and pod_obj is not None:
                             # unschedule from the oracle world, then exact-check
                             # each dense-feasible destination in index order
-                            src_list = by_node.get(pod_obj.node_name, [])
-                            if pod_obj in src_list:
-                                src_list.remove(pod_obj)
-                            alive = [nd for k, nd in enumerate(nodes)
-                                     if not deleted_mask[k]]
+                            src_name = pod_obj.node_name
+                            oracle_world.move(pod_obj, src_name, "")
                             d = -1
                             for cand_d in np.nonzero(fits)[0]:
-                                if _oracle.check_pod_in_cluster(
-                                        pod_obj, nodes[int(cand_d)], alive, by_node,
-                                        registry=enc.registry,
-                                        namespaces=enc.namespaces):
+                                if oracle_world.check(pod_obj,
+                                                      nodes[int(cand_d)]):
                                     d = int(cand_d)
                                     break
                             if d < 0:
-                                src_list.append(pod_obj)  # restore the world
+                                # restore the world
+                                oracle_world.move(pod_obj, "", src_name)
                                 ok = False
                                 break
-                            clone = _copy.deepcopy(pod_obj)
-                            clone.node_name = nodes[d].name
-                            by_node.setdefault(nodes[d].name, []).append(clone)
-                            local_pod_moves.append((pod_obj, pod_obj.node_name, clone))
+                            oracle_world.move(pod_obj, "", nodes[d].name)
+                            local_pod_moves.append(
+                                (pod_obj, src_name, nodes[d].name))
                         else:
                             d = int(np.argmax(fits))
                             if not fits[d]:
@@ -651,11 +688,8 @@ class Planner:
                     # by an earlier candidate this round)
                     for slot, d in moves.items():
                         charge(d, reqs[slot], -1)
-                    for pod_obj, src_name, clone in local_pod_moves:
-                        dst = by_node.get(clone.node_name, [])
-                        if clone in dst:
-                            dst.remove(clone)
-                        by_node.setdefault(src_name, []).append(pod_obj)
+                    for pod_obj, src_name, dst_name in local_pod_moves:
+                        oracle_world.move(pod_obj, dst_name, src_name)
                     self._mark(name, "NoPlaceToMovePods", now)
                     continue
 
@@ -672,7 +706,8 @@ class Planner:
                 else:
                     drain_budget -= 1
                 deleted_mask[i] = True
-                by_node.pop(nd.name, None)  # node gone: daemonset leftovers vanish
+                # node gone (daemonset leftovers vanish with it)
+                oracle_world.remove_node(nd.name)
                 for slot, d in moves.items():
                     received_slots.setdefault(d, []).append(slot)
                     final_dest[slot] = d

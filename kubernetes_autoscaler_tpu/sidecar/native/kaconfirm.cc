@@ -8,8 +8,13 @@
 // 50k pods; this kernel does the identical algorithm in milliseconds.
 //
 // Semantics (mirrors core/scaledown/planner.py attempt(), fast-path subset —
-// no PDBs, no exact-oracle groups, no one-per-node groups, no atomic groups;
-// the Python loop remains the fallback for those):
+// no exact-oracle groups, no one-per-node groups, no atomic groups; the
+// Python loop remains the fallback for those. PDB budgets ARE handled:
+// up to 64 PodDisruptionBudgets ride as a per-slot membership bitmask +
+// a remaining-budget vector, gating candidates over their ORIGINAL
+// resident slots exactly as the Python pass's can_remove_pods +
+// accumulated reservation do — round-3 review Weak #3/#6, the all-PDB
+// cluster previously fell back to the seconds-long Python pass):
 //   * candidates processed in the given order (oldest unneeded clock first)
 //   * per candidate: its victim slots (original residents + pods RECEIVED
 //     from earlier accepted drains) re-place group-by-group, first feasible
@@ -38,7 +43,8 @@ struct Move {
 extern "C" {
 
 // Returns the number of accepted candidates, or -1 on bad arguments.
-// reason_out: 0 accepted, 1 no-place, 2 group-room, 3 quota, 4 budget-skip.
+// reason_out: 0 accepted, 1 no-place, 2 group-room, 3 quota, 4 budget-skip,
+//             5 pdb-budget.
 int ka_confirm(
     int n, int r, int g,
     int64_t* free_io,            // [n*r] free capacity, mutated in place
@@ -57,12 +63,18 @@ int ka_confirm(
     const int64_t* quota_min,    // [r] min limits (or null)
     const int64_t* node_cap,     // [n*r] per-node capacity (for quota deduct)
     int empty_budget, int drain_budget, int total_budget,
+    int n_pdbs,                  // 0..64 (0 = no PDB gating)
+    const uint64_t* slot_pdb,    // [max_slot_id+1] membership bitmask, or null
+    int64_t* pdb_remaining,      // [n_pdbs] budgets, deducted on accept
     uint8_t* accept_out,         // [n_cand]
     uint8_t* reason_out,         // [n_cand]
     int32_t* dest_out)           // slot id -> destination (indexed by slot id;
                                  // caller sizes it max_slot_id+1, fills -1)
 {
   if (n <= 0 || r <= 0 || g <= 0 || n_cand < 0) return -1;
+  if (n_pdbs < 0 || n_pdbs > 64) return -1;
+  if (n_pdbs > 0 && (slot_pdb == nullptr || pdb_remaining == nullptr))
+    return -1;
   std::vector<uint8_t> deleted(n, 0);
   // pods moved ONTO a node (re-placed again if that node later drains)
   std::vector<std::vector<Move>> received(n);
@@ -108,6 +120,32 @@ int ka_confirm(
       if (empty_budget <= 0) continue;
     } else {
       if (drain_budget <= 0) continue;
+    }
+
+    // PDB gate over the ORIGINAL resident slots only (received pods were
+    // accounted when their own node was confirmed — planner.py comment)
+    int64_t pdb_need[64];
+    if (n_pdbs > 0) {
+      for (int p = 0; p < n_pdbs; ++p) pdb_need[p] = 0;
+      for (int s = slot_off[c]; s < slot_off[c + 1]; ++s) {
+        uint64_t mask = slot_pdb[slot_ids[s]];
+        while (mask) {
+          int p = __builtin_ctzll(mask);
+          mask &= mask - 1;
+          ++pdb_need[p];
+        }
+      }
+      bool pdb_ok = true;
+      for (int p = 0; p < n_pdbs; ++p) {
+        if (pdb_need[p] > pdb_remaining[p]) {
+          pdb_ok = false;
+          break;
+        }
+      }
+      if (!pdb_ok) {
+        reason_out[c] = 5;
+        continue;
+      }
     }
 
     // place group-by-group (stable-sorted so equal groups are consecutive),
@@ -190,6 +228,8 @@ int ka_confirm(
     accept_out[c] = 1;
     reason_out[c] = 0;
     ++accepted;
+    if (n_pdbs > 0)
+      for (int p = 0; p < n_pdbs; ++p) pdb_remaining[p] -= pdb_need[p];
     deleted[cand] = 1;
     group_room[gi_room] -= 1;
     if (is_empty) --empty_budget; else --drain_budget;

@@ -120,7 +120,10 @@ class CertManager:
             tmp_c, tmp_k = self.cert_path + ".tmp", self.key_path + ".tmp"
             with open(tmp_c, "wb") as f:
                 f.write(cert_pem)
-            with open(tmp_k, "wb") as f:
+            # the private key must never be world-readable (0600, like the
+            # k8s cert managers write theirs)
+            fd = os.open(tmp_k, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "wb") as f:
                 f.write(key_pem)
             os.replace(tmp_c, self.cert_path)
             os.replace(tmp_k, self.key_path)

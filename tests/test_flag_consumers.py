@@ -282,3 +282,16 @@ def test_max_free_difference_ratio_gates_balancing():
                                   free_a=free_same, free_b=free_far)
     assert _similar_templates(tmpl_a, tmpl_b, loose,
                               free_a=free_same, free_b=free_far)
+
+
+def test_scale_down_simulation_timeout_bounds_the_confirm_pass():
+    """--scale-down-simulation-timeout: a zero budget stops the host-side
+    confirmation pass before any candidate confirms (they retry next loop)."""
+    fake = _idle_world(3)
+    a = autoscaler_for(fake, scale_down_simulation_timeout_s=0.0,
+                       node_group_defaults=IDLE_DEFAULTS)
+    st = a.run_once(now=1000.0)
+    assert st.unneeded_nodes and not st.scale_down_deleted
+    b = autoscaler_for(fake, node_group_defaults=IDLE_DEFAULTS)
+    st = b.run_once(now=2000.0)
+    assert st.scale_down_deleted

@@ -153,3 +153,48 @@ def test_frontier_hint_rewinds_for_all_groups_on_revert():
     assert list(accept) == [0, 1], (list(accept), list(reason))
     assert reason[0] == 1  # candidate 1 genuinely has no place for B
     assert dest[2] == 0    # candidate 2's group-B pod lands on node 0
+
+
+def test_native_matches_python_with_pdbs(monkeypatch):
+    """PDB budgets now ride the native pass (round-4): randomized worlds with
+    label-selector PDBs must produce identical plans either way."""
+    from kubernetes_autoscaler_tpu.core.scaledown.pdb import (
+        PodDisruptionBudget,
+    )
+
+    for trial in range(4):
+        rng = random.Random(300 + trial)
+        fake, enc, nodes = _world(rng, n_nodes=rng.randint(8, 14))
+        # label half the resident pods; budget tight enough to bite
+        for j, p in enumerate(fake.pods.values()):
+            if j % 2 == 0:
+                p.labels["guard"] = "yes"
+        fake.add_pdb(PodDisruptionBudget(
+            "g1", match_labels={"guard": "yes"},
+            disruptions_allowed=rng.randint(0, 3)))
+        fake.add_pdb(PodDisruptionBudget(
+            "all", match_labels={}, disruptions_allowed=rng.randint(2, 8)))
+
+        def _plan_pdb(use_native):
+            if not use_native:
+                monkeypatch.setattr(native_confirm, "_available", False)
+            else:
+                monkeypatch.setattr(native_confirm, "_available", None)
+            from kubernetes_autoscaler_tpu.core.scaledown.pdb import (
+                RemainingPdbTracker,
+            )
+
+            tracker = RemainingPdbTracker(fake.list_pdbs())
+            pl = Planner(fake.provider, _opts(
+                max_scale_down_parallelism=len(nodes),
+                max_drain_parallelism=len(nodes),
+                max_empty_bulk_delete=len(nodes)), pdb_tracker=tracker)
+            pl.update(enc, nodes, now=1000.0)
+            out = pl.nodes_to_delete(enc, nodes, now=1000.0)
+            return {r.node.name: (r.is_empty, sorted(r.pods_to_move),
+                                  dict(sorted(r.destinations.items())))
+                    for r in out}
+
+        a = _plan_pdb(True)
+        b = _plan_pdb(False)
+        assert a == b, f"trial {trial}"

@@ -1,0 +1,117 @@
+"""ConfirmOracle ≡ oracle.check_pod_in_cluster under randomized mutation
+sequences (the incremental constraint cache that bounds the confirmation
+pass's host-check tier — round-3 review Weak #4 / item #6)."""
+
+import random
+
+from kubernetes_autoscaler_tpu.models.api import (
+    AffinityTerm,
+    Taint,
+    TopologySpreadConstraint,
+)
+from kubernetes_autoscaler_tpu.utils import oracle
+from kubernetes_autoscaler_tpu.utils.oracle_cache import ConfirmOracle
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def _world(rng):
+    nodes = []
+    for i in range(rng.randint(6, 10)):
+        nodes.append(build_test_node(
+            f"n{i}", cpu_milli=8000, mem_mib=16384, pods=32,
+            labels={"pool": rng.choice(["x", "y"])},
+            taints=[Taint("dedicated", "infra", "NoSchedule")]
+            if rng.random() < 0.3 else [],
+            zone=rng.choice(["a", "b", "c", ""]),
+        ))
+    residents = []
+    for i in range(rng.randint(8, 16)):
+        p = build_test_pod(
+            f"r{i}", cpu_milli=rng.choice([100, 400]), mem_mib=128,
+            namespace=rng.choice(["default", "team-a"]),
+            labels={"app": rng.choice(["web", "db", "api"]),
+                    "rev": rng.choice(["r1", "r2"])},
+            owner_name=f"rs{i % 3}",
+            node_name=rng.choice(nodes).name)
+        residents.append(p)
+    return nodes, residents
+
+
+def _probe_pods(rng):
+    out = []
+    for i in range(6):
+        p = build_test_pod(
+            f"q{i}", cpu_milli=100, mem_mib=64,
+            namespace=rng.choice(["default", "team-a"]),
+            labels={"app": rng.choice(["web", "db"]), "rev": "r1"},
+            node_selector={"pool": "x"} if rng.random() < 0.4 else None)
+        roll = rng.random()
+        if roll < 0.35:
+            p.topology_spread = [TopologySpreadConstraint(
+                max_skew=rng.choice([1, 2]),
+                topology_key=rng.choice(["topology.kubernetes.io/zone",
+                                         "kubernetes.io/hostname"]),
+                match_labels={"app": "web"},
+                match_label_keys=("rev",) if rng.random() < 0.5 else (),
+                min_domains=rng.choice([1, 1, 3]),
+                node_affinity_policy=rng.choice(["Honor", "Ignore"]),
+                node_taints_policy=rng.choice(["Ignore", "Honor"]))]
+        elif roll < 0.6:
+            p.anti_affinity = [AffinityTerm(
+                match_labels={"app": rng.choice(["web", "db"])},
+                topology_key=rng.choice(["topology.kubernetes.io/zone",
+                                         "kubernetes.io/hostname"]),
+                namespace_selector={"tier": "prod"}
+                if rng.random() < 0.3 else None)]
+        elif roll < 0.8:
+            p.pod_affinity = [AffinityTerm(
+                match_labels={"app": "web"},
+                topology_key="topology.kubernetes.io/zone")]
+        out.append(p)
+    return out
+
+
+def test_cache_matches_oracle_under_mutations():
+    namespaces = {"default": {"tier": "prod"}, "team-a": {"tier": "dev"}}
+    for seed in range(6):
+        rng = random.Random(400 + seed)
+        nodes, residents = _world(rng)
+        probes = _probe_pods(rng)
+        by_node = oracle.group_pods_by_node(residents)
+        cache = ConfirmOracle(nodes, by_node, namespaces=namespaces)
+        alive = list(nodes)
+
+        def assert_agree(step):
+            for p in probes:
+                for nd in rng.sample(alive, min(3, len(alive))):
+                    want = oracle.check_pod_in_cluster(
+                        p, nd, alive, by_node, namespaces=namespaces)
+                    got = cache.check(p, nd)
+                    assert got == want, (
+                        f"seed {seed} step {step}: {p.name} on {nd.name}: "
+                        f"cache={got} oracle={want}")
+
+        assert_agree("init")
+        for step in range(12):
+            op = rng.random()
+            if op < 0.6 and residents:
+                # move a resident (possibly to 'unscheduled')
+                q = rng.choice(residents)
+                src = q.node_name
+                dst = rng.choice([nd.name for nd in alive] + [""]) \
+                    if alive else ""
+                cache.move(q, src, dst)
+                if src and q in by_node.get(src, []):
+                    by_node[src].remove(q)
+                if dst:
+                    by_node.setdefault(dst, []).append(q)
+                q.node_name = dst
+            elif len(alive) > 3:
+                # remove a node (its leftover pods vanish with it)
+                nd = rng.choice(alive)
+                cache.remove_node(nd.name)
+                for q in by_node.pop(nd.name, []):
+                    q.node_name = ""
+                    residents.remove(q)
+                alive.remove(nd)
+            assert_agree(step)
