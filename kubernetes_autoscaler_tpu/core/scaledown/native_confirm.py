@@ -33,7 +33,6 @@ def _load():
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
     lib.ka_confirm.restype = ctypes.c_int
-    u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
     lib.ka_confirm.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
         i64p, u8p, u8p, i32p,

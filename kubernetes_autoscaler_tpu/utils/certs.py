@@ -121,7 +121,13 @@ class CertManager:
             with open(tmp_c, "wb") as f:
                 f.write(cert_pem)
             # the private key must never be world-readable (0600, like the
-            # k8s cert managers write theirs)
+            # k8s cert managers write theirs). Unlink first: os.open's mode
+            # applies only on CREATION — a leftover tmp from a crashed run
+            # would keep its old permissions
+            try:
+                os.unlink(tmp_k)
+            except FileNotFoundError:
+                pass
             fd = os.open(tmp_k, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
             with os.fdopen(fd, "wb") as f:
                 f.write(key_pem)

@@ -1,0 +1,141 @@
+"""Two-host control-plane HA (round-3 review item #9): two real processes
+contend for one lease — exactly one leads; killing the leader fails over to
+the standby (reference: active/passive leaderelection.RunOrDie,
+main.go:271-319; flock releases on process death like a Lease expiring).
+Plus the DCN leg: parallel/multihost.initialize joins two separate processes
+into one JAX distributed cluster whose global device set spans both.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CONTENDER = r"""
+import os, sys, time, threading
+sys.path.insert(0, {repo!r})
+from kubernetes_autoscaler_tpu.utils.leaderelection import FileLeaderElector
+
+lease, out = sys.argv[1], sys.argv[2]
+elector = FileLeaderElector(lease, retry_period_s=0.05)
+
+def lead():
+    while True:
+        with open(out, "w") as f:
+            f.write(f"{{os.getpid()}} {{time.time()}}")
+        time.sleep(0.05)
+
+elector.run_or_die(lead, timeout_s=30.0)
+"""
+
+
+def _cpu_env():
+    env = {k: v for k, v in os.environ.items() if "AXON" not in k.upper()}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _heartbeat_pid(path, deadline_s=10.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            with open(path) as f:
+                parts = f.read().split()
+            if len(parts) == 2:
+                return int(parts[0]), float(parts[1])
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"no heartbeat in {path}")
+
+
+def test_two_process_lease_contention_and_failover(tmp_path):
+    lease = str(tmp_path / "lease.lock")
+    script = str(tmp_path / "contender.py")
+    with open(script, "w") as f:
+        f.write(_CONTENDER.format(repo=REPO))
+    out_a, out_b = str(tmp_path / "a.hb"), str(tmp_path / "b.hb")
+    env = _cpu_env()
+    a = subprocess.Popen([sys.executable, script, lease, out_a], env=env)
+    b = subprocess.Popen([sys.executable, script, lease, out_b], env=env)
+    try:
+        # exactly one leads (the other's heartbeat file never appears)
+        pid, _ = _heartbeat_pid(out_a if os.path.exists(out_a)
+                                or not os.path.exists(out_b) else out_b)
+        time.sleep(0.5)
+        leading = [p for p in (out_a, out_b) if os.path.exists(p)]
+        assert len(leading) == 1, "both replicas think they lead"
+        leader_path = leading[0]
+        standby_path = out_b if leader_path == out_a else out_a
+        leader_pid, _ = _heartbeat_pid(leader_path)
+        assert leader_pid in (a.pid, b.pid)
+
+        # kill the leader: the standby must take over (flock released on
+        # process death — the Lease-expiry analog)
+        os.kill(leader_pid, signal.SIGKILL)
+        new_pid, _ = _heartbeat_pid(standby_path, deadline_s=15.0)
+        assert new_pid != leader_pid
+        assert new_pid in (a.pid, b.pid)
+    finally:
+        for p in (a, b):
+            if p.poll() is None:
+                p.kill()
+        a.wait(timeout=10)
+        b.wait(timeout=10)
+
+
+_DCN_WORKER = r"""
+import os, sys, json
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from kubernetes_autoscaler_tpu.parallel import multihost
+
+ok = multihost.initialize(
+    coordinator_address=sys.argv[1],
+    num_processes=2,
+    process_id=int(sys.argv[2]),
+)
+print(json.dumps({{
+    "distributed": ok,
+    "process_index": jax.process_index(),
+    "global_devices": len(jax.devices()),
+    "local_devices": len(jax.local_devices()),
+}}), flush=True)
+"""
+
+
+def test_dcn_init_joins_two_processes(tmp_path):
+    """parallel/multihost.initialize: two processes form one JAX cluster —
+    the global device set spans both hosts (the DCN leg of SURVEY §5.8)."""
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(_DCN_WORKER.format(repo=REPO))
+    env = _cpu_env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    addr = "127.0.0.1:29517"
+    procs = [subprocess.Popen([sys.executable, script, addr, str(i)],
+                              env=env, stdout=subprocess.PIPE, text=True)
+             for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            assert p.returncode == 0, out
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert all(o["distributed"] for o in outs)
+    assert sorted(o["process_index"] for o in outs) == [0, 1]
+    # each contributes its 2 forced CPU devices to a 4-device global set
+    assert all(o["global_devices"] == 4 for o in outs)
+    assert all(o["local_devices"] == 2 for o in outs)
