@@ -91,6 +91,11 @@ class ScaleUpOrchestrator:
         # AsyncNodeGroupCreator when --async-node-group-creation is on
         # (reference: CreateNodeGroupAsync orchestrator.go:453)
         self.async_creator = async_creator
+        # template-tensor cache: the static planes (cap/labels/taints/zone)
+        # change only when templates change; max_new/price change every
+        # accepted scale-up and are refreshed as two small arrays instead of
+        # re-encoding + re-uploading the whole NodeGroupTensors per loop
+        self._group_tensor_cache: tuple | None = None
 
     # ---- node-group validity (reference: filterValidScaleUpNodeGroups :152) ----
 
@@ -150,9 +155,7 @@ class ScaleUpOrchestrator:
                 tmpl.unschedulable = False
             templates.append((tmpl, g.max_size() - g.target_size(),
                               getattr(g, "price_per_node", 1.0)))
-        group_tensors = encode_node_groups(
-            templates, enc.registry, enc.zone_table, enc.dims
-        )
+        group_tensors = self._group_tensors(templates, enc)
         est = estimator.estimate_all_groups(enc.specs, group_tensors, nodes_count)
         scores = scoring.score_options(est, group_tensors, specs=enc.specs)
         # non-allocating lookup: try_slot_for would BURN one of the four
@@ -273,6 +276,45 @@ class ScaleUpOrchestrator:
                                  if gpu_slot is not None else 0.0),
                 ))
         return out
+
+    def _group_tensors(self, templates, enc):
+        """encode_node_groups with the static planes cached across loops."""
+        import jax.numpy as jnp
+
+        from kubernetes_autoscaler_tpu.models.cluster_state import pad_to
+
+        fp = (
+            tuple(
+                (tmpl.name, tuple(sorted(tmpl.labels.items())),
+                 tuple((t.key, t.value, t.effect) for t in tmpl.taints),
+                 tuple(sorted((k, float(v))
+                              for k, v in tmpl.alloc_or_cap().items())))
+                for tmpl, _mx, _pr in templates
+            ),
+            len(enc.registry.slots),
+            # the full MAPPING, not its size: a rebuild can reassign the
+            # same number of zone ids in a different first-seen order
+            tuple(sorted(enc.zone_table.ids.items())),
+            enc.dims,
+        )
+        cached = self._group_tensor_cache
+        if cached is not None and cached[0] == fp:
+            gt = cached[1]
+            ng_pad = pad_to(max(len(templates), 1), 8)
+            if gt.ng == ng_pad:
+                max_new = np.zeros((ng_pad,), np.int32)
+                price = np.zeros((ng_pad,), np.float32)
+                for i, (_tmpl, mx, pr) in enumerate(templates):
+                    max_new[i] = mx
+                    price[i] = pr
+                gt = gt.replace(max_new=jnp.asarray(max_new),
+                                price_per_node=jnp.asarray(price))
+                self._group_tensor_cache = (fp, gt)
+                return gt
+        gt = encode_node_groups(templates, enc.registry, enc.zone_table,
+                                enc.dims)
+        self._group_tensor_cache = (fp, gt)
+        return gt
 
     # ---- similar-group balancing (reference: compare_nodegroups.go:105) ----
 

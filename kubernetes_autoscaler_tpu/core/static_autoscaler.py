@@ -171,6 +171,7 @@ class StaticAutoscaler:
         # incremental snapshot maintenance (models/incremental.py); created
         # lazily so DrainOptions reflect the live flag values
         self._encoder = None
+        self._last_lowering_key = None
 
         # ProvisioningRequest wiring (reference: builder/autoscaler.go wraps
         # the scale-up orchestrator when ProvReq support is on) — active when
@@ -307,19 +308,31 @@ class StaticAutoscaler:
             dra_snapshot_fn = (getattr(self.source, "dra_snapshot", None)
                                if self.options.enable_dynamic_resource_allocation
                                else None)
+            lowering_key = None
             if dra_snapshot_fn is not None:
                 from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
                     apply_dra,
                 )
 
-                apply_dra(nodes, pods, dra_snapshot_fn())
+                dra = dra_snapshot_fn()
+                apply_dra(nodes, pods, dra)
+                lowering_key = (dra.content_key(),)
             csi_snapshot_fn = (getattr(self.source, "csi_snapshot", None)
                                if self.options.enable_csi_node_aware_scheduling
                                else None)
             if csi_snapshot_fn is not None:
                 from kubernetes_autoscaler_tpu.simulator.csi import apply_csi
 
-                apply_csi(nodes, pods, csi_snapshot_fn())
+                csi = csi_snapshot_fn()
+                apply_csi(nodes, pods, csi)
+                lowering_key = (lowering_key, csi.content_key())
+            # DRA/CSI lowering REWRITES the same objects in place each loop;
+            # identity diffing cannot see that, so a lowering-state change
+            # must force the incremental encoder to rebuild
+            if (self._encoder is not None
+                    and lowering_key != self._last_lowering_key):
+                self._encoder.invalidate()
+            self._last_lowering_key = lowering_key
 
             # tensor snapshot — incrementally maintained across loops by
             # default (models/incremental.py; reference rationale:

@@ -148,6 +148,13 @@ class IncrementalEncoder:
 
     # ------------------------------------------------------------------ API
 
+    def invalidate(self) -> None:
+        """Force the next encode() to full-rebuild. The control plane calls
+        this when an out-of-band lowering pass (DRA/CSI) mutated the SAME
+        Node/Pod objects in place — a change object-identity diffing cannot
+        see (the snapshots' content_key comparison drives this)."""
+        self._seeded = False
+
     def encode(
         self,
         nodes: list[Node],

@@ -44,9 +44,24 @@ class CsiSnapshot:
     def add(self, csi_node: CSINode) -> None:
         self.csi_nodes[csi_node.node_name] = csi_node
 
+    def content_key(self) -> tuple:
+        """Change fingerprint — see DraSnapshot.content_key."""
+        return (
+            tuple(sorted(
+                (name, tuple(sorted((d.name, d.allocatable_count)
+                                    for d in cn.drivers)))
+                for name, cn in self.csi_nodes.items())),
+            tuple(sorted(self.pvc_driver.items())),
+        )
+
 
 def apply_csi(nodes: list[Node], pods: list[Pod], csi: CsiSnapshot) -> None:
-    """Lower volume limits into the resource axis before encode_cluster."""
+    """Lower volume limits into the resource axis before encode_cluster.
+
+    Like apply_dra, previously-lowered state is CLEARED first so removed
+    CSINodes/PVC mappings leave no phantom limits on the persistent
+    objects."""
+    clear_csi_lowering(nodes, pods)
     drivers_seen: set[str] = set()
     for nd in nodes:
         cn = csi.csi_nodes.get(nd.name)
@@ -95,4 +110,31 @@ def apply_csi(nodes: list[Node], pods: list[Pod], csi: CsiSnapshot) -> None:
             if driver in drivers_seen:
                 pod.requests[CSI_RESOURCE_PREFIX + driver] = n
         if lossy:
+            from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+                CSI_LOSSY_ANNOTATION,
+            )
+
             pod.annotations[HOST_CHECK_ANNOTATION] = "true"
+            pod.annotations[CSI_LOSSY_ANNOTATION] = "true"
+
+
+def clear_csi_lowering(nodes: list[Node], pods: list[Pod]) -> None:
+    """Remove everything a previous apply_csi pass wrote."""
+    from kubernetes_autoscaler_tpu.models.api import HOST_CHECK_ANNOTATION
+    from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+        CSI_LOSSY_ANNOTATION,
+        DRA_LOSSY_ANNOTATION,
+    )
+
+    for nd in nodes:
+        for store in (nd.capacity, nd.allocatable):
+            if not store:
+                continue
+            for k in [k for k in store if k.startswith(CSI_RESOURCE_PREFIX)]:
+                del store[k]
+    for p in pods:
+        for k in [k for k in p.requests if k.startswith(CSI_RESOURCE_PREFIX)]:
+            del p.requests[k]
+        if p.annotations.pop(CSI_LOSSY_ANNOTATION, None) is not None \
+                and DRA_LOSSY_ANNOTATION not in p.annotations:
+            p.annotations.pop(HOST_CHECK_ANNOTATION, None)

@@ -32,6 +32,14 @@ from kubernetes_autoscaler_tpu.models.api import (
 )
 
 DRA_RESOURCE_PREFIX = "dra/"
+# markers recording what apply_dra wrote onto the persistent objects, so the
+# next pass can CLEAR residue when claims/slices disappear (the loop
+# re-lists the same Node/Pod objects every tick)
+DRA_PIN_ANNOTATION = "autoscaler.x-k8s.io/dra-pinned-host"
+# the USER's own hostname selector value the pin overwrote (restored on clear)
+DRA_PIN_PREV_ANNOTATION = "autoscaler.x-k8s.io/dra-pinned-host-prev"
+DRA_LOSSY_ANNOTATION = "autoscaler.x-k8s.io/host-check-dra"
+CSI_LOSSY_ANNOTATION = "autoscaler.x-k8s.io/host-check-csi"
 
 
 @dataclass
@@ -100,6 +108,32 @@ class DraSnapshot:
     claims: list[ResourceClaim] = field(default_factory=list)
     _stack: list[dict[str, tuple[str, tuple[str, ...]]]] = field(
         default_factory=list, repr=False)
+
+    def content_key(self) -> tuple:
+        """Cheap change fingerprint for the incremental encoder: the DRA
+        lowering (apply_dra) MUTATES the same Node/Pod objects in place every
+        loop, which identity-based diffing cannot see — the control plane
+        compares this key per loop and forces a full re-encode when the DRA
+        world changed (models/incremental.py contract).
+
+        Cost: O(objects log objects) per loop — trivially zero for non-DRA
+        clusters (empty snapshot) and a few ms at thousands of claims,
+        comparable to apply_dra's own per-loop walk. A generation counter
+        can't replace it: sources mutate the claims/slices lists directly."""
+        return (
+            tuple(sorted(self.classes)),
+            tuple(sorted(
+                (sl.node_name, sl.device_class, sl.count,
+                 tuple(sorted(sl.attributes.items())))
+                for sl in self.slices)),
+            tuple(sorted(
+                (c.namespace, c.name, c.owner_pod, c.allocated_node,
+                 tuple(sorted(c.reserved_for)),
+                 tuple((r.device_class, r.count,
+                        tuple(sorted(r.selector.items())))
+                       for r in c.requests))
+                for c in self.claims)),
+        )
 
     # ---- fork/commit/revert (reference: patchset Fork/Commit/Revert) ----
 
@@ -251,7 +285,12 @@ def apply_dra(nodes: list[Node], pods: list[Pod], dra: DraSnapshot) -> None:
     host-check annotation (claim_fits_exact is the exact tier).
 
     Totals are recomputed and OVERWRITTEN each pass — the loop re-lists the
-    same Pod objects every tick, so += would compound across loops."""
+    same Pod objects every tick, so += would compound across loops. Every
+    DRA-owned mutation is CLEARED up front so deleted claims/slices leave no
+    residue (requests/capacity keys, hostname pins, gang labels/affinity,
+    the host-check mark) — without this, a removed claim left its pod
+    demanding phantom devices forever."""
+    clear_dra_lowering(nodes, pods)
     cap = dra.device_capacity()
     # devices held by allocated claims of NON-resident owners (shared claims
     # or claims of departed pods) reduce the node's free devices; resident
@@ -290,7 +329,7 @@ def apply_dra(nodes: list[Node], pods: list[Pod], dra: DraSnapshot) -> None:
         if claim.allocated_node:
             # bound claim: pending sharers can only go where the devices are
             for p in pending:
-                p.node_selector["kubernetes.io/hostname"] = claim.allocated_node
+                _pin_host(p, claim.allocated_node)
         elif pending:
             shared_rep[ckey] = pending[0].name
             from kubernetes_autoscaler_tpu.models.api import AffinityTerm
@@ -313,7 +352,7 @@ def apply_dra(nodes: list[Node], pods: list[Pod], dra: DraSnapshot) -> None:
                     and claim.owner_pod == pod.name):
                 # owned claim already bound: the pod must follow its devices,
                 # which `held` charged to the node (no double charge)
-                pod.node_selector["kubernetes.io/hostname"] = claim.allocated_node
+                _pin_host(pod, claim.allocated_node)
                 continue
             for req in claim.requests:
                 if req.selector:
@@ -332,6 +371,52 @@ def apply_dra(nodes: list[Node], pods: list[Pod], dra: DraSnapshot) -> None:
             pod.requests[key] = total
         if lossy:
             pod.annotations[HOST_CHECK_ANNOTATION] = "true"
+            pod.annotations[DRA_LOSSY_ANNOTATION] = "true"
+
+
+def _pin_host(p: Pod, node_name: str) -> None:
+    """Overwrite the hostname selector with the claim's node, stashing any
+    USER-authored value so clear_dra_lowering can restore (not delete) it —
+    the clear runs first each pass, so the current selector here IS the
+    user's state."""
+    prev = p.node_selector.get("kubernetes.io/hostname")
+    p.annotations[DRA_PIN_ANNOTATION] = node_name
+    if prev is not None:
+        p.annotations[DRA_PIN_PREV_ANNOTATION] = prev
+    p.node_selector["kubernetes.io/hostname"] = node_name
+
+
+def clear_dra_lowering(nodes: list[Node], pods: list[Pod]) -> None:
+    """Remove everything a previous apply_dra pass wrote (see its docstring)."""
+    for nd in nodes:
+        for store in (nd.capacity, nd.allocatable):
+            if not store:
+                continue
+            for k in [k for k in store if k.startswith(DRA_RESOURCE_PREFIX)]:
+                del store[k]
+    for p in pods:
+        for k in [k for k in p.requests
+                  if k.startswith(DRA_RESOURCE_PREFIX)]:
+            del p.requests[k]
+        gang = [k for k in p.labels if k.startswith(DRA_SHARED_LABEL_PREFIX)]
+        for k in gang:
+            del p.labels[k]
+        if p.pod_affinity:
+            p.pod_affinity = [
+                t for t in p.pod_affinity
+                if not (len(t.match_labels) == 1 and next(
+                    iter(t.match_labels)).startswith(DRA_SHARED_LABEL_PREFIX))]
+        pin = p.annotations.pop(DRA_PIN_ANNOTATION, None)
+        prev = p.annotations.pop(DRA_PIN_PREV_ANNOTATION, None)
+        if pin is not None \
+                and p.node_selector.get("kubernetes.io/hostname") == pin:
+            if prev is not None:
+                p.node_selector["kubernetes.io/hostname"] = prev
+            else:
+                del p.node_selector["kubernetes.io/hostname"]
+        if p.annotations.pop(DRA_LOSSY_ANNOTATION, None) is not None \
+                and CSI_LOSSY_ANNOTATION not in p.annotations:
+            p.annotations.pop(HOST_CHECK_ANNOTATION, None)
 
 
 def allocate_claim(claim: ResourceClaim, node: Node, pod: Pod) -> None:

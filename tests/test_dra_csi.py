@@ -158,3 +158,115 @@ def test_runonce_scales_up_for_dra_pods():
     assert st.scale_up is not None and st.scale_up.scaled_up
     # 8 claims x 1 device, 4 devices/node -> 2 "dev" nodes; cpu group useless
     assert st.scale_up.increases == {"dev": 2}
+
+
+def test_removed_claim_and_slice_leave_no_residue():
+    """Round-4 review: apply_dra only overwrote keys still present, so a
+    DELETED claim/slice left phantom requests/capacity/pins on the
+    persistent objects forever. The lowering now clears its own writes."""
+    from kubernetes_autoscaler_tpu.models.api import HOST_CHECK_ANNOTATION
+    from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+        ClaimRequest,
+        DeviceClass,
+        DraSnapshot,
+        ResourceClaim,
+        ResourceSlice,
+        apply_dra,
+    )
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    nd = build_test_node("n0", cpu_milli=4000, mem_mib=8192)
+    pod = build_test_pod("claimer", cpu_milli=100, mem_mib=64,
+                         owner_name="rs")
+    dra = DraSnapshot()
+    dra.classes["gpu.x"] = DeviceClass("gpu.x")
+    dra.slices.append(ResourceSlice(node_name="n0", device_class="gpu.x",
+                                    count=4))
+    claim = ResourceClaim(
+        name="c1", owner_pod="claimer", allocated_node="n0",
+        reserved_for=["default/claimer"],
+        requests=[ClaimRequest(device_class="gpu.x", count=2,
+                               selector={"vendor": "z"})])
+    dra.claims.append(claim)
+    apply_dra([nd], [pod], dra)
+    assert pod.requests.get("dra/gpu.x") or \
+        pod.node_selector.get("kubernetes.io/hostname") == "n0"
+    assert nd.capacity.get("dra/gpu.x") is not None
+
+    # the claim AND the slice disappear: every trace must clear
+    dra.claims.clear()
+    dra.slices.clear()
+    apply_dra([nd], [pod], dra)
+    assert "dra/gpu.x" not in pod.requests
+    assert "dra/gpu.x" not in nd.capacity
+    assert "dra/gpu.x" not in nd.allocatable
+    assert pod.node_selector.get("kubernetes.io/hostname") is None
+    assert HOST_CHECK_ANNOTATION not in pod.annotations
+
+
+def test_removed_csinode_leaves_no_residue():
+    from kubernetes_autoscaler_tpu.simulator.csi import (
+        CSINode,
+        CSINodeDriver,
+        CsiSnapshot,
+        apply_csi,
+    )
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    nd = build_test_node("n0", cpu_milli=4000, mem_mib=8192)
+    pod = build_test_pod("p", cpu_milli=100, mem_mib=64, owner_name="rs")
+    pod.pvc_refs = ("vol-1",)
+    csi = CsiSnapshot()
+    csi.add(CSINode(node_name="n0",
+                    drivers=[CSINodeDriver("ebs", allocatable_count=8)]))
+    csi.pvc_driver["default/vol-1"] = "ebs"
+    apply_csi([nd], [pod], csi)
+    assert nd.capacity.get("csi/ebs") == 8
+    assert pod.requests.get("csi/ebs") == 1
+
+    csi.csi_nodes.clear()
+    csi.pvc_driver.clear()
+    apply_csi([nd], [pod], csi)
+    assert "csi/ebs" not in nd.capacity
+    assert "csi/ebs" not in pod.requests
+
+
+def test_pin_clear_restores_user_hostname_selector():
+    """A user-authored hostname selector the pin overwrote must be RESTORED
+    on claim deletion, not deleted (round-4 review)."""
+    from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+        ClaimRequest,
+        DeviceClass,
+        DraSnapshot,
+        ResourceClaim,
+        ResourceSlice,
+        apply_dra,
+    )
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    nd = build_test_node("n0", cpu_milli=4000, mem_mib=8192)
+    pod = build_test_pod("claimer", cpu_milli=100, mem_mib=64,
+                         owner_name="rs",
+                         node_selector={"kubernetes.io/hostname": "n0"})
+    dra = DraSnapshot()
+    dra.classes["gpu.x"] = DeviceClass("gpu.x")
+    dra.slices.append(ResourceSlice(node_name="n0", device_class="gpu.x",
+                                    count=4))
+    dra.claims.append(ResourceClaim(
+        name="c1", owner_pod="claimer", allocated_node="n0",
+        requests=[ClaimRequest(device_class="gpu.x", count=1)]))
+    apply_dra([nd], [pod], dra)
+    assert pod.node_selector["kubernetes.io/hostname"] == "n0"
+    dra.claims.clear()
+    apply_dra([nd], [pod], dra)
+    # the user's own constraint survives the claim's disappearance
+    assert pod.node_selector.get("kubernetes.io/hostname") == "n0"

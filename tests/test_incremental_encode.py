@@ -330,3 +330,49 @@ def test_incremental_scatter_path_small_delta():
     assert e1.scheduled.req is e2.scheduled.req
     _assert_equiv(e2, _reference(world, encoder.registry, DrainOptions(), 2.0),
                   step="scatter")
+
+
+def test_dra_state_change_forces_rebuild():
+    """DRA lowering rewrites the SAME Pod/Node objects each loop — identity
+    diffing cannot see it. The control plane fingerprints the DRA snapshot
+    and invalidates the encoder when it changes."""
+    from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import (
+        StaticAutoscaler,
+    )
+    from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+        ClaimRequest,
+        DeviceClass,
+        ResourceClaim,
+        ResourceSlice,
+    )
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    fake.add_existing_node("ng1", build_test_node(
+        "n0", cpu_milli=4000, mem_mib=8192))
+    dra = fake.dra_snapshot()
+    dra.classes["gpu.example.com"] = DeviceClass("gpu.example.com")
+    dra.slices.append(ResourceSlice(node_name="n0",
+                                    device_class="gpu.example.com", count=4))
+    opts = AutoscalingOptions(node_shape_bucket=16, group_shape_bucket=16,
+                              max_new_nodes_static=16, max_pods_per_node=16,
+                              drain_chunk=8, scale_down_enabled=False)
+    a = StaticAutoscaler(fake.provider, fake, options=opts,
+                         eviction_sink=fake)
+    a.run_once(now=1000.0)
+    a.run_once(now=1010.0)
+    assert a._encoder.full_encodes == 1   # steady: no rebuilds
+
+    # the DRA world changes (a claim appears): rebuild must trigger
+    p = build_test_pod("claimer", cpu_milli=100, mem_mib=64, owner_name="rs")
+    fake.add_pod(p)
+    dra.claims.append(ResourceClaim(
+        name="c1", owner_pod="claimer",
+        requests=[ClaimRequest(device_class="gpu.example.com", count=2)]))
+    a.run_once(now=1020.0)
+    assert a._encoder.full_encodes == 2
+    a.run_once(now=1030.0)
+    assert a._encoder.full_encodes == 2   # stable again
