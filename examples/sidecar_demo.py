@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""End-to-end sidecar demo: what a Go control plane does, in 80 lines.
+
+Starts the TPU simulation sidecar (TLS, self-signed), pushes a cluster as
+KAD1/KAUX deltas the way `go/katpusim` would (docs/SIDECAR_WIRE.md), then
+asks the two simulation questions the control loop needs:
+
+  * ScaleUpSim  — can the pending pods fit; which node group, how many nodes?
+  * ScaleDownSim — which nodes are drainable, where would their pods go?
+
+Run:  python examples/sidecar_demo.py        (CPU or TPU; ~30 s cold compile)
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Default to CPU so the demo runs anywhere (KATPU_DEMO_PLATFORM=tpu to
+# target an attached TPU). BOTH knobs, in this order (tests/conftest.py does
+# the same): the env var before the first jax import keeps other platform
+# plugins from initializing at backend discovery; the config knob pins the
+# default platform.
+platform = os.environ.get("KATPU_DEMO_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = platform
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", platform)
+
+from kubernetes_autoscaler_tpu.models.api import Toleration  # noqa: E402
+from kubernetes_autoscaler_tpu.sidecar.server import (  # noqa: E402
+    SimulatorClient,
+    SimulatorService,
+    make_grpc_server,
+)
+from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter  # noqa: E402
+from kubernetes_autoscaler_tpu.utils.certs import CertManager  # noqa: E402
+from kubernetes_autoscaler_tpu.utils.testing import (  # noqa: E402
+    build_test_node,
+    build_test_pod,
+)
+# cold compiles: ~1-3 min on a busy CPU; seconds on TPU after the first run
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as td:
+        cm = CertManager(td)  # self-signed serving pair, rotated on expiry
+        server, port = make_grpc_server(SimulatorService(), port=0,
+                                        cert_file=cm.cert_path,
+                                        key_file=cm.key_path)
+        server.start()
+        print(f"sidecar listening on :{port} (TLS)", flush=True)
+        client = SimulatorClient(port, cert_file=cm.cert_path)
+
+        # ---- loop 1: upload the world as one delta -------------------------
+        w = DeltaWriter()
+        for i in range(8):
+            w.upsert_node(build_test_node(
+                f"n{i}", cpu_milli=8000, mem_mib=16384, pods=32,
+                zone=["a", "b"][i % 2]), group_id=0)
+        for i in range(6):  # residents at ~50% utilization
+            w.upsert_pod(build_test_pod(
+                f"r{i}", cpu_milli=4000, mem_mib=4096, owner_name="rs-web",
+                node_name=f"n{i}"), movable=True)
+        for i in range(20):  # pending demand beyond the free capacity
+            w.upsert_pod(build_test_pod(
+                f"p{i}", cpu_milli=3000, mem_mib=2048, owner_name="rs-api",
+                tolerations=[Toleration("dedicated", "Exists", "", "")]))
+        ack = client.apply_delta(w)
+        print(f"delta applied, snapshot version {ack['version']}", flush=True)
+
+        mib = 1024 * 1024
+        up = client.scale_up_sim(
+            max_new_nodes=16, strategy="least-waste",
+            node_groups=[{"id": "ng-big", "max_new": 16, "price": 2.0,
+                          "template": {
+                              "name": "tmpl", "labels": {},
+                              "capacity": {"cpu": 16.0,
+                                           "memory": 32768 * mib,
+                                           "pods": 64}}}])
+        print(f"scale-up: {up}", flush=True)
+
+        # ---- loop 2: the bound pods churn; ask about scale-down -----------
+        w2 = DeltaWriter()
+        w2.delete_pod("uid-default/r5")            # a resident finished
+        ack = client.apply_delta(w2)
+        down = client.scale_down_sim(threshold=0.6)
+        print(f"scale-down (after delta v{ack['version']}): {down}", flush=True)
+        server.stop(1.0)
+
+
+if __name__ == "__main__":
+    main()

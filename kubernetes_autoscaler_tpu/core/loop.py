@@ -9,7 +9,6 @@ check + metrics wrapper).
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass
 
 from kubernetes_autoscaler_tpu.core.static_autoscaler import (

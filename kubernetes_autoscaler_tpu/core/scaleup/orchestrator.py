@@ -291,9 +291,10 @@ class ScaleUpOrchestrator:
                               for k, v in tmpl.alloc_or_cap().items())))
                 for tmpl, _mx, _pr in templates
             ),
-            len(enc.registry.slots),
-            # the full MAPPING, not its size: a rebuild can reassign the
-            # same number of zone ids in a different first-seen order
+            # the full MAPPINGS, not their sizes: a rebuild can reassign
+            # the same number of slot/zone ids in a different first-seen
+            # order
+            tuple(sorted(enc.registry.slots.items())),
             tuple(sorted(enc.zone_table.ids.items())),
             enc.dims,
         )

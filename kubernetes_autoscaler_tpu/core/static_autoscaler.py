@@ -314,21 +314,20 @@ class StaticAutoscaler:
                     apply_dra,
                 )
 
-                dra = dra_snapshot_fn()
-                apply_dra(nodes, pods, dra)
-                lowering_key = (dra.content_key(),)
+                lowering_key = (apply_dra(nodes, pods, dra_snapshot_fn()),)
             csi_snapshot_fn = (getattr(self.source, "csi_snapshot", None)
                                if self.options.enable_csi_node_aware_scheduling
                                else None)
             if csi_snapshot_fn is not None:
                 from kubernetes_autoscaler_tpu.simulator.csi import apply_csi
 
-                csi = csi_snapshot_fn()
-                apply_csi(nodes, pods, csi)
-                lowering_key = (lowering_key, csi.content_key())
+                lowering_key = (lowering_key,
+                                apply_csi(nodes, pods, csi_snapshot_fn()))
             # DRA/CSI lowering REWRITES the same objects in place each loop;
-            # identity diffing cannot see that, so a lowering-state change
-            # must force the incremental encoder to rebuild
+            # identity diffing cannot see that. The passes return a
+            # fingerprint of everything they WROTE (which depends on the pod
+            # set too — claim residency, PVC sharing — not just the
+            # snapshots), and any change forces the encoder to rebuild.
             if (self._encoder is not None
                     and lowering_key != self._last_lowering_key):
                 self._encoder.invalidate()

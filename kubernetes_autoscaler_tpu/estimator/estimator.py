@@ -16,7 +16,7 @@ composed as min().
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Protocol
+from typing import Protocol
 
 import jax.numpy as jnp
 import numpy as np

@@ -31,6 +31,9 @@ DELETION_CANDIDATE_TAINT = "DeletionCandidateOfClusterAutoscaler"
 # Set by lowering passes (DRA selectored claims, shared claims) whose
 # constraint is not dense-encodable: forces the winner-verification tier.
 HOST_CHECK_ANNOTATION = "autoscaler.x-k8s.io/host-check"
+# which lowering pass set host-check (each clears only its own mark)
+DRA_LOSSY_ANNOTATION = "autoscaler.x-k8s.io/host-check-dra"
+CSI_LOSSY_ANNOTATION = "autoscaler.x-k8s.io/host-check-csi"
 
 # Well-known topology keys (k8s core/v1). The dense encoding supports these
 # two domain kinds; other topology keys route through the host-check tier.

@@ -28,7 +28,6 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from kubernetes_autoscaler_tpu.models.resources import NUM_RESOURCES
 
 
 @dataclass(frozen=True)

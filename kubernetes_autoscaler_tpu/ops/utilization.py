@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from kubernetes_autoscaler_tpu.models.cluster_state import NodeTensors
-from kubernetes_autoscaler_tpu.models.resources import CPU, MEMORY, NUM_STANDARD
+from kubernetes_autoscaler_tpu.models.resources import CPU, MEMORY
 
 
 def node_utilization(nodes: NodeTensors, gpu_slot: jnp.ndarray | None = None) -> jnp.ndarray:

@@ -23,8 +23,6 @@ estimator, node *groups* are independent → sharded over `pods` too.
 
 from __future__ import annotations
 
-from contextlib import contextmanager
-
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

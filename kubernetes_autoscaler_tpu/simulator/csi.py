@@ -44,18 +44,7 @@ class CsiSnapshot:
     def add(self, csi_node: CSINode) -> None:
         self.csi_nodes[csi_node.node_name] = csi_node
 
-    def content_key(self) -> tuple:
-        """Change fingerprint — see DraSnapshot.content_key."""
-        return (
-            tuple(sorted(
-                (name, tuple(sorted((d.name, d.allocatable_count)
-                                    for d in cn.drivers)))
-                for name, cn in self.csi_nodes.items())),
-            tuple(sorted(self.pvc_driver.items())),
-        )
-
-
-def apply_csi(nodes: list[Node], pods: list[Pod], csi: CsiSnapshot) -> None:
+def apply_csi(nodes: list[Node], pods: list[Pod], csi: CsiSnapshot):
     """Lower volume limits into the resource axis before encode_cluster.
 
     Like apply_dra, previously-lowered state is CLEARED first so removed
@@ -110,7 +99,7 @@ def apply_csi(nodes: list[Node], pods: list[Pod], csi: CsiSnapshot) -> None:
             if driver in drivers_seen:
                 pod.requests[CSI_RESOURCE_PREFIX + driver] = n
         if lossy:
-            from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+            from kubernetes_autoscaler_tpu.models.api import (
                 CSI_LOSSY_ANNOTATION,
             )
 
@@ -118,23 +107,28 @@ def apply_csi(nodes: list[Node], pods: list[Pod], csi: CsiSnapshot) -> None:
             pod.annotations[CSI_LOSSY_ANNOTATION] = "true"
 
 
-def clear_csi_lowering(nodes: list[Node], pods: list[Pod]) -> None:
-    """Remove everything a previous apply_csi pass wrote."""
-    from kubernetes_autoscaler_tpu.models.api import HOST_CHECK_ANNOTATION
+    from kubernetes_autoscaler_tpu.models.api import CSI_LOSSY_ANNOTATION
     from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
-        CSI_LOSSY_ANNOTATION,
-        DRA_LOSSY_ANNOTATION,
+        lowering_fingerprint,
     )
 
-    for nd in nodes:
-        for store in (nd.capacity, nd.allocatable):
-            if not store:
-                continue
-            for k in [k for k in store if k.startswith(CSI_RESOURCE_PREFIX)]:
-                del store[k]
+    return lowering_fingerprint(nodes, pods, CSI_RESOURCE_PREFIX,
+                                (CSI_LOSSY_ANNOTATION,))
+
+
+def clear_csi_lowering(nodes: list[Node], pods: list[Pod]) -> None:
+    """Remove everything a previous apply_csi pass wrote."""
+    from kubernetes_autoscaler_tpu.models.api import (
+        CSI_LOSSY_ANNOTATION,
+        DRA_LOSSY_ANNOTATION,
+        HOST_CHECK_ANNOTATION,
+    )
+    from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+        clear_prefixed_resources,
+    )
+
+    clear_prefixed_resources(nodes, pods, CSI_RESOURCE_PREFIX)
     for p in pods:
-        for k in [k for k in p.requests if k.startswith(CSI_RESOURCE_PREFIX)]:
-            del p.requests[k]
         if p.annotations.pop(CSI_LOSSY_ANNOTATION, None) is not None \
                 and DRA_LOSSY_ANNOTATION not in p.annotations:
             p.annotations.pop(HOST_CHECK_ANNOTATION, None)

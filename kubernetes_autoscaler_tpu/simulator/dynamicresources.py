@@ -38,8 +38,10 @@ DRA_RESOURCE_PREFIX = "dra/"
 DRA_PIN_ANNOTATION = "autoscaler.x-k8s.io/dra-pinned-host"
 # the USER's own hostname selector value the pin overwrote (restored on clear)
 DRA_PIN_PREV_ANNOTATION = "autoscaler.x-k8s.io/dra-pinned-host-prev"
-DRA_LOSSY_ANNOTATION = "autoscaler.x-k8s.io/host-check-dra"
-CSI_LOSSY_ANNOTATION = "autoscaler.x-k8s.io/host-check-csi"
+from kubernetes_autoscaler_tpu.models.api import (  # noqa: E402
+    CSI_LOSSY_ANNOTATION,
+    DRA_LOSSY_ANNOTATION,
+)
 
 
 @dataclass
@@ -108,32 +110,6 @@ class DraSnapshot:
     claims: list[ResourceClaim] = field(default_factory=list)
     _stack: list[dict[str, tuple[str, tuple[str, ...]]]] = field(
         default_factory=list, repr=False)
-
-    def content_key(self) -> tuple:
-        """Cheap change fingerprint for the incremental encoder: the DRA
-        lowering (apply_dra) MUTATES the same Node/Pod objects in place every
-        loop, which identity-based diffing cannot see — the control plane
-        compares this key per loop and forces a full re-encode when the DRA
-        world changed (models/incremental.py contract).
-
-        Cost: O(objects log objects) per loop — trivially zero for non-DRA
-        clusters (empty snapshot) and a few ms at thousands of claims,
-        comparable to apply_dra's own per-loop walk. A generation counter
-        can't replace it: sources mutate the claims/slices lists directly."""
-        return (
-            tuple(sorted(self.classes)),
-            tuple(sorted(
-                (sl.node_name, sl.device_class, sl.count,
-                 tuple(sorted(sl.attributes.items())))
-                for sl in self.slices)),
-            tuple(sorted(
-                (c.namespace, c.name, c.owner_pod, c.allocated_node,
-                 tuple(sorted(c.reserved_for)),
-                 tuple((r.device_class, r.count,
-                        tuple(sorted(r.selector.items())))
-                       for r in c.requests))
-                for c in self.claims)),
-        )
 
     # ---- fork/commit/revert (reference: patchset Fork/Commit/Revert) ----
 
@@ -266,7 +242,7 @@ def claim_fits_exact(claim: ResourceClaim, node: Node, dra: DraSnapshot,
 DRA_SHARED_LABEL_PREFIX = "dra.claim/"
 
 
-def apply_dra(nodes: list[Node], pods: list[Pod], dra: DraSnapshot) -> None:
+def apply_dra(nodes: list[Node], pods: list[Pod], dra: DraSnapshot):
     """The lowering pass: fold device counts into node capacity and claim
     counts into pod requests as 'dra/<class>' extended resources, BEFORE
     encode_cluster.
@@ -372,32 +348,70 @@ def apply_dra(nodes: list[Node], pods: list[Pod], dra: DraSnapshot) -> None:
         if lossy:
             pod.annotations[HOST_CHECK_ANNOTATION] = "true"
             pod.annotations[DRA_LOSSY_ANNOTATION] = "true"
+    return lowering_fingerprint(nodes, pods, DRA_RESOURCE_PREFIX,
+                                (DRA_PIN_ANNOTATION, DRA_LOSSY_ANNOTATION))
+
+
+def lowering_fingerprint(nodes, pods, prefix: str,
+                         annotations: tuple[str, ...]) -> int:
+    """Hash of everything a lowering pass WROTE onto the live objects.
+
+    The control plane compares this per loop to decide whether the
+    incremental encoder must rebuild: the lowered OUTPUT depends on the pod
+    set (claim residency, PVC sharing), not just the DRA/CSI snapshots, so
+    fingerprinting the inputs is not enough. Only prefixed keys and the
+    pass's own annotations contribute — O(touched objects), not O(world)."""
+    acc = hash(prefix)
+    for nd in nodes:
+        for k, v in nd.capacity.items():
+            if k.startswith(prefix):
+                acc = hash((acc, nd.name, k, v))
+    for p in pods:
+        for k, v in p.requests.items():
+            if k.startswith(prefix):
+                acc = hash((acc, p.namespace, p.name, k, v))
+        for k in p.labels:
+            if k.startswith(DRA_SHARED_LABEL_PREFIX):
+                acc = hash((acc, p.namespace, p.name, k))
+        for a in annotations:
+            v = p.annotations.get(a)
+            if v is not None:
+                acc = hash((acc, p.namespace, p.name, a, v))
+    return acc
 
 
 def _pin_host(p: Pod, node_name: str) -> None:
     """Overwrite the hostname selector with the claim's node, stashing any
     USER-authored value so clear_dra_lowering can restore (not delete) it —
     the clear runs first each pass, so the current selector here IS the
-    user's state."""
+    user's state. A SECOND pin in the same pass must not re-stash (it would
+    capture the first pin as if it were user state)."""
     prev = p.node_selector.get("kubernetes.io/hostname")
-    p.annotations[DRA_PIN_ANNOTATION] = node_name
-    if prev is not None:
+    if DRA_PIN_ANNOTATION not in p.annotations and prev is not None:
         p.annotations[DRA_PIN_PREV_ANNOTATION] = prev
+    p.annotations[DRA_PIN_ANNOTATION] = node_name
     p.node_selector["kubernetes.io/hostname"] = node_name
 
 
-def clear_dra_lowering(nodes: list[Node], pods: list[Pod]) -> None:
-    """Remove everything a previous apply_dra pass wrote (see its docstring)."""
+def clear_prefixed_resources(nodes: list[Node], pods: list[Pod],
+                             prefix: str) -> None:
+    """Purge a lowering pass's resource-key namespace from the live objects
+    (shared by the DRA and CSI clears)."""
     for nd in nodes:
         for store in (nd.capacity, nd.allocatable):
             if not store:
                 continue
-            for k in [k for k in store if k.startswith(DRA_RESOURCE_PREFIX)]:
+            for k in [k for k in store if k.startswith(prefix)]:
                 del store[k]
     for p in pods:
-        for k in [k for k in p.requests
-                  if k.startswith(DRA_RESOURCE_PREFIX)]:
+        for k in [k for k in p.requests if k.startswith(prefix)]:
             del p.requests[k]
+
+
+def clear_dra_lowering(nodes: list[Node], pods: list[Pod]) -> None:
+    """Remove everything a previous apply_dra pass wrote (see its docstring)."""
+    clear_prefixed_resources(nodes, pods, DRA_RESOURCE_PREFIX)
+    for p in pods:
         gang = [k for k in p.labels if k.startswith(DRA_SHARED_LABEL_PREFIX)]
         for k in gang:
             del p.labels[k]

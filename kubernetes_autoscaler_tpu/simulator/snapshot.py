@@ -25,7 +25,6 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from kubernetes_autoscaler_tpu.models.api import Node, Pod
 from kubernetes_autoscaler_tpu.models.cluster_state import (

@@ -270,3 +270,81 @@ def test_pin_clear_restores_user_hostname_selector():
     apply_dra([nd], [pod], dra)
     # the user's own constraint survives the claim's disappearance
     assert pod.node_selector.get("kubernetes.io/hostname") == "n0"
+
+
+def test_double_pin_does_not_clobber_user_selector_stash():
+    """Two bound claims pinning the same pod in one pass must not capture
+    the first pin as if it were the user's selector (round-4 review)."""
+    from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+        ClaimRequest,
+        DeviceClass,
+        DraSnapshot,
+        ResourceClaim,
+        ResourceSlice,
+        apply_dra,
+    )
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    nodes = [build_test_node(n, cpu_milli=4000, mem_mib=8192)
+             for n in ("n1", "n2")]
+    pod = build_test_pod("claimer", cpu_milli=100, mem_mib=64,
+                         owner_name="rs")
+    dra = DraSnapshot()
+    dra.classes["gpu.x"] = DeviceClass("gpu.x")
+    dra.slices.append(ResourceSlice(node_name="n1", device_class="gpu.x",
+                                    count=4))
+    # a shared bound claim pinning to n1 AND an owned bound claim to n2
+    dra.claims.append(ResourceClaim(
+        name="shared", allocated_node="n1",
+        reserved_for=["default/claimer", "default/other"],
+        requests=[ClaimRequest(device_class="gpu.x", count=1)]))
+    dra.claims.append(ResourceClaim(
+        name="owned", owner_pod="claimer", allocated_node="n2",
+        requests=[ClaimRequest(device_class="gpu.x", count=1)]))
+    other = build_test_pod("other", cpu_milli=100, mem_mib=64,
+                           owner_name="rs")
+    apply_dra(nodes, [pod, other], dra)
+    # both claims gone: NO selector must remain (the pod never had one)
+    dra.claims.clear()
+    apply_dra(nodes, [pod, other], dra)
+    assert "kubernetes.io/hostname" not in pod.node_selector
+
+
+def test_claim_owner_departure_changes_lowering_fingerprint():
+    """The lowered output depends on the POD SET (claim residency flips the
+    held-device charge), so the fingerprint must change when only a pod
+    departs — triggering the encoder rebuild (round-4 review)."""
+    from kubernetes_autoscaler_tpu.simulator.dynamicresources import (
+        ClaimRequest,
+        DeviceClass,
+        DraSnapshot,
+        ResourceClaim,
+        ResourceSlice,
+        apply_dra,
+    )
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    nd = build_test_node("n0", cpu_milli=4000, mem_mib=8192)
+    owner = build_test_pod("owner", cpu_milli=100, mem_mib=64,
+                           owner_name="rs", node_name="n0")
+    dra = DraSnapshot()
+    dra.classes["gpu.x"] = DeviceClass("gpu.x")
+    dra.slices.append(ResourceSlice(node_name="n0", device_class="gpu.x",
+                                    count=4))
+    dra.claims.append(ResourceClaim(
+        name="c1", owner_pod="owner", allocated_node="n0",
+        reserved_for=["default/owner"],
+        requests=[ClaimRequest(device_class="gpu.x", count=2)]))
+    fp_resident = apply_dra([nd], [owner], dra)
+    cap_resident = nd.capacity["dra/gpu.x"]
+    # the owner departs; the claim (unchanged!) now holds devices nobody
+    # resident charges → node free devices drop
+    fp_gone = apply_dra([nd], [], dra)
+    assert fp_gone != fp_resident
+    assert nd.capacity["dra/gpu.x"] == cap_resident - 2
