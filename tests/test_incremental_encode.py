@@ -376,3 +376,79 @@ def test_dra_state_change_forces_rebuild():
     assert a._encoder.full_encodes == 2
     a.run_once(now=1030.0)
     assert a._encoder.full_encodes == 2   # stable again
+
+
+def test_runonce_decisions_identical_incremental_vs_full():
+    """End-to-end decision equality: the SAME churned world driven through
+    two autoscalers — incremental encoding on vs off — must produce the
+    same scale-up plans, unneeded sets and deletions every loop."""
+    from kubernetes_autoscaler_tpu.config.options import (
+        AutoscalingOptions,
+        NodeGroupDefaults,
+    )
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import (
+        StaticAutoscaler,
+    )
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+
+    def build():
+        fake = FakeCluster()
+        tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384, pods=32)
+        fake.add_node_group("ng1", tmpl, min_size=1, max_size=30)
+        for i in range(6):
+            nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384,
+                                 pods=32)
+            fake.add_existing_node("ng1", nd)
+            fake.add_pod(build_test_pod(
+                f"r{i}", cpu_milli=2000, mem_mib=1024,
+                owner_name=f"rs{i % 3}", node_name=nd.name))
+        return fake
+
+    def opts(inc):
+        return AutoscalingOptions(
+            incremental_encode=inc,
+            node_shape_bucket=16, group_shape_bucket=16,
+            max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+            scale_down_delay_after_add_s=0.0,
+            scale_down_delay_after_failure_s=0.0,
+            scale_down_delay_after_delete_s=0.0,
+            node_group_defaults=NodeGroupDefaults(
+                scale_down_unneeded_time_s=0.0,
+                scale_down_unready_time_s=0.0))
+
+    worlds = [build(), build()]
+    autos = [StaticAutoscaler(w.provider, w, options=opts(inc),
+                              eviction_sink=w)
+             for w, inc in zip(worlds, (True, False))]
+
+    def churn(w, loop, rng):
+        # identical deterministic churn per world
+        if loop == 1:
+            for k in range(6):
+                w.add_pod(build_test_pod(
+                    f"burst{k}", cpu_milli=3000, mem_mib=512,
+                    owner_name="rs-burst"))
+        if loop == 3:
+            for k in range(6):
+                w.remove_pod(f"burst{k}")
+        if loop == 4 and "r2" in {p.name for p in w.pods.values()}:
+            w.remove_pod("r2")
+
+    import random
+
+    for loop in range(6):
+        now = 1000.0 + 10.0 * loop
+        stats = []
+        for w, a in zip(worlds, autos):
+            churn(w, loop, random.Random(loop))
+            w.advance_to(now)
+            st = a.run_once(now=now)
+            stats.append((
+                sorted(st.scale_up.increases.items())
+                if st.scale_up else None,
+                sorted(st.unneeded_nodes),
+                sorted(st.scale_down_deleted),
+                st.pending_pods,
+            ))
+        assert stats[0] == stats[1], f"loop {loop}: {stats[0]} != {stats[1]}"
+    assert autos[0]._encoder is not None and autos[1]._encoder is None
