@@ -73,7 +73,7 @@ def _probe_pods(rng):
 
 def test_cache_matches_oracle_under_mutations():
     namespaces = {"default": {"tier": "prod"}, "team-a": {"tier": "dev"}}
-    for seed in range(6):
+    for seed in range(10):
         rng = random.Random(400 + seed)
         nodes, residents = _world(rng)
         probes = _probe_pods(rng)
@@ -92,7 +92,7 @@ def test_cache_matches_oracle_under_mutations():
                         f"cache={got} oracle={want}")
 
         assert_agree("init")
-        for step in range(12):
+        for step in range(18):
             op = rng.random()
             if op < 0.6 and residents:
                 # move a resident (possibly to 'unscheduled')

@@ -128,3 +128,49 @@ def test_vpa_admission_self_signed_serving_and_rotation(tmp_path):
             assert json.loads(resp.read())["response"]["allowed"] is True
     finally:
         srv.stop()
+
+
+def test_sidecar_cli_serves_tls_with_self_signed_dir(tmp_path):
+    """python -m kubernetes_autoscaler_tpu.sidecar.server --self-signed-cert-dir:
+    the standalone CLI binds TLS on a generated pair and answers Health."""
+    import os
+    import re
+    import subprocess
+    import sys
+    import time
+
+    pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar.native_api import available
+
+    if not available():
+        pytest.skip("native codec unavailable")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if "AXON" not in k.upper()}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    cert_dir = tmp_path / "certs"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_autoscaler_tpu.sidecar.server",
+         "--port", "0", "--self-signed-cert-dir", str(cert_dir)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=repo)
+    try:
+        line = ""
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if proc.poll() is not None:   # died before the banner
+                break
+            line = proc.stdout.readline()
+            if not line or "listening" in line:
+                break
+        m = re.search(r":(\d+) \(tls\)", line)
+        assert m, (f"unexpected banner {line!r}; rc={proc.poll()} "
+                   f"stderr={proc.stderr.read()[-500:] if proc.poll() is not None else '...'}")
+        port = int(m.group(1))
+        from kubernetes_autoscaler_tpu.sidecar.server import SimulatorClient
+
+        client = SimulatorClient(port, cert_file=str(cert_dir / "tls.crt"))
+        assert client.health().get("error", "") == ""
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
