@@ -507,6 +507,7 @@ def bench_runonce_e2e(args) -> None:
     cold_s = time.perf_counter() - t0
     samples = []
     seq = 0
+    burst = 0
     for loop in range(max(args.e2e_loops, 2)):
         for k in range(500):  # churn: new pods arrive, old ones finish
             fake.remove_pod(f"p{seq + k}")
@@ -516,9 +517,22 @@ def bench_runonce_e2e(args) -> None:
         for k in range(50):   # kubelet binds
             fake.bind(f"p{args.pods + seq + k}", f"n{(seq + k) % n_nodes}")
         seq += 500
+        if loop % 4 == 2:
+            # an unfittable burst: the SCALE-UP path fires for real —
+            # orchestrator + expander + executor — and the provider
+            # materializes nodes the next loop sees (node-add churn
+            # exercises the encoder realign/growth paths on device)
+            burst += 1
+            for k in range(200):
+                fake.add_pod(build_test_pod(
+                    f"burst{burst}-{k}", cpu_milli=14000, mem_mib=4096,
+                    owner_name=f"burst-rs{burst}"))
         t0 = time.perf_counter()
         a.run_once(now=1010.0 + 10.0 * loop)
         samples.append((time.perf_counter() - t0) * 1000.0)
+        if loop % 4 == 3 and burst:
+            for k in range(200):   # the burst resolves; demand drains away
+                fake.remove_pod(f"burst{burst}-{k}")
     # first churn loop still warms scatter/shape caches — steady = the rest
     steady = samples[1:] if len(samples) > 1 else samples
     p50 = float(np.percentile(steady, 50))

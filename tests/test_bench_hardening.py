@@ -94,7 +94,7 @@ def test_bench_small_run_on_cpu_produces_metric():
         [sys.executable, os.path.join(REPO, "bench.py"),
          "--nodes", "64", "--pods", "128", "--pod-groups", "4",
          "--nodegroups", "2", "--max-new-nodes", "16",
-         "--iters", "1", "--chain", "3"],
+         "--iters", "1", "--chain", "3", "--e2e-loops", "4"],
         capture_output=True, text=True, env=env, timeout=560, cwd=REPO)
     assert proc.returncode == 0, proc.stderr[-800:]
     doc = json.loads(proc.stdout.strip().splitlines()[-1])
