@@ -62,12 +62,29 @@ def translate_buffer(buf: CapacityBuffer) -> None:
 def fake_pods_for(buf: CapacityBuffer, replicas: int | None = None) -> list[Pod]:
     """Materialize pending pods from a resolved buffer status (reference:
     capacitybuffer fakepods registry + simulator/fake/pod.go). `replicas`
-    overrides the status count (the controller's per-loop quota clamp)."""
+    overrides the status count (the controller's per-loop quota clamp).
+
+    The pod OBJECTS are cached per (generation, template, count) on the
+    buffer: the loop injects them every tick, and stable object identity is
+    what lets the incremental encoder (models/incremental.py) skip
+    re-lowering unchanged headroom each loop."""
     st = buf.status
     if not st.ready() or st.pod_template is None:
         return []
-    out = []
-    for i in range(st.replicas if replicas is None else replicas):
+    n = st.replicas if replicas is None else replicas
+    # cache the LARGEST materialization per (generation, template): the
+    # quota clamp moves loop-to-loop in busy clusters, and a prefix slice
+    # keeps pods 0..n-1 identity-stable as it shrinks and grows (object
+    # identity is what lets the incremental encoder skip re-lowering)
+    cache_key = (buf.generation, st.pod_template)
+    cached = getattr(buf, "_fake_pods_cache", None)
+    if (cached is not None and cached[0][0] == cache_key[0]
+            and cached[0][1] is cache_key[1] and len(cached[1]) >= n):
+        return list(cached[1][:n])
+    out = list(cached[1]) if (
+        cached is not None and cached[0][0] == cache_key[0]
+        and cached[0][1] is cache_key[1]) else []
+    for i in range(len(out), n):
         p = copy.deepcopy(st.pod_template)
         p.name = f"capacity-buffer-{buf.name}-{i}"
         p.namespace = buf.namespace
@@ -79,7 +96,8 @@ def fake_pods_for(buf: CapacityBuffer, replicas: int | None = None) -> list[Pod]
         p.owner = OwnerRef(kind="CapacityBuffer", name=buf.name,
                            uid=f"buffer-{buf.namespace}-{buf.name}")
         out.append(p)
-    return out
+    buf._fake_pods_cache = (cache_key, out)
+    return list(out[:n])
 
 
 def is_buffer_pod(pod: Pod) -> bool:

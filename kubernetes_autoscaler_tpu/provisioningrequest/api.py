@@ -89,7 +89,20 @@ class ProvisioningRequest:
 
     def pods(self) -> list[Pod]:
         """Materialize the request's pods (reference: provreqwrapper builds
-        fake pods per pod set for injection/simulation)."""
+        fake pods per pod set for injection/simulation).
+
+        Cached per pod-set identity: booked requests re-inject every loop,
+        and stable object identity lets the incremental encoder skip
+        re-lowering them (a ProvisioningRequest whose spec changes is a new
+        object in the k8s model, so identity-keying is sound)."""
+        # key holds the TEMPLATE REFERENCES (not bare ids): retaining them
+        # both prevents id reuse after GC and makes identity comparison sound
+        key = tuple((ps.template, ps.count) for ps in self.pod_sets)
+        cached = getattr(self, "_pods_cache", None)
+        if cached is not None and len(cached[0]) == len(key) and all(
+                a[0] is b[0] and a[1] == b[1]
+                for a, b in zip(cached[0], key)):
+            return list(cached[1])
         out: list[Pod] = []
         for si, ps in enumerate(self.pod_sets):
             for i in range(ps.count):
@@ -102,4 +115,5 @@ class ProvisioningRequest:
                 p.owner = OwnerRef(kind="ProvisioningRequest", name=self.name,
                                    uid=f"provreq-{self.namespace}-{self.name}")
                 out.append(p)
-        return out
+        self._pods_cache = (key, out)
+        return list(out)

@@ -240,3 +240,45 @@ def test_injection_flag_off_still_reconciles_statuses():
     assert status.scale_up is None          # no injection
     assert buf.status.ready()               # but reconciliation still ran
     assert buf.status.replicas == 6
+
+
+def test_fake_pod_identity_stable_across_loops():
+    """Injected headroom/ProvReq pods keep OBJECT identity while their spec
+    is unchanged — the incremental encoder relies on identity to skip
+    re-lowering them every loop (round-4)."""
+    buf = CapacityBuffer("hb", pod_template=build_test_pod(
+        "tmpl", cpu_milli=500), replicas=3)
+    translate_buffer(buf)
+    first = fake_pods_for(buf)
+    second = fake_pods_for(buf)
+    assert [id(p) for p in first] == [id(p) for p in second]
+    # a spec change (generation bump + re-translate) yields fresh objects
+    buf.generation += 1
+    buf.pod_template = build_test_pod("tmpl", cpu_milli=600)
+    translate_buffer(buf)
+    third = fake_pods_for(buf)
+    assert [id(p) for p in third] != [id(p) for p in first]
+
+    from kubernetes_autoscaler_tpu.provisioningrequest.api import (
+        PodSet,
+        ProvisioningRequest,
+    )
+
+    pr = ProvisioningRequest(
+        name="pr1", pod_sets=[PodSet(
+            template=build_test_pod("t", cpu_milli=100, mem_mib=64,
+                                    owner_name="rs"), count=2)])
+    assert [id(p) for p in pr.pods()] == [id(p) for p in pr.pods()]
+
+
+def test_fake_pod_cache_prefix_stable_under_clamp_changes():
+    """The quota clamp moves loop-to-loop; pods 0..n-1 must keep identity
+    as it shrinks and grows (prefix-slice cache, round-4 review)."""
+    buf = CapacityBuffer("hb2", pod_template=build_test_pod(
+        "tmpl", cpu_milli=500), replicas=5)
+    translate_buffer(buf)
+    five = fake_pods_for(buf, replicas=5)
+    three = fake_pods_for(buf, replicas=3)
+    assert [id(p) for p in three] == [id(p) for p in five[:3]]
+    five_again = fake_pods_for(buf, replicas=5)
+    assert [id(p) for p in five_again] == [id(p) for p in five]
