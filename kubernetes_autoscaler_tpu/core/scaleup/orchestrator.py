@@ -257,7 +257,8 @@ class ScaleUpOrchestrator:
             count[refuted] = 0
             masked = enc.specs.replace(count=jnp.asarray(count))
             redo = estimator.estimate_all_groups(masked, group_tensors, nodes_count)
-            sc = scoring.score_options(redo, group_tensors, specs=masked)
+            sc = scoring.fetch_scores(
+                scoring.score_options(redo, group_tensors, specs=masked))
             i = opt.group_index
             if bool(sc.valid[i]):
                 helped = np.asarray(sc.helped_req)

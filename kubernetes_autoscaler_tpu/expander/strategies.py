@@ -20,7 +20,7 @@ from typing import Protocol
 
 import numpy as np
 
-from kubernetes_autoscaler_tpu.ops.scoring import OptionScores
+from kubernetes_autoscaler_tpu.ops.scoring import OptionScores, fetch_scores
 
 
 @dataclass
@@ -46,6 +46,9 @@ class Option:
 def options_from_scores(scores: OptionScores, group_ids: list[str],
                         groups: list | None = None,
                         gpu_slot: int | None = None) -> list[Option]:
+    # one bulk device→host fetch; the per-element int()/float() reads
+    # below would otherwise each pay a tunnel round trip
+    scores = fetch_scores(scores)
     valid = np.asarray(scores.valid)
     helped = (np.asarray(scores.helped_req)
               if scores.helped_req is not None else None)

@@ -65,6 +65,15 @@ class RemovalResult(struct.PyTreeNode):
                            # re-pick destinations without re-running predicates
 
 
+def fetch_result(r: "RemovalResult") -> "RemovalResult":
+    """Device→host with at most three transfers (ops/hostfetch) instead of
+    one per leaf — each leaf transfer is a ~70 ms round trip over the TPU
+    tunnel."""
+    from kubernetes_autoscaler_tpu.ops.hostfetch import fetch_pytree
+
+    return fetch_pytree(r)
+
+
 def simulate_removals(
     nodes: NodeTensors,
     specs: PodGroupTensors,

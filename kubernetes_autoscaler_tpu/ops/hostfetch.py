@@ -1,0 +1,59 @@
+"""Single-shot device→host fetch for result pytrees.
+
+jax.device_get walks pytree leaves one transfer each; over the TPU tunnel
+every transfer is a ~70 ms round trip, so a 7-leaf result costs ~0.5 s per
+control loop. `fetch_pytree` concatenates the leaves into at most three
+dtype-class buffers ON DEVICE (bool→uint8 so the big feasibility planes are
+not widened 4x, integers→int32, floats→float32) and reconstructs the exact
+original structure, shapes and dtypes on the host — three transfers worst
+case, independent of leaf count. The packer is one jitted function whose
+cache keys on the pytree structure+shapes, so there is nothing to keep in
+sync when a result struct gains or reorders fields.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _packed(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    bools, ints, floats = [], [], []
+    for leaf in leaves:
+        if leaf.dtype == jnp.bool_:
+            bools.append(leaf.ravel().astype(jnp.uint8))
+        elif jnp.issubdtype(leaf.dtype, jnp.floating):
+            floats.append(leaf.ravel().astype(jnp.float32))
+        else:
+            ints.append(leaf.ravel().astype(jnp.int32))
+    empty = lambda dt: jnp.zeros((0,), dt)  # noqa: E731
+    return (
+        jnp.concatenate(bools) if bools else empty(jnp.uint8),
+        jnp.concatenate(ints) if ints else empty(jnp.int32),
+        jnp.concatenate(floats) if floats else empty(jnp.float32),
+    )
+
+
+def fetch_pytree(tree):
+    """Return the same pytree with every leaf as a host numpy array of the
+    ORIGINAL shape and dtype, using at most three device→host transfers."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    b, i, f = jax.device_get(_packed(tree))
+    offs = {"b": 0, "i": 0, "f": 0}
+    out = []
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.ndim else 1
+        dt = np.dtype(leaf.dtype)
+        if dt == np.bool_:
+            chunk, key = b, "b"
+        elif np.issubdtype(dt, np.floating):
+            chunk, key = f, "f"
+        else:
+            chunk, key = i, "i"
+        out.append(chunk[offs[key]:offs[key] + n]
+                   .reshape(leaf.shape).astype(dt))
+        offs[key] += n
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -31,6 +31,15 @@ class OptionScores(struct.PyTreeNode):
                                          # price expander's pod-cost input
 
 
+def fetch_scores(sc: "OptionScores") -> "OptionScores":
+    """Device→host with at most three transfers (ops/hostfetch) — the host
+    consumes these values element-wise, and each lazy scalar read would be
+    its own round trip."""
+    from kubernetes_autoscaler_tpu.ops.hostfetch import fetch_pytree
+
+    return fetch_pytree(sc)
+
+
 def score_options(est: EstimateResult, groups: NodeGroupTensors,
                   specs=None) -> OptionScores:
     pods = est.scheduled.sum(axis=-1)
