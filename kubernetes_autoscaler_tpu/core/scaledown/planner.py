@@ -45,6 +45,19 @@ from kubernetes_autoscaler_tpu.ops.drain import (
 from kubernetes_autoscaler_tpu.resourcequotas.tracker import QuotaTracker
 
 
+def _hostarr(enc: "EncodedCluster", key: str, dev) -> np.ndarray:
+    """Prefer the incremental encoder's host mirror for PLACEMENT-INVARIANT
+    tensors — reading the device array costs a device→host round trip per
+    call (~70 ms over the TPU tunnel). NEVER use for nodes.alloc or
+    specs.count: those are replaced post-placement on the device and the
+    planner must see the placed capacity (static_autoscaler sync comment)."""
+    assert key not in ("nodes.alloc", "specs.count")
+    h = enc.host_arrays
+    if h is not None and key in h:
+        return np.asarray(h[key])
+    return np.asarray(dev)
+
+
 @dataclass
 class NodeToRemove:
     node: Node
@@ -123,9 +136,9 @@ class Planner:
         # capped at max(ratio x cluster, min) via
         # --scale-down-candidates-pool-ratio, FAQ.md:1117).
         if eligible_idx:
-            sched_valid = np.asarray(enc.scheduled.valid)
+            sched_valid = _hostarr(enc, "scheduled.valid", enc.scheduled.valid)
             occupied = {
-                int(x) for x in np.asarray(enc.scheduled.node_idx)[sched_valid]
+                int(x) for x in _hostarr(enc, "scheduled.node_idx", enc.scheduled.node_idx)[sched_valid]
             }
             prev = self.unneeded_nodes.since
             eligible_idx.sort(key=lambda i: (nodes[i].name not in prev,
@@ -256,9 +269,9 @@ class Planner:
         slot_groups = group_ref[flat].astype(np.int32)
 
         quota_totals = quota_min = None
-        node_cap = (np.asarray(enc.nodes.cap)).astype(np.int64)
+        node_cap = _hostarr(enc, "nodes.cap", enc.nodes.cap).astype(np.int64)
         if self.quota is not None:
-            cap_sum = node_cap[np.asarray(enc.nodes.valid)].sum(axis=0)
+            cap_sum = node_cap[_hostarr(enc, "nodes.valid", enc.nodes.valid)].sum(axis=0)
             quota_totals = cap_sum.astype(np.int64)
             quota_min = self._quota_min_vector(enc)
 
@@ -355,9 +368,9 @@ class Planner:
             return util
         from kubernetes_autoscaler_tpu.models.resources import CPU, MEMORY
 
-        cap = np.asarray(enc.nodes.cap, dtype=np.float64)[:n_real]
+        cap = _hostarr(enc, "nodes.cap", enc.nodes.cap).astype(np.float64)[:n_real]
         alloc = np.asarray(enc.nodes.alloc, dtype=np.float64)[:n_real].copy()
-        reqs = np.asarray(enc.scheduled.req, dtype=np.float64)
+        reqs = _hostarr(enc, "scheduled.req", enc.scheduled.req).astype(np.float64)
         for j, p in enumerate(enc.scheduled_pods):
             if p is None:  # freed slot (incremental encoder hole)
                 continue
@@ -399,29 +412,35 @@ class Planner:
         # moves are committed into the working snapshot before the next
         # candidate is simulated, simulator/cluster.go:174-188), which the
         # independent per-candidate device sweep deliberately omits.
-        reqs = np.asarray(enc.scheduled.req)
-        greq = np.asarray(enc.specs.req)
-        group_ref = np.asarray(enc.scheduled.group_ref)
-        movable_f = np.asarray(enc.scheduled.movable)
-        limit_g = np.asarray(enc.specs.one_per_node())
+        reqs = _hostarr(enc, "scheduled.req", enc.scheduled.req)
+        greq = _hostarr(enc, "specs.req", enc.specs.req)
+        group_ref = _hostarr(enc, "scheduled.group_ref", enc.scheduled.group_ref)
+        movable_f = _hostarr(enc, "scheduled.movable", enc.scheduled.movable)
+        h = enc.host_arrays
+        if h is not None and "specs.anti_affinity_self" in h:
+            # one_per_node from the mirrors (a device compute + fetch saved)
+            limit_g = (np.asarray(h["specs.anti_affinity_self"])
+                       | (np.asarray(h["specs.port_hash"]) != 0).any(axis=-1))
+        else:
+            limit_g = np.asarray(enc.specs.one_per_node())
         # Groups whose dense feasibility row is not the whole truth — lossy
         # encodings and topology-coupled constraints — get every destination
         # double-checked by the exact oracle during confirmation (the analog
         # of the reference running real scheduler plugins for each move).
-        need_exact = np.asarray(enc.specs.needs_host_check).copy()
+        need_exact = _hostarr(enc, "specs.needs_host_check", enc.specs.needs_host_check).copy()
         if enc.specs.spread_kind is not None:
-            need_exact |= (np.asarray(enc.specs.spread_kind) > 0)
-            need_exact |= (np.asarray(enc.specs.aff_kind) > 0)
-            need_exact |= np.asarray(enc.specs.anti_self_zone)
+            need_exact |= (_hostarr(enc, "specs.spread_kind", enc.specs.spread_kind) > 0)
+            need_exact |= (_hostarr(enc, "specs.aff_kind", enc.specs.aff_kind) > 0)
+            need_exact |= _hostarr(enc, "specs.anti_self_zone", enc.specs.anti_self_zone)
         if enc.planes is not None:
-            need_exact |= np.asarray(enc.planes.anti_host_cnt).sum(axis=1) > 0
-            need_exact |= np.asarray(enc.planes.anti_zone_cnt).sum(axis=1) > 0
+            need_exact |= _hostarr(enc, "planes.anti_host_cnt", enc.planes.anti_host_cnt).sum(axis=1) > 0
+            need_exact |= _hostarr(enc, "planes.anti_zone_cnt", enc.planes.anti_zone_cnt).sum(axis=1) > 0
         # same destination gates the device sweep applies (ops/drain.py):
         # valid & ready & schedulable — a cordoned or unready node must not
         # absorb paper capacity during confirmation
-        node_valid = (np.asarray(enc.nodes.valid)
-                      & np.asarray(enc.nodes.ready)
-                      & np.asarray(enc.nodes.schedulable))
+        node_valid = (_hostarr(enc, "nodes.valid", enc.nodes.valid)
+                      & _hostarr(enc, "nodes.ready", enc.nodes.ready)
+                      & _hostarr(enc, "nodes.schedulable", enc.nodes.schedulable))
         ds_by_node: dict[str, list[int]] = {}
         for j, p in enumerate(enc.scheduled_pods):
             if p is None:  # freed slot (incremental encoder hole)
@@ -482,7 +501,7 @@ class Planner:
             from kubernetes_autoscaler_tpu.core.scaledown import native_confirm
 
             moved_groups = np.unique(group_ref[
-                np.asarray(enc.scheduled.valid) & movable_f])
+                _hostarr(enc, "scheduled.valid", enc.scheduled.valid) & movable_f])
             special = (need_exact[moved_groups].any()
                        or limit_g[moved_groups].any()) if moved_groups.size else False
             if (not special and native_confirm.available()
@@ -507,7 +526,9 @@ class Planner:
         def attempt(names: list[str]) -> tuple[list[NodeToRemove], dict[int, int], set[str]]:
 
 
-            free = (np.asarray(enc.nodes.cap)
+            # cap from the host mirror; alloc MUST be the device value
+            # (post-placement capacity, see _hostarr contract)
+            free = (_hostarr(enc, "nodes.cap", enc.nodes.cap)
                     - np.asarray(enc.nodes.alloc)).astype(np.int64)
             deleted_mask = np.zeros((enc.nodes.n,), dtype=bool)
             # Incremental fits cache: fits_m[g, n] = predicate plane AND

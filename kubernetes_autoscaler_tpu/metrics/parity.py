@@ -74,8 +74,11 @@ def emit_cluster_metrics(registry, cluster_state, provider, options, enc,
 
     registry.gauge("cluster_safe_to_autoscale").set(
         1.0 if cluster_state.is_cluster_healthy() else 0.0)
-    cap = np.asarray(enc.nodes.cap, dtype=np.int64)
-    valid = np.asarray(enc.nodes.valid)
+    # prefer the incremental encoder's host mirrors: reading the device
+    # arrays here would cost two device→host transfers per loop
+    h = enc.host_arrays or {}
+    cap = np.asarray(h.get("nodes.cap", enc.nodes.cap), dtype=np.int64)
+    valid = np.asarray(h.get("nodes.valid", enc.nodes.valid))
     sums = cap[valid].sum(axis=0) if valid.any() else np.zeros(cap.shape[1])
     registry.gauge("cluster_cpu_current_cores").set(float(sums[res.CPU]) / 1000.0)
     registry.gauge("cluster_memory_current_bytes").set(

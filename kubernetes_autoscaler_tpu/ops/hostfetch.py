@@ -18,11 +18,20 @@ import jax.numpy as jnp
 import numpy as np
 
 
+_SUPPORTED = ("bool", "int8", "int16", "int32", "uint8", "uint16",
+              "float32")
+
+
 @jax.jit
 def _packed(tree):
     leaves = jax.tree_util.tree_leaves(tree)
     bools, ints, floats = [], [], []
     for leaf in leaves:
+        # silent-corruption guard: wider types would wrap in the i32/f32
+        # buffers, and bfloat16 classifies differently on device vs host
+        assert str(leaf.dtype) in _SUPPORTED, (
+            f"fetch_pytree cannot pack dtype {leaf.dtype}; widen _SUPPORTED "
+            f"and the buffer classes first")
         if leaf.dtype == jnp.bool_:
             bools.append(leaf.ravel().astype(jnp.uint8))
         elif jnp.issubdtype(leaf.dtype, jnp.floating):

@@ -68,7 +68,8 @@ def test_two_process_lease_contention_and_failover(tmp_path):
     try:
         # exactly one leads (the other's heartbeat file never appears)
         pid, _ = _heartbeat_pid(out_a if os.path.exists(out_a)
-                                or not os.path.exists(out_b) else out_b)
+                                or not os.path.exists(out_b) else out_b,
+                                deadline_s=30.0)
         time.sleep(0.5)
         leading = [p for p in (out_a, out_b) if os.path.exists(p)]
         assert len(leading) == 1, "both replicas think they lead"
@@ -80,7 +81,7 @@ def test_two_process_lease_contention_and_failover(tmp_path):
         # kill the leader: the standby must take over (flock released on
         # process death — the Lease-expiry analog)
         os.kill(leader_pid, signal.SIGKILL)
-        new_pid, _ = _heartbeat_pid(standby_path, deadline_s=15.0)
+        new_pid, _ = _heartbeat_pid(standby_path, deadline_s=40.0)
         assert new_pid != leader_pid
         assert new_pid in (a.pid, b.pid)
     finally:
