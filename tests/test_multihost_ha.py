@@ -66,14 +66,21 @@ def test_two_process_lease_contention_and_failover(tmp_path):
     a = subprocess.Popen([sys.executable, script, lease, out_a], env=env)
     b = subprocess.Popen([sys.executable, script, lease, out_b], env=env)
     try:
-        # exactly one leads (the other's heartbeat file never appears)
-        pid, _ = _heartbeat_pid(out_a if os.path.exists(out_a)
-                                or not os.path.exists(out_b) else out_b,
-                                deadline_s=30.0)
+        # exactly one leads (the other's heartbeat file never appears);
+        # which one wins the flock race is nondeterministic — wait for
+        # WHICHEVER heartbeat shows up first
+        deadline = time.time() + 60.0
+        leader_path = None
+        while time.time() < deadline and leader_path is None:
+            for p in (out_a, out_b):
+                if os.path.exists(p):
+                    leader_path = p
+                    break
+            time.sleep(0.05)
+        assert leader_path is not None, "no replica took leadership"
         time.sleep(0.5)
         leading = [p for p in (out_a, out_b) if os.path.exists(p)]
         assert len(leading) == 1, "both replicas think they lead"
-        leader_path = leading[0]
         standby_path = out_b if leader_path == out_a else out_a
         leader_pid, _ = _heartbeat_pid(leader_path)
         assert leader_pid in (a.pid, b.pid)
