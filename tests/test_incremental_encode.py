@@ -452,3 +452,60 @@ def test_runonce_decisions_identical_incremental_vs_full():
             ))
         assert stats[0] == stats[1], f"loop {loop}: {stats[0]} != {stats[1]}"
     assert autos[0]._encoder is not None and autos[1]._encoder is None
+
+
+def test_padded_array_growth_across_buckets():
+    """Node, scheduled-slot and equivalence-row growth past their shape
+    buckets (triggering _grow_nodes/_grow_scheduled/_grow_specs incl. the
+    planes axes) must stay semantically equal to a fresh encode."""
+    opts = DrainOptions()
+    encoder = IncrementalEncoder(node_bucket=16, group_bucket=8,
+                                 pod_bucket=16, drain_opts=opts)
+    nodes = [build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384,
+                             pods=64, zone=["a", "b"][i % 2])
+             for i in range(14)]
+    pods = []
+    for i in range(14):  # near the pod bucket
+        p = build_test_pod(f"r{i}", cpu_milli=100, mem_mib=64,
+                           owner_name=f"rs{i}",  # distinct rows: near g_pad
+                           labels={"app": f"a{i % 3}"},
+                           node_name=f"n{i % 14}")
+        pods.append(p)
+    inc = encoder.encode(nodes, pods, now=1.0)
+    assert inc.nodes.n == 16 and inc.scheduled.p == 16
+
+    # cross every bucket at once: +6 nodes, +8 residents (distinct owners →
+    # new rows too), plus a constrained group (planes must grow in step)
+    from kubernetes_autoscaler_tpu.models.api import TopologySpreadConstraint
+
+    for i in range(14, 20):
+        nodes.append(build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384,
+                                     pods=64, zone=["a", "b"][i % 2]))
+    for i in range(14, 22):
+        p = build_test_pod(f"r{i}", cpu_milli=100, mem_mib=64,
+                           owner_name=f"rs{i}",
+                           labels={"app": f"a{i % 3}"},
+                           node_name=f"n{i % 20}")
+        pods.append(p)
+    spreader = build_test_pod("spreader", cpu_milli=100, mem_mib=64,
+                              owner_name="rs-spread",
+                              labels={"app": "a0"})
+    spreader.topology_spread = [TopologySpreadConstraint(
+        max_skew=2, topology_key="topology.kubernetes.io/zone",
+        match_labels={"app": "a0"})]
+    pods.append(spreader)
+
+    inc = encoder.encode(nodes, pods, now=2.0)
+    assert encoder.full_encodes == 1            # grown, not rebuilt
+    assert inc.nodes.n == 32 and inc.scheduled.p == 32
+    _assert_equiv(inc, _reference(_FakeWorld(nodes, pods), encoder.registry,
+                                  opts, 2.0), step="growth", nodes=nodes)
+
+
+class _FakeWorld:
+    def __init__(self, nodes, pods):
+        self._nodes, self._pods = nodes, pods
+        self.pdbs = set()
+
+    def lists(self):
+        return list(self._nodes), list(self._pods)
