@@ -48,7 +48,6 @@ from kubernetes_autoscaler_tpu.models.encode import (
 )
 from kubernetes_autoscaler_tpu.ops import scoring
 from kubernetes_autoscaler_tpu.resourcequotas.tracker import QuotaTracker
-from kubernetes_autoscaler_tpu.utils import oracle
 
 
 @dataclass
@@ -226,6 +225,13 @@ class ScaleUpOrchestrator:
         if not flagged.any():
             return options
         all_nodes, pods_by_node = enc.all_nodes_and_pods()
+        # incremental constraint cache: the full oracle walks nodes x pods
+        # PER exemplar check — seconds per flagged option at 5k x 50k
+        from kubernetes_autoscaler_tpu.utils.oracle_cache import ConfirmOracle
+
+        oracle_world = ConfirmOracle(all_nodes, pods_by_node,
+                                     registry=enc.registry,
+                                     namespaces=enc.namespaces)
         scheduled = np.asarray(est.scheduled)  # [NG, G]
         # --max-binpacking-time bounds the whole option computation; once the
         # budget is gone, options needing a re-estimate are dropped rather
@@ -242,10 +248,7 @@ class ScaleUpOrchestrator:
                     continue
                 if gi < len(enc.group_pods) and enc.group_pods[gi]:
                     exemplar = enc.pending_pods[enc.group_pods[gi][0]]
-                    if not oracle.check_pod_on_new_node(
-                            exemplar, g_t, all_nodes, pods_by_node,
-                            registry=enc.registry,
-                            namespaces=enc.namespaces):
+                    if not oracle_world.check_on_new_node(exemplar, g_t):
                         refuted.append(int(gi))
             if not refuted:
                 out.append(opt)

@@ -323,6 +323,23 @@ def check_pod_in_cluster(
     return True
 
 
+def fresh_node_from_template(template: Node,
+                             fresh_name: str = "template-fresh-node") -> Node:
+    """Template → concrete fresh node, the estimator's sanitization
+    (binpacking_estimator.go:330 via SanitizedNodeInfo). Shared by the
+    oracle and the ConfirmOracle cache so their worlds cannot diverge."""
+    return Node(
+        name=fresh_name,
+        labels={**template.labels, HOSTNAME_KEY: fresh_name},
+        annotations=dict(template.annotations),
+        capacity=dict(template.capacity),
+        allocatable=dict(template.allocatable),
+        taints=list(template.taints),
+        ready=True,
+        unschedulable=False,
+    )
+
+
 def check_pod_on_new_node(
     pod: Pod,
     template: Node,
@@ -336,16 +353,7 @@ def check_pod_on_new_node(
     current cluster? This is the scale-up winner-verification question
     (reference: the estimator schedules against a sanitized template NodeInfo
     added to the forked snapshot, binpacking_estimator.go:330)."""
-    fresh = Node(
-        name=fresh_name,
-        labels={**template.labels, HOSTNAME_KEY: fresh_name},
-        annotations=dict(template.annotations),
-        capacity=dict(template.capacity),
-        allocatable=dict(template.allocatable),
-        taints=list(template.taints),
-        ready=True,
-        unschedulable=False,
-    )
+    fresh = fresh_node_from_template(template, fresh_name)
     return check_pod_in_cluster(
         pod, fresh, list(nodes) + [fresh], pods_by_node, registry,
         namespaces=namespaces,

@@ -62,12 +62,13 @@ class _CountIndex:
     of topology key (the oracle's matched_anywhere semantics)."""
 
     __slots__ = ("by_domain", "total", "matcher", "topology_key",
-                 "node_filter", "domains")
+                 "node_filter", "domains", "filter_memo")
 
     def __init__(self, topology_key, matcher, node_filter=None):
         self.topology_key = topology_key
         self.matcher = matcher        # Pod -> bool (memoized by caller)
         self.node_filter = node_filter  # Node -> bool (memoized), or None
+        self.filter_memo: dict[str, bool] = {}
         self.by_domain: dict[str, int] = {}
         self.total = 0
         # domain value -> number of included alive nodes holding it (spread
@@ -136,6 +137,28 @@ class ConfirmOracle:
                 if dst in self._used:
                     self._used[dst] = self._used[dst] + self._req(pod)
 
+    def add_node(self, node: Node) -> None:
+        """A node joins the world (e.g. a FRESH template instantiation for
+        scale-up winner verification) — spread domain sets grow where the
+        node passes a constraint's inclusion policies."""
+        self.node_by_name[node.name] = node
+        for idx in self._indexes.values():
+            if idx.node_filter is not None and idx.node_filter(node):
+                v = _o.topology_value(node, idx.topology_key)
+                if v is not None:
+                    idx.domains[v] = idx.domains.get(v, 0) + 1
+
+    def check_on_new_node(self, pod: Pod, template: Node,
+                          fresh_name: str = "template-fresh-node") -> bool:
+        """≡ oracle.check_pod_on_new_node over the cache's current world:
+        can `pod` schedule on a FRESH node stamped from `template`?"""
+        fresh = _o.fresh_node_from_template(template, fresh_name)
+        self.add_node(fresh)
+        try:
+            return self.check(pod, fresh)
+        finally:
+            self.remove_node(fresh.name)
+
     def remove_node(self, name: str) -> None:
         """Node leaves the world; any pods still listed on it vanish with it
         (the pass's by_node.pop semantics — daemonset leftovers)."""
@@ -146,6 +169,10 @@ class ConfirmOracle:
             for idx in self._matched_indexes(q):
                 idx.bump(nd, -1)
         self._used.pop(name, None)
+        # NAME-keyed memos must die with the node: a different node may
+        # reuse the name (the fresh template-node name does, every
+        # check_on_new_node call) and would otherwise see stale verdicts
+        self._cap_memo.pop(name, None)
         for idx in self._indexes.values():
             if idx.node_filter is not None and idx.node_filter(nd):
                 v = _o.topology_value(nd, idx.topology_key)
@@ -153,6 +180,7 @@ class ConfirmOracle:
                     idx.domains[v] -= 1
                     if idx.domains[v] <= 0:
                         del idx.domains[v]
+            idx.filter_memo.pop(name, None)
 
 
     # ------------------------------------------------------------- internal
@@ -187,9 +215,8 @@ class ConfirmOracle:
                 return hit
 
             filt = None
+            fmemo: dict[str, bool] = {}
             if node_filter is not None:
-                fmemo: dict[str, bool] = {}
-
                 def filt(nd: Node, _f=node_filter, _memo=fmemo):
                     hit = _memo.get(nd.name)
                     if hit is None:
@@ -197,6 +224,7 @@ class ConfirmOracle:
                     return hit
 
             idx = _CountIndex(topology_key, memo_matcher, filt)
+            idx.filter_memo = fmemo
             for name, qs in self.pods_by_node.items():
                 nd = self.node_by_name.get(name)
                 if nd is None:

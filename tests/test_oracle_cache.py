@@ -115,3 +115,32 @@ def test_cache_matches_oracle_under_mutations():
                     residents.remove(q)
                 alive.remove(nd)
             assert_agree(step)
+
+
+def test_check_on_new_node_matches_oracle():
+    """ConfirmOracle.check_on_new_node ≡ oracle.check_pod_on_new_node (the
+    scale-up winner-verification question) across randomized worlds."""
+    namespaces = {"default": {"tier": "prod"}, "team-a": {"tier": "dev"}}
+    for seed in range(8):
+        rng = random.Random(700 + seed)
+        nodes, residents = _world(rng)
+        probes = _probe_pods(rng)
+        by_node = oracle.group_pods_by_node(residents)
+        cache = ConfirmOracle(nodes, by_node, namespaces=namespaces)
+        # SEVERAL templates through ONE cache: name-keyed memo staleness
+        # across fresh-node checks is exactly the bug this guards against
+        templates = [
+            build_test_node("tmpl", cpu_milli=cpu, mem_mib=mem, pods=32,
+                            labels={"pool": rng.choice(["x", "y"])},
+                            zone=zone)
+            for cpu, mem, zone in ((8000, 16384, rng.choice(["a", "d"])),
+                                   (100, 128, "b"),
+                                   (16000, 32768, ""))]
+        for template in templates:
+            for p in probes:
+                want = oracle.check_pod_on_new_node(
+                    p, template, nodes, by_node, namespaces=namespaces)
+                got = cache.check_on_new_node(p, template)
+                assert got == want, f"seed {seed}: {p.name}"
+                # the fresh node must leave no residue (repeatable)
+                assert cache.check_on_new_node(p, template) == want
