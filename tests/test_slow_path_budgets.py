@@ -150,3 +150,25 @@ def test_simulation_timeout_caps_pathological_shapes():
     pl.nodes_to_delete(enc, nodes, now=1000.0)
     took = time.perf_counter() - t0
     assert took < 5.0  # deadline checked per candidate, not per move
+
+
+def test_all_constrained_default_budgets_fast():
+    """With PRODUCTION budgets (max 10 deletions/loop, 1 drain) the
+    constrained confirm is bounded regardless of cluster size."""
+    fake, enc, nodes = _world(1000, spread=True)
+    pl = Planner(fake.provider, _opts(
+        max_scale_down_parallelism=10, max_drain_parallelism=1,
+        max_empty_bulk_delete=10))
+    pl.update(enc, nodes, now=1000.0)
+    pl.nodes_to_delete(enc, nodes, now=1000.0)       # warm
+    pl.update(enc, nodes, now=1001.0)
+    t0 = time.perf_counter()
+    plan = pl.nodes_to_delete(enc, nodes, now=1001.0)
+    took = time.perf_counter() - t0
+    assert len(plan) >= 1
+    if took >= 0.3:                                  # one retry under CI load
+        pl.update(enc, nodes, now=1002.0)
+        t0 = time.perf_counter()
+        pl.nodes_to_delete(enc, nodes, now=1002.0)
+        took = time.perf_counter() - t0
+    assert took < 0.3, f"default-budget constrained confirm {took*1e3:.0f}ms"
