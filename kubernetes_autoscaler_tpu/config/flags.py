@@ -111,6 +111,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds CA keeps retrying a failed pod eviction")
     p.add_argument("--force-delete-unregistered-nodes", type=_bool,
                    default=False)
+    p.add_argument("--async-node-deletion", type=_bool, default=False,
+                   help="run evict+delete on a background executor (the "
+                        "reference always detaches; default off because "
+                        "in-process sinks complete instantly)")
     p.add_argument("--skip-nodes-with-system-pods", type=_bool, default=True)
     p.add_argument("--skip-nodes-with-local-storage", type=_bool, default=True)
     p.add_argument("--skip-nodes-with-custom-controller-pods", type=_bool,
@@ -288,6 +292,7 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         max_pod_eviction_time_s=args.max_pod_eviction_time,
         scale_down_simulation_timeout_s=args.scale_down_simulation_timeout,
         force_delete_unregistered_nodes=args.force_delete_unregistered_nodes,
+        async_node_deletion=args.async_node_deletion,
         incremental_encode=args.incremental_encode,
         incremental_resync_loops=args.incremental_resync_loops,
     )
