@@ -46,14 +46,18 @@ from kubernetes_autoscaler_tpu.resourcequotas.tracker import QuotaTracker
 
 
 def _hostarr(enc: "EncodedCluster", key: str, dev) -> np.ndarray:
-    """Prefer the incremental encoder's host mirror for PLACEMENT-INVARIANT
-    tensors — reading the device array costs a device→host round trip per
-    call (~70 ms over the TPU tunnel). NEVER use for nodes.alloc or
-    specs.count: those are replaced post-placement on the device and the
-    planner must see the placed capacity (static_autoscaler sync comment)."""
+    """Prefer the incremental encoder's host mirror — reading the device
+    array costs a device→host round trip per call (~70 ms over the TPU
+    tunnel). The mirror substitutes ONLY while `dev` is still the exact
+    handed-out array (host_mirror_token identity check): the loop REPLACES
+    tensors (placement charging, upcoming-node injection, drainability) and
+    the mirrors do not follow those replacements. nodes.alloc/specs.count
+    are additionally excluded outright — post-placement state by design."""
     assert key not in ("nodes.alloc", "specs.count")
     h = enc.host_arrays
-    if h is not None and key in h:
+    tok = enc.host_mirror_token
+    if h is not None and tok is not None and key in h \
+            and tok.get(key) is dev:
         return np.asarray(h[key])
     return np.asarray(dev)
 

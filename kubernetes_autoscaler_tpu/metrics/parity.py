@@ -74,11 +74,17 @@ def emit_cluster_metrics(registry, cluster_state, provider, options, enc,
 
     registry.gauge("cluster_safe_to_autoscale").set(
         1.0 if cluster_state.is_cluster_healthy() else 0.0)
-    # prefer the incremental encoder's host mirrors: reading the device
-    # arrays here would cost two device→host transfers per loop
+    # prefer the incremental encoder's host mirrors (two device→host
+    # transfers saved per loop) — but only while the device tensors are
+    # still the handed-out arrays (upcoming-node injection replaces them)
     h = enc.host_arrays or {}
-    cap = np.asarray(h.get("nodes.cap", enc.nodes.cap), dtype=np.int64)
-    valid = np.asarray(h.get("nodes.valid", enc.nodes.valid))
+    tok = enc.host_mirror_token or {}
+    cap = np.asarray(
+        h["nodes.cap"] if tok.get("nodes.cap") is enc.nodes.cap
+        else enc.nodes.cap, dtype=np.int64)
+    valid = np.asarray(
+        h["nodes.valid"] if tok.get("nodes.valid") is enc.nodes.valid
+        else enc.nodes.valid)
     sums = cap[valid].sum(axis=0) if valid.any() else np.zeros(cap.shape[1])
     registry.gauge("cluster_cpu_current_cores").set(float(sums[res.CPU]) / 1000.0)
     registry.gauge("cluster_memory_current_bytes").set(

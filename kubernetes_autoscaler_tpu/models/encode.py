@@ -529,6 +529,11 @@ class EncodedCluster:
     # encoder (models/incremental.py) can seed its mirrors without a device
     # round-trip (device readback over the TPU tunnel is ~70 ms/sync)
     host_arrays: dict | None = None
+    # id(device array) per key AT HANDOUT: a mirror may only substitute for
+    # a device read while the tensor is still the handed-out object — the
+    # loop REPLACES tensors (placement charging, upcoming-node injection,
+    # drainability) and the mirrors do not follow those
+    host_mirror_token: dict | None = None
 
     def all_nodes_and_pods(self) -> tuple[list[Node], dict[str, list[Pod]]]:
         """Host view for the exact oracle (utils/oracle.check_pod_in_cluster).
@@ -728,6 +733,9 @@ def encode_cluster(
         g_aff_any[:] = p_aff.sum(axis=1) > 0
     has_constraints = bool(constrained_rows)
 
+    # token values are the ARRAY OBJECTS (compared with `is`): holding the
+    # reference also pins it, so a freed array's address can never be reused
+    # by a different array that would spuriously match (id() would be unsafe)
     host_arrays = {
         "nodes.cap": cap, "nodes.alloc": alloc, "nodes.label_hash": label_hash,
         "nodes.taint_exact": taint_exact, "nodes.taint_key": taint_key,
@@ -750,25 +758,32 @@ def encode_cluster(
         "planes.anti_zone_cnt": p_anti_zone, "planes.spread_cnt": p_spread,
     }
 
+    out_nodes = _device(NodeTensors(
+        cap=cap, alloc=alloc, label_hash=label_hash, taint_exact=taint_exact,
+        taint_key=taint_key, used_ports=used_ports, zone_id=zone_id,
+        group_id=group_id, ready=ready, schedulable=schedulable, valid=valid,
+    ))
+    out_specs = _device(PodGroupTensors(
+        req=g_req, count=g_count, sel_req=g_sel_req, sel_neg=g_sel_neg,
+        tol_exact=g_tol_exact, tol_key=g_tol_key, tolerate_all=g_tol_all,
+        port_hash=g_ports, anti_affinity_self=g_anti_self, valid=g_valid,
+        needs_host_check=g_hostcheck,
+        spread_kind=g_spread_kind, max_skew=g_max_skew,
+        spread_self=g_spread_self, aff_kind=g_aff_kind, aff_self=g_aff_self,
+        aff_match_any=g_aff_any, anti_self_zone=g_anti_self_zone,
+    ))
+    out_sched = _device(ScheduledPodTensors(
+        req=s_req, node_idx=s_node, group_ref=s_group, movable=s_movable,
+        blocks=s_blocks, valid=s_valid,
+    ))
+    out_planes = _device(AffinityPlanes(
+        aff_cnt=p_aff, anti_host_cnt=p_anti_host,
+        anti_zone_cnt=p_anti_zone, spread_cnt=p_spread,
+    ))
     return EncodedCluster(
-        nodes=_device(NodeTensors(
-            cap=cap, alloc=alloc, label_hash=label_hash, taint_exact=taint_exact,
-            taint_key=taint_key, used_ports=used_ports, zone_id=zone_id,
-            group_id=group_id, ready=ready, schedulable=schedulable, valid=valid,
-        )),
-        specs=_device(PodGroupTensors(
-            req=g_req, count=g_count, sel_req=g_sel_req, sel_neg=g_sel_neg,
-            tol_exact=g_tol_exact, tol_key=g_tol_key, tolerate_all=g_tol_all,
-            port_hash=g_ports, anti_affinity_self=g_anti_self, valid=g_valid,
-            needs_host_check=g_hostcheck,
-            spread_kind=g_spread_kind, max_skew=g_max_skew,
-            spread_self=g_spread_self, aff_kind=g_aff_kind, aff_self=g_aff_self,
-            aff_match_any=g_aff_any, anti_self_zone=g_anti_self_zone,
-        )),
-        scheduled=_device(ScheduledPodTensors(
-            req=s_req, node_idx=s_node, group_ref=s_group, movable=s_movable,
-            blocks=s_blocks, valid=s_valid,
-        )),
+        nodes=out_nodes,
+        specs=out_specs,
+        scheduled=out_sched,
         node_names=[nd.name for nd in nodes],
         node_index=node_index,
         zone_table=zone_table,
@@ -777,15 +792,26 @@ def encode_cluster(
         group_pods=group_pods,
         pending_pods=pending,
         scheduled_pods=resident,
-        planes=_device(AffinityPlanes(
-            aff_cnt=p_aff, anti_host_cnt=p_anti_host,
-            anti_zone_cnt=p_anti_zone, spread_cnt=p_spread,
-        )),
+        planes=out_planes,
         has_constraints=has_constraints,
         node_objs=list(nodes),
         namespaces=namespaces,
         host_arrays=host_arrays,
+        host_mirror_token=mirror_token(out_nodes, out_specs, out_sched,
+                                       out_planes),
     )
+
+
+def mirror_token(nodes_t, specs_t, sched_t, planes_t) -> dict:
+    """host_mirror_token over EVERY mirrored field (derived from the same
+    field sets both encode paths use — no hand-maintained key list)."""
+    out: dict = {}
+    for section, tree in (("nodes", nodes_t), ("specs", specs_t),
+                          ("scheduled", sched_t), ("planes", planes_t)):
+        for f, arr in vars(tree).items():
+            if arr is not None and not f.startswith("_"):
+                out[f"{section}.{f}"] = arr
+    return out
 
 
 def encode_node_groups(

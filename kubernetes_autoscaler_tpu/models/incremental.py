@@ -915,6 +915,7 @@ class IncrementalEncoder:
                                    for f in _PLANE_FIELDS})
         self._dirty.clear()
         self._dirty_rows.clear()
+        token = dict(self._dev)  # array objects, compared with `is`
         return EncodedCluster(
             nodes=nodes, specs=specs, scheduled=scheduled,
             node_names=list(self._node_names),
@@ -931,6 +932,7 @@ class IncrementalEncoder:
             node_objs=list(self._node_objs),
             namespaces=self._namespaces,
             host_arrays=self._m,
+            host_mirror_token=token,
         )
 
 

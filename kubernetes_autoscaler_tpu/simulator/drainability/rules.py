@@ -157,4 +157,8 @@ def apply_drainability(enc, opts: DrainOptions = DrainOptions(),
     if enc.host_arrays is not None:  # keep the host mirror coherent
         enc.host_arrays["scheduled.movable"] = movable
         enc.host_arrays["scheduled.blocks"] = blocks
+        if enc.host_mirror_token is not None:
+            # the replaced device arrays ARE mirrored by the new host arrays
+            enc.host_mirror_token["scheduled.movable"] = enc.scheduled.movable
+            enc.host_mirror_token["scheduled.blocks"] = enc.scheduled.blocks
     return enc

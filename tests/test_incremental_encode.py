@@ -509,3 +509,65 @@ class _FakeWorld:
 
     def lists(self):
         return list(self._nodes), list(self._pods)
+
+
+def test_upcoming_injection_with_mirror_reads():
+    """Upcoming-node injection REPLACES (and here GROWS past the bucket) the
+    snapshot's device tensors mid-loop; the planner's host-mirror reads must
+    detect the replacement (host_mirror_token) and fall back to the device —
+    and decisions must match the full-encode path."""
+    from kubernetes_autoscaler_tpu.config.options import (
+        AutoscalingOptions,
+        NodeGroupDefaults,
+    )
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import (
+        StaticAutoscaler,
+    )
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+
+    def build():
+        fake = FakeCluster(provision_delay_s=10_000.0)  # stays upcoming
+        tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384,
+                               pods=32)
+        fake.add_node_group("ng1", tmpl, min_size=1, max_size=40)
+        for i in range(14):                 # node bucket is 16: injection
+            nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384,
+                                 pods=32)   # of 4 upcoming grows past it
+            fake.add_existing_node("ng1", nd)
+            if i >= 10:                     # an idle band for scale-down
+                continue
+            fake.add_pod(build_test_pod(
+                f"r{i}", cpu_milli=6000, mem_mib=1024,
+                owner_name=f"rs{i % 3}", node_name=nd.name))
+        for i in range(12):                 # demand worth ~4 new nodes
+            fake.add_pod(build_test_pod(
+                f"p{i}", cpu_milli=2500, mem_mib=512, owner_name="prs"))
+        return fake
+
+    def run(inc):
+        fake = build()
+        a = StaticAutoscaler(
+            fake.provider, fake,
+            options=AutoscalingOptions(
+                incremental_encode=inc,
+                node_shape_bucket=16, group_shape_bucket=16,
+                max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+                scale_down_delay_after_add_s=0.0,
+                scale_down_delay_after_failure_s=0.0,
+                node_group_defaults=NodeGroupDefaults(
+                    scale_down_unneeded_time_s=0.0,
+                    scale_down_unready_time_s=0.0)),
+            eviction_sink=fake)
+        out = []
+        for loop in range(3):
+            now = 1000.0 + 10.0 * loop
+            fake.advance_to(now)
+            st = a.run_once(now=now)
+            out.append((
+                sorted(st.scale_up.increases.items())
+                if st.scale_up and st.scale_up.scaled_up else None,
+                sorted(st.unneeded_nodes), sorted(st.scale_down_deleted),
+                st.pending_pods))
+        return out
+
+    assert run(True) == run(False)
