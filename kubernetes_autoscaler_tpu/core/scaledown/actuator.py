@@ -15,8 +15,10 @@ deployment) so the actuator logic is transport-independent.
 from __future__ import annotations
 
 import concurrent.futures
+import copy
+import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Callable, Protocol
 
 from kubernetes_autoscaler_tpu.cloudprovider.provider import (
@@ -40,6 +42,9 @@ DEFAULT_EVICTION_RETRY_TIME_S = 10.0
 DEFAULT_POD_EVICTION_HEADROOM_S = 30.0
 # apiv1.DefaultTerminationGracePeriodSeconds
 DEFAULT_TERMINATION_GRACE_S = 30.0
+# how long an eviction counts as "recent" for planner re-injection
+# (reference: NewNodeDeletionTracker(15*time.Minute), builder wiring)
+DEFAULT_EVICTIONS_TTL_S = 900.0
 
 
 class EvictionSink(Protocol):
@@ -78,20 +83,59 @@ def priority_eviction_order(pods: list[Pod]) -> list[Pod]:
 
 @dataclass
 class NodeDeletionTracker:
-    """reference: deletiontracker/nodedeletiontracker.go — in-flight registry."""
+    """reference: deletiontracker/nodedeletiontracker.go — in-flight deletion
+    registry + recent-eviction registry (RegisterEviction :125,
+    RecentEvictions :132 with the expiring-list TTL). Lock-protected: drains
+    run in worker threads and detached deletions span loops, so the control
+    loop reads this concurrently with the workers' writes."""
 
     deleting: dict[str, float] = field(default_factory=dict)
+    drained: set[str] = field(default_factory=set)   # subset of `deleting` with pods
     results: list[DeletionResult] = field(default_factory=list)
+    evictions_ttl_s: float = DEFAULT_EVICTIONS_TTL_S
+    _evictions: list[tuple[Pod, float]] = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
-    def start(self, node: str, now: float) -> None:
-        self.deleting[node] = now
+    def start(self, node: str, now: float, drain: bool = False) -> None:
+        with self._lock:
+            self.deleting[node] = now
+            if drain:
+                self.drained.add(node)
 
     def finish(self, node: str, ok: bool, reason: str = "") -> None:
-        self.deleting.pop(node, None)
-        self.results.append(DeletionResult(node, ok, reason))
+        with self._lock:
+            self.deleting.pop(node, None)
+            self.drained.discard(node)
+            self.results.append(DeletionResult(node, ok, reason))
 
     def in_flight(self) -> int:
-        return len(self.deleting)
+        with self._lock:
+            return len(self.deleting)
+
+    def is_deleting(self, node: str) -> bool:
+        with self._lock:
+            return node in self.deleting
+
+    def drain_deletions_in_progress(self) -> list[str]:
+        """Names of nodes currently being DRAINED (reference:
+        DeletionsInProgress()'s second return — the set the
+        currently-drained-nodes pod list processor consumes)."""
+        with self._lock:
+            return sorted(self.drained)
+
+    def register_eviction(self, pod: Pod, now: float) -> None:
+        """reference: RegisterEviction — called per successfully evicted pod
+        so the planner can anticipate its recreation (planner.go:230-260)."""
+        with self._lock:
+            self._evictions.append((pod, now))
+
+    def recent_evictions(self, now: float) -> list[Pod]:
+        """Pods evicted within evictions_ttl_s (reference: RecentEvictions,
+        expiring-list DropNotNewerThan prune on read)."""
+        with self._lock:
+            cutoff = now - self.evictions_ttl_s
+            self._evictions = [(p, t) for p, t in self._evictions if t > cutoff]
+            return [p for p, _ in self._evictions]
 
 
 class Actuator:
@@ -120,9 +164,17 @@ class Actuator:
         self.pod_eviction_headroom_s = DEFAULT_POD_EVICTION_HEADROOM_S
         self._sink_takes_grace: bool | None = None  # resolved on first evict
         # detached-deletion support (reference: deleteNodesAsync goroutines,
-        # actuator.go:287 — deletions never block the control loop there)
+        # actuator.go:287 — deletions never block the control loop there).
+        # on_result fires ON THE WORKER THREAD — notification only; all
+        # bookkeeping belongs in drain_completed(), which the control loop
+        # calls at the top of RunOnce (r4 advisor: the old callback mutated
+        # ClusterStateRegistry/observers/metrics off-thread)
         self.on_result = on_result
         self._bg: concurrent.futures.ThreadPoolExecutor | None = None
+        self._completed: list[DeletionResult] = []
+        self._completed_lock = threading.Lock()
+        # live Node objects for deferred rollback (workers act on copies)
+        self._live_nodes: dict[str, Node] = {}
 
     # ---- eviction with retry (reference: drain.go evictPod :240) ----
 
@@ -275,16 +327,31 @@ class Actuator:
                 if self.options.cordon_node_before_terminating:
                     r.node.unschedulable = True
                 self.taint_to_be_deleted(r.node)
-                self.tracker.start(r.node.name, now)
+                self.tracker.start(r.node.name, now, drain=not r.is_empty)
+                self._live_nodes[r.node.name] = r.node
             if self._bg is None:
                 self._bg = concurrent.futures.ThreadPoolExecutor(
                     max_workers=max(self.options.max_scale_down_parallelism,
                                     1),
                     thread_name_prefix="ka-delete")
+            # the worker gets COPIES of the node/pod objects: the next loop
+            # re-reads and re-encodes the live ones concurrently (r4 advisor
+            # race); failed-node rollback is deferred to drain_completed()
+            # on the control-loop thread, against the live Node
+            work = [replace(r, node=self._copy_node(r.node)) for r in to_remove]
+            slots = None
+            if pods_by_slot is not None:
+                needed = {s for r in to_remove
+                          for s in (*r.pods_to_move, *r.ds_to_evict)}
+                slots = {s: copy.copy(pods_by_slot[s])
+                         for s in needed if s in pods_by_slot}
 
             def run():
                 results = self._execute_deletion(
-                    to_remove, pods_by_slot, now, force, pre_tainted=True)
+                    work, slots, now, force, pre_tainted=True,
+                    defer_rollback=True)
+                with self._completed_lock:
+                    self._completed.extend(results)
                 if self.on_result is not None:
                     for res in results:
                         self.on_result(res)
@@ -293,6 +360,26 @@ class Actuator:
             return []
         return self._execute_deletion(to_remove, pods_by_slot, now, force)
 
+    @staticmethod
+    def _copy_node(node: Node) -> Node:
+        nd = copy.copy(node)
+        nd.taints = list(node.taints)
+        return nd
+
+    def drain_completed(self) -> list[DeletionResult]:
+        """Pop finished DETACHED deletions; called at the top of RunOnce so
+        registry/observer/metric bookkeeping — and failed-node rollback —
+        happen on the control-loop thread (reference: deletion results are
+        consumed via NodeDeletionTracker.DeletionResults in RunOnce, not in
+        the deletion goroutines)."""
+        with self._completed_lock:
+            done, self._completed = self._completed, []
+        for res in done:
+            live = self._live_nodes.pop(res.node, None)
+            if live is not None and not res.ok:
+                self._rollback_node(live)
+        return done
+
     def _execute_deletion(
         self,
         to_remove: list[NodeToRemove],
@@ -300,6 +387,7 @@ class Actuator:
         now: float,
         force: bool,
         pre_tainted: bool = False,
+        defer_rollback: bool = False,
     ) -> list[DeletionResult]:
         empty = [r for r in to_remove if r.is_empty]
         drain = [r for r in to_remove if not r.is_empty]
@@ -311,7 +399,7 @@ class Actuator:
                     # node unschedulable before the taint lands
                     r.node.unschedulable = True
                 self.taint_to_be_deleted(r.node)
-                self.tracker.start(r.node.name, now)
+                self.tracker.start(r.node.name, now, drain=not r.is_empty)
 
         def evict_daemonsets(r: NodeToRemove) -> None:
             """--daemonset-eviction-for-{empty,occupied}-nodes."""
@@ -335,6 +423,9 @@ class Actuator:
             g = self.provider.node_group_for_node(r.node)
             if g is None:
                 self.tracker.finish(r.node.name, False, "NoNodeGroup")
+                # a terminal result for every started node — the detached
+                # path's deferred bookkeeping/rollback depends on it
+                results.append(DeletionResult(r.node.name, False, "NoNodeGroup"))
                 continue
             by_group.setdefault(g.id(), []).append(r)
         for gid, rs in by_group.items():
@@ -358,7 +449,8 @@ class Actuator:
                         results.append(DeletionResult(r.node.name, True))
                 except NodeGroupError as e:
                     for r in batch:
-                        self._rollback_node(r.node)
+                        if not defer_rollback:
+                            self._rollback_node(r.node)
                         self.tracker.finish(r.node.name, False, str(e))
                         results.append(DeletionResult(r.node.name, False, str(e)))
 
@@ -375,15 +467,25 @@ class Actuator:
                         # Forced deletion bypasses PDBs (StartForceDeletion).
                         if not self.pdb_tracker.try_remove_pods(victims):
                             raise NodeGroupError("PDB budget exhausted")
+                    # per-NODE retry window shared by every pod eviction of
+                    # the node (reference: drain.go:185 — retryUntil is
+                    # computed once per node and all pod-eviction goroutines
+                    # run against it). This also bounds the worst-case stall
+                    # of a synchronous drain at max-pod-eviction-time per
+                    # NODE, not per pod (r4 advisor): a persistently failing
+                    # sink costs one window, later pods fail fast and the
+                    # drain rolls back to retry next loop.
+                    retry_until = self.clock() + \
+                        self.options.max_pod_eviction_time_s
                     for pod in priority_eviction_order(victims):
-                        # per-POD retry window (the reference gets the same
-                        # effect by evicting pods in parallel goroutines that
-                        # each run until retryUntil; sequentially, the window
-                        # must restart per pod or later pods get no retries)
-                        retry_until = self.clock() + \
-                            self.options.max_pod_eviction_time_s
                         self._evict_with_retry(pod, r.node, retry_until,
                                                force=force)
+                        # planner anticipation feed (reference:
+                        # RegisterEviction per evicted pod, drain.go).
+                        # Stamped at EVICTION time, wall clock — detached
+                        # drains may run long after dispatch `now`, and the
+                        # TTL is measured against the loop's wall time
+                        self.tracker.register_eviction(pod, time.time())
                     self._wait_pods_gone(r.node, victims)
                     from kubernetes_autoscaler_tpu.metrics.metrics import (
                         default_registry,
@@ -403,7 +505,8 @@ class Actuator:
                     self.latency_tracker.observe_deletion(r.node.name, now)
                 return DeletionResult(r.node.name, True)
             except NodeGroupError as e:
-                self._rollback_node(r.node)
+                if not defer_rollback:
+                    self._rollback_node(r.node)
                 self.tracker.finish(r.node.name, False, str(e))
                 return DeletionResult(r.node.name, False, str(e))
 

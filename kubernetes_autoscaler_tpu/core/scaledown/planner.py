@@ -79,6 +79,10 @@ class PlannerState:
     utilization: dict[str, float] = field(default_factory=dict)
     removal: RemovalResult | None = None
     candidate_indices: np.ndarray | None = None
+    # recently-evicted-pod anticipation (reference: injectRecentlyEvictedPods)
+    evictions_injected: int = 0
+    evictions_uninjectable: int = 0
+    injected_pods: list = field(default_factory=list)   # placed copies
 
 
 class Planner:
@@ -95,11 +99,99 @@ class Planner:
         self.pdb_tracker = pdb_tracker          # shared with the actuator
         self.latency_tracker = latency_tracker
 
+    # ---- evicted-pod anticipation (reference: injectRecentlyEvictedPods,
+    # planner.go:230-260) ----
+
+    def _inject_evicted(self, enc: EncodedCluster, nodes: list[Node],
+                        pods: list) -> None:
+        """Charge recently evicted, not-yet-recreated pods onto the snapshot
+        before the drain sweep, so consolidation cannot reclaim the capacity
+        their recreation needs. The reference schedules them into the forked
+        snapshot via HintingSimulator.TrySchedulePods (ScheduleAnywhere —
+        taints keep them off draining nodes, planner.go:296-300); here each
+        pod host-places onto the first node that passes the exact-oracle
+        predicates with device-true free capacity (cap − alloc, which already
+        includes this loop's simulated placements), and the summed charge is
+        applied to the node-allocation tensor in one device op. Pods that fit
+        nowhere are counted (the reference logs the same condition)."""
+        import copy as _copy
+
+        from kubernetes_autoscaler_tpu.models.encode import pod_request_vector
+        from kubernetes_autoscaler_tpu.utils import oracle
+
+        cap = _hostarr(enc, "nodes.cap", enc.nodes.cap).astype(np.int64)
+        alloc = np.asarray(enc.nodes.alloc).astype(np.int64)
+        free = cap - alloc
+        ok_node = (np.asarray(_hostarr(enc, "nodes.valid", enc.nodes.valid))
+                   & np.asarray(_hostarr(enc, "nodes.ready", enc.nodes.ready)))
+        n_real = len(nodes)
+        by_node: dict[str, list] = {}
+        for q in enc.scheduled_pods:
+            if q is None:
+                continue
+            by_node.setdefault(q.node_name, []).append(q)
+        delta = np.zeros_like(alloc)
+        injected = failed = 0
+        placed_pods: list = []
+        for pod in pods:
+            p = _copy.copy(pod)
+            p.node_name = ""                      # ClearPodNodeNames
+            req, _lossy = pod_request_vector(p, enc.registry)
+            placed = False
+            for i in range(n_real):
+                if not ok_node[i]:
+                    continue
+                if not (free[i] >= req).all():
+                    continue
+                nd = nodes[i]
+                # predicate-only exact checks (capacity came from the
+                # device-true free tensor above, which check_pod_in_cluster's
+                # own resource pass cannot see)
+                if not oracle.node_schedulable(nd):
+                    continue
+                if not oracle.selector_matches(p, nd):
+                    continue
+                if not oracle.taints_tolerated(p, nd):
+                    continue
+                if not oracle.ports_free(p, by_node.get(nd.name, [])):
+                    continue
+                if p.anti_affinity and not oracle.anti_affinity_ok(
+                        p, nd, nodes, by_node, enc.namespaces):
+                    continue
+                if p.pod_affinity and not oracle.pod_affinity_ok(
+                        p, nd, nodes, by_node, enc.namespaces):
+                    continue
+                if not oracle.spread_ok(p, nd, nodes, by_node):
+                    continue
+                free[i] -= req
+                delta[i] += req
+                p.node_name = nd.name
+                by_node.setdefault(nd.name, []).append(p)
+                placed = True
+                break
+            if placed:
+                injected += 1
+                placed_pods.append(p)
+            else:
+                failed += 1
+        if injected:
+            enc.nodes = enc.nodes.replace(
+                alloc=enc.nodes.alloc + jnp.asarray(delta, dtype=enc.nodes.alloc.dtype))
+        self.state.evictions_injected = injected
+        self.state.evictions_uninjectable = failed
+        self.state.injected_pods = placed_pods
+
     # ---- per-loop state update (reference: UpdateClusterState :120) ----
 
     def update(self, enc: EncodedCluster, nodes: list[Node],
-               now: float | None = None) -> PlannerState:
+               now: float | None = None,
+               inject_pods: list | None = None) -> PlannerState:
         now = time.time() if now is None else now
+        self.state.evictions_injected = 0
+        self.state.evictions_uninjectable = 0
+        self.state.injected_pods = []
+        if inject_pods:
+            self._inject_evicted(enc, nodes, inject_pods)
         n_real = len(nodes)
         util = self._utilization(enc, nodes)
         defaults = _ng_defaults(self.options)
@@ -558,6 +650,10 @@ class Planner:
             for q in enc.scheduled_pods:
                 if q is None:  # freed slot (incremental encoder hole)
                     continue
+                by_node.setdefault(q.node_name, []).append(q)
+            # anticipated (injected) evicted pods are residents of the
+            # oracle world too — their alloc charge is already in `free`
+            for q in self.state.injected_pods:
                 by_node.setdefault(q.node_name, []).append(q)
             oracle_world = ConfirmOracle(
                 list(nodes), by_node, registry=enc.registry,

@@ -161,8 +161,17 @@ class StaticAutoscaler:
         self._async_group_of: dict[str, str] = {}
         self.actuator = Actuator(provider, self.options, eviction_sink,
                                  pdb_tracker=self.pdb_tracker,
-                                 latency_tracker=self.latency_tracker,
-                                 on_result=self._on_deletion_result)
+                                 latency_tracker=self.latency_tracker)
+        # pods on still-draining nodes join the pending list pre-scale-up
+        # (reference chain slot: after the expendable filter,
+        # pod_list_processor.go:28-32)
+        from kubernetes_autoscaler_tpu.processors.processors import (
+            CurrentlyDrainedNodesProcessor,
+        )
+
+        self.processors.pod_list_processors.insert(
+            min(2, len(self.processors.pod_list_processors)),
+            CurrentlyDrainedNodesProcessor(self.actuator.tracker))
         self.last_scale_down_delete: float = 0.0
         self.last_scale_down_fail: float = 0.0
         # one-time crash recovery on the first loop (reference:
@@ -231,6 +240,10 @@ class StaticAutoscaler:
     def _run_once_inner(self, now: float) -> RunOnceStatus:
         status = RunOnceStatus()
         with self.metrics.time_function("main"):
+            # finished async deletions first: their bookkeeping (and any
+            # failed-node taint rollback) must land before this loop reads
+            # cluster state
+            self._drain_deletion_results(now)
             self.provider.refresh()
             nodes = self.source.list_nodes()
             pods = self.source.list_pods()
@@ -295,6 +308,7 @@ class StaticAutoscaler:
                 self.options, self.provider, now,
                 list_workloads=getattr(self.source, "list_workloads", None),
             )
+            source_pods = pods     # pre-pipeline list (recreation checks)
             pods = self.processors.run_pod_list(pods, ctx)
 
             # PDB refresh (reference: planner.go builds the RemainingPdbTracker
@@ -467,7 +481,10 @@ class StaticAutoscaler:
             if self.options.scale_down_enabled and not scaled_up \
                     and self._scale_down_allowed(now):
                 with self.metrics.time_function("scale_down_update"):
-                    self.planner.update(enc, nodes, now)
+                    self.planner.update(
+                        enc, nodes, now,
+                        inject_pods=self._evicted_pods_to_inject(
+                            source_pods, now))
                 status.unneeded_nodes = list(self.planner.state.unneeded)
                 # persist scale-down intent as soft taints (reference:
                 # actuation/softtaint.go UpdateSoftDeletionTaints) so a
@@ -565,24 +582,84 @@ class StaticAutoscaler:
 
     # ---- scale-up dispatch (single vs salvo) ----
 
-    def _on_deletion_result(self, res) -> None:
-        """Completion hook for DETACHED deletions (reference: the result
-        observation the deleteNodesAsync goroutines perform through the
-        NodeDeletionTracker). Runs on the actuator's background thread."""
-        import time as _time
+    def _drain_deletion_results(self, now: float) -> None:
+        """Apply completed DETACHED deletions' bookkeeping at the top of
+        RunOnce — on the control-loop thread (reference: RunOnce consumes
+        NodeDeletionTracker.DeletionResults; r4 advisor flagged the previous
+        worker-thread callback racing ClusterStateRegistry/observers)."""
+        for res in self.actuator.drain_completed():
+            gid = self._async_group_of.pop(res.node, "")
+            if res.ok:
+                self.cluster_state.register_scale_down(res.node, now, gid)
+                self.last_scale_down_delete = now
+                self.node_group_change_observers.register_scale_down(
+                    gid, res.node, now)
+                self.metrics.counter("scaled_down_nodes_total").inc()
+            else:
+                self.last_scale_down_fail = now
+                self.node_group_change_observers.register_failed_scale_down(
+                    gid, res.node, res.reason, now)
 
-        now = _time.time()
-        gid = self._async_group_of.pop(res.node, "")
-        if res.ok:
-            self.cluster_state.register_scale_down(res.node, now, gid)
-            self.last_scale_down_delete = now
-            self.node_group_change_observers.register_scale_down(
-                gid, res.node, now)
-            self.metrics.counter("scaled_down_nodes_total").inc()
-        else:
-            self.last_scale_down_fail = now
-            self.node_group_change_observers.register_failed_scale_down(
-                gid, res.node, res.reason, now)
+    def _evicted_pods_to_inject(self, live_pods: list[Pod],
+                                now: float) -> list[Pod]:
+        """Recently evicted, recreatable, NOT-yet-recreated pods — the
+        planner injects these before scale-down planning (reference:
+        planner.go:239-260 injectRecentlyEvictedPods + filterOutRecreatedPods
+        with per-controller replica checks via controller.go getReplicas).
+
+        Recreation detection: a pod whose (namespace, name) is live again is
+        recreated; for owners with a known Workload, at most
+        (target − current) replicas are injected per owner (current = live
+        non-terminal owned pods, the stand-in for the controller's
+        Status.Replicas); unknown owners inject unconditionally — "to be on
+        the safe side in case there is some custom controller" (planner.go
+        :250-253)."""
+        recent = self.actuator.tracker.recent_evictions(now)
+        if not recent:
+            return []
+        from kubernetes_autoscaler_tpu.models.api import is_recreatable
+
+        live_keys = {(p.namespace, p.name) for p in live_pods
+                     if p.phase not in ("Succeeded", "Failed")}
+        workloads = []
+        lw = getattr(self.source, "list_workloads", None)
+        if lw is not None:
+            workloads = list(lw())
+        target_of: dict[tuple, int] = {}
+        for w in workloads:
+            target_of[(w.kind, w.namespace, w.name)] = w.replicas
+            if getattr(w, "uid", ""):
+                target_of[("uid", w.uid)] = w.replicas
+        current: dict[tuple, int] = {}
+        for p in live_pods:
+            if p.owner is None or p.phase in ("Succeeded", "Failed"):
+                continue
+            for key in ((p.owner.kind, p.namespace, p.owner.name),
+                        ("uid", p.owner.uid) if p.owner.uid else None):
+                if key is not None and key in target_of:
+                    current[key] = current.get(key, 0) + 1
+        added: dict[tuple, int] = {}
+        out: list[Pod] = []
+        for p in recent:
+            if not is_recreatable(p):
+                continue
+            if (p.namespace, p.name) in live_keys:
+                continue                       # literally recreated (e.g. STS)
+            key = None
+            if p.owner is not None:
+                for k in (("uid", p.owner.uid) if p.owner.uid else None,
+                          (p.owner.kind, p.namespace, p.owner.name)):
+                    if k is not None and k in target_of:
+                        key = k
+                        break
+            if key is None:
+                out.append(p)                  # unknown controller: inject
+                continue
+            gap = target_of[key] - current.get(key, 0)
+            if added.get(key, 0) < gap:
+                added[key] = added.get(key, 0) + 1
+                out.append(p)
+        return out
 
     def _dispatch_scale_up(self, enc, snapshot, nodes: list[Node],
                            now: float) -> ScaleUpResult:
@@ -692,7 +769,11 @@ class StaticAutoscaler:
                         tainted_since[nd.name] = since
                     else:
                         self.actuator.untaint(nd, DELETION_CANDIDATE_TAINT)
-            if any(t.key == TO_BE_DELETED_TAINT for t in nd.taints):
+            # a ToBeDeleted taint is stale ONLY if no deletion is actually in
+            # flight for the node — detached deletions this process started
+            # (or a test armed) before the first loop must keep theirs
+            if not self.actuator.tracker.is_deleting(nd.name) and any(
+                    t.key == TO_BE_DELETED_TAINT for t in nd.taints):
                 self.actuator.untaint(nd, TO_BE_DELETED_TAINT)
         if tainted_since:
             self.planner.unneeded_nodes.load_from_taints(tainted_since)

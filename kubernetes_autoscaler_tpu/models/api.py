@@ -194,6 +194,22 @@ class Pod:
         return out
 
 
+def is_recreatable(pod: "Pod") -> bool:
+    """Will this pod's controller recreate it after an eviction?
+    (reference: utils/pod/pod.go:65 FilterRecreatablePods — skip static,
+    mirror and DaemonSet pods, including the
+    cluster-autoscaler.kubernetes.io/daemonset-pod annotation form)."""
+    if pod.is_mirror():
+        return False
+    src = pod.annotations.get("kubernetes.io/config.source")
+    if src is not None and src != "api":     # static pod (IsStaticPod)
+        return False
+    if pod.is_daemonset() or pod.annotations.get(
+            "cluster-autoscaler.kubernetes.io/daemonset-pod") == "true":
+        return False
+    return True
+
+
 def labels_match(selector: dict[str, str], labels: dict[str, str]) -> bool:
     """match_labels subset test. An EMPTY selector matches no pods — both the
     spread and affinity encodings treat {} as 'selects nothing'."""

@@ -98,6 +98,10 @@ class _PodRec:
     seen: int
     req: np.ndarray
     ports: list[int]
+    # deletion_timestamp is mutated in place by control-plane-style sinks
+    # just like node_name/phase, and drives the long-terminating drain rule
+    # — it belongs in the mutable-field diff (r4 advisor)
+    deletion_ts: float | None = None
 
 
 @dataclass(slots=True)
@@ -268,6 +272,7 @@ class IncrementalEncoder:
                 seen=self._seq, req=s_req[j].copy(),
                 ports=[fold32(f"{pt}/{proto or 'TCP'}")
                        for pt, proto in p.host_ports],
+                deletion_ts=p.deletion_timestamp,
             )
             self._pods[rec.key] = rec
             self._by_id[id(p)] = rec
@@ -290,6 +295,7 @@ class IncrementalEncoder:
                 pod=p, key=(p.namespace, p.name), node_name=p.node_name,
                 phase=p.phase, state="pending", row=pend_row[i], slot=-1,
                 seen=self._seq, req=None, ports=[],
+                deletion_ts=p.deletion_timestamp,
             )
             self._pods[rec.key] = rec
             self._by_id[id(p)] = rec
@@ -381,21 +387,30 @@ class IncrementalEncoder:
         changed: list[tuple[_PodRec | None, Pod | None]] = []
         by_id = self._by_id
         pods_map = self._pods
+        new_keys: set[tuple[str, str]] = set()
         for p in pods:
             rec = by_id.get(id(p))
             if rec is not None and rec.pod is p:
+                if rec.seen == seq:
+                    raise _ResyncNeeded  # same pod listed twice
                 rec.seen = seq
                 hits += 1
-                if rec.node_name != p.node_name or rec.phase != p.phase:
+                if (rec.node_name != p.node_name or rec.phase != p.phase
+                        or rec.deletion_ts != p.deletion_timestamp):
                     changed.append((rec, p))
                 continue
             key = (p.namespace, p.name)
             rec = pods_map.get(key)
             if rec is not None:
+                if rec.seen == seq:
+                    raise _ResyncNeeded  # duplicate pod key — malformed source
                 rec.seen = seq
                 hits += 1
                 changed.append((rec, p))   # object replaced → re-lower
             elif p.phase not in _TERMINAL:
+                if key in new_keys:
+                    raise _ResyncNeeded    # two new pods share a key
+                new_keys.add(key)
                 changed.append((None, p))  # new pod
         if hits < len(pods_map):
             for rec in [r for r in pods_map.values() if r.seen != seq]:
@@ -479,6 +494,7 @@ class IncrementalEncoder:
         rec.seen = self._seq
         rec.phase = p.phase
         rec.node_name = p.node_name
+        rec.deletion_ts = p.deletion_timestamp
         if p.deletion_timestamp is not None:
             self._deletion_ts_keys.add(rec.key)
         if rec.row < 0:

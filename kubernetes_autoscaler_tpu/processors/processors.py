@@ -52,6 +52,64 @@ class ClearTpuRequestsProcessor:
         return pods
 
 
+class CurrentlyDrainedNodesProcessor:
+    """reference: core/podlistprocessor/currently_drained_nodes.go — pods on
+    nodes whose DRAIN is still in flight join the pending list (node name
+    cleared) so scale-up provisions their replacement capacity before the
+    node disappears. Matters most with --async-node-deletion, where drains
+    span loops and the capacity is leaving while the pods still show as
+    scheduled.
+
+    The injected objects are COPIES (the live pods stay bound to the draining
+    node — the reference likewise keeps the originals in the snapshot, where
+    the ToBeDeleted taint stops duplicates landing back on the leaving node),
+    cached by identity across loops so the incremental encoder sees a stable
+    pending set while a drain is in progress. Copies are renamed
+    "drained::<name>" — ':' cannot appear in real pod names, so the encoder's
+    (namespace, name) keyspace stays collision-free while the original is
+    still listed."""
+
+    def __init__(self, deletion_tracker):
+        self.tracker = deletion_tracker          # actuator's NodeDeletionTracker
+        self._copies: dict[tuple[str, str], Pod] = {}
+
+    def process(self, pods, ctx):
+        from kubernetes_autoscaler_tpu.models.api import is_recreatable
+
+        draining = set(self.tracker.drain_deletions_in_progress())
+        if not draining:
+            self._copies.clear()
+            return pods
+        injected: list[Pod] = []
+        live_keys: set[tuple[str, str]] = set()
+        for p in pods:
+            if p.node_name not in draining:
+                continue
+            # deletion already under way -> the eviction/recreation path
+            # owns it (currently_drained_nodes.go:57 skips these)
+            if p.deletion_timestamp is not None:
+                continue
+            if not is_recreatable(p):
+                continue
+            key = (p.namespace, p.name)
+            live_keys.add(key)
+            cp = self._copies.get(key)
+            if cp is None:
+                import copy as _copy
+
+                cp = _copy.copy(p)
+                cp.name = f"drained::{p.name}"
+                cp.uid = f"drained::{p.uid}"
+                cp.node_name = ""                # ClearPodNodeNames
+                cp.phase = "Pending"
+                self._copies[key] = cp
+            injected.append(cp)
+        for key in list(self._copies):
+            if key not in live_keys:
+                del self._copies[key]
+        return pods + injected
+
+
 class FilterExpendableProcessor:
     """reference: filter_out_expendable.go — drop pods below the priority
     cutoff (--expendable-pods-priority-cutoff)."""

@@ -104,7 +104,13 @@ class CertManager:
                 cert = x509.load_pem_x509_certificate(f.read())
         except (OSError, ValueError):
             return None
-        return cert.not_valid_after_utc.timestamp()
+        try:
+            return cert.not_valid_after_utc.timestamp()
+        except AttributeError:  # cryptography < 42
+            import datetime
+
+            return cert.not_valid_after.replace(
+                tzinfo=datetime.timezone.utc).timestamp()
 
     def ensure(self, now: float | None = None) -> bool:
         """Generate/rotate when absent or expiring soon; True if rotated."""
@@ -134,6 +140,9 @@ class CertManager:
             os.replace(tmp_c, self.cert_path)
             os.replace(tmp_k, self.key_path)
             self.rotations += 1
-            for hook in self._reload_hooks:
-                hook(self.cert_path, self.key_path)
-            return True
+            hooks = list(self._reload_hooks)
+        # hooks run OUTSIDE the non-reentrant lock — a hook calling back
+        # into ensure() must not deadlock (r4 advisor)
+        for hook in hooks:
+            hook(self.cert_path, self.key_path)
+        return True

@@ -1,0 +1,128 @@
+"""Standing TPU-tunnel probe: self-arming bench capture.
+
+Round-4 verdict: the axon tunnel was down the whole round and the flagship
+e2e metric has never touched hardware; the fix is a probe that cannot miss a
+tunnel window ("make the capture self-arming", VERDICT.md Next-round #1).
+
+Run under tmux for the whole round:
+
+    tmux new-session -d -s tpuprobe "python tools/tpu_probe.py"
+
+Behavior:
+  - every PROBE_INTERVAL_S, spawn a SUBPROCESS that touches the backend
+    (device discovery + one tiny dispatch) under a hard timeout — the r4
+    failure mode was a hang, not an error, so the touch must be killable;
+  - first success arms the full bench: `python bench.py` (sim p50 +
+    runonce_e2e p50 at the 50k pods x 5k nodes shape), stdout JSON lines
+    appended to BENCH_probe.jsonl and the full log to bench_stderr.log;
+  - keeps probing after a capture (cheap), re-benching at most every
+    REBENCH_INTERVAL_S while the tunnel stays up so the final artifact is
+    fresh; state transitions (down->up, up->down) are always logged,
+    repeated failures are logged at most every LOG_EVERY_FAILS attempts.
+"""
+
+from __future__ import annotations
+
+import datetime
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+LOG = REPO / "bench_stderr.log"
+OUT = REPO / "BENCH_probe.jsonl"
+PROBE_INTERVAL_S = 300
+TOUCH_TIMEOUT_S = 120
+BENCH_TIMEOUT_S = 3600
+REBENCH_INTERVAL_S = 5400
+LOG_EVERY_FAILS = 6  # one failure line per ~30 min of down tunnel
+
+TOUCH = (
+    "import jax, jax.numpy as jnp; "
+    "d = jax.devices(); "
+    "x = jax.jit(lambda v: (v * 2).sum())(jnp.ones((128,), jnp.bfloat16)); "
+    "print('touch-ok', d[0].platform, float(x), flush=True)"
+)
+
+
+def log(msg: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M:%S UTC")
+    line = f"# [tpu_probe {stamp}] {msg}"
+    print(line, flush=True)
+    with LOG.open("a") as f:
+        f.write(line + "\n")
+
+
+def touch() -> tuple[bool, str]:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", TOUCH], capture_output=True, text=True,
+            timeout=TOUCH_TIMEOUT_S, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return False, f"touch exceeded {TOUCH_TIMEOUT_S}s (tunnel hang?)"
+    if r.returncode != 0:
+        return False, (r.stderr or r.stdout).strip().splitlines()[-1][:200] \
+            if (r.stderr or r.stdout).strip() else f"rc={r.returncode}"
+    return True, r.stdout.strip()
+
+
+def run_bench() -> bool:
+    log("tunnel green -> firing full bench (this may take many minutes)")
+    try:
+        r = subprocess.run(
+            [sys.executable, "bench.py"], capture_output=True, text=True,
+            timeout=BENCH_TIMEOUT_S, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log(f"bench exceeded {BENCH_TIMEOUT_S}s; will retry on next green probe")
+        return False
+    json_lines = [ln for ln in r.stdout.splitlines()
+                  if ln.startswith("{") and '"metric"' in ln]
+    with LOG.open("a") as f:
+        if r.stderr.strip():
+            f.write("# --- bench stderr (probe-armed run) ---\n")
+            for ln in r.stderr.strip().splitlines()[-40:]:
+                f.write("#   " + ln + "\n")
+    ok = r.returncode == 0 and any('"value": null' not in ln
+                                   for ln in json_lines)
+    if json_lines:
+        with OUT.open("a") as f:
+            for ln in json_lines:
+                f.write(ln + "\n")
+        log(f"bench rc={r.returncode}; captured {len(json_lines)} metric "
+            f"line(s) -> BENCH_probe.jsonl: "
+            + " | ".join(ln[:160] for ln in json_lines))
+    else:
+        log(f"bench rc={r.returncode}, no metric lines; stderr tail: "
+            + (r.stderr.strip().splitlines()[-1][:200]
+               if r.stderr.strip() else "<empty>"))
+    return ok
+
+
+def main() -> None:
+    log("probe started (interval %ss, touch timeout %ss)"
+        % (PROBE_INTERVAL_S, TOUCH_TIMEOUT_S))
+    was_up = False
+    fails = 0
+    last_bench_ok = 0.0
+    while True:
+        ok, detail = touch()
+        if ok:
+            if not was_up:
+                log(f"tunnel UP: {detail}")
+            fails = 0
+            was_up = True
+            if time.time() - last_bench_ok >= REBENCH_INTERVAL_S:
+                if run_bench():
+                    last_bench_ok = time.time()
+        else:
+            if was_up or fails % LOG_EVERY_FAILS == 0:
+                log(f"tunnel down: {detail} (fail #{fails + 1})")
+            was_up = False
+            fails += 1
+        time.sleep(PROBE_INTERVAL_S)
+
+
+if __name__ == "__main__":
+    main()
