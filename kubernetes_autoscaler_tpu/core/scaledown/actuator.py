@@ -150,6 +150,7 @@ class Actuator:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
         on_result: Callable[[DeletionResult], None] | None = None,
+        walltime: Callable[[], float] = time.time,
     ):
         self.provider = provider
         self.options = options
@@ -160,6 +161,11 @@ class Actuator:
         self.latency_tracker = latency_tracker  # core/scaledown/latencytracker
         self.clock = clock                      # injectable for retry tests
         self.sleep = sleep
+        # the RunOnce `now` domain (wall clock in production, logical time in
+        # harnesses): eviction timestamps must live in the SAME domain the
+        # control loop prunes recent_evictions with — monotonic self.clock
+        # would never line up with it
+        self.walltime = walltime
         self.eviction_retry_time_s = DEFAULT_EVICTION_RETRY_TIME_S
         self.pod_eviction_headroom_s = DEFAULT_POD_EVICTION_HEADROOM_S
         self._sink_takes_grace: bool | None = None  # resolved on first evict
@@ -347,9 +353,21 @@ class Actuator:
                          for s in needed if s in pods_by_slot}
 
             def run():
-                results = self._execute_deletion(
-                    work, slots, now, force, pre_tainted=True,
-                    defer_rollback=True)
+                try:
+                    results = self._execute_deletion(
+                        work, slots, now, force, pre_tainted=True,
+                        defer_rollback=True)
+                except Exception as e:  # noqa: BLE001 — a worker must never
+                    # strand its nodes: synthesize terminal failures so
+                    # drain_completed still rolls back and books them
+                    results = []
+                    for r in work:
+                        # whoever is still in flight got no terminal result
+                        if not self.tracker.is_deleting(r.node.name):
+                            continue
+                        self.tracker.finish(r.node.name, False, repr(e))
+                        results.append(
+                            DeletionResult(r.node.name, False, repr(e)))
                 with self._completed_lock:
                     self._completed.extend(results)
                 if self.on_result is not None:
@@ -482,10 +500,10 @@ class Actuator:
                                                force=force)
                         # planner anticipation feed (reference:
                         # RegisterEviction per evicted pod, drain.go).
-                        # Stamped at EVICTION time, wall clock — detached
-                        # drains may run long after dispatch `now`, and the
-                        # TTL is measured against the loop's wall time
-                        self.tracker.register_eviction(pod, time.time())
+                        # Stamped at EVICTION time — detached drains may run
+                        # long after dispatch `now` — in the walltime domain
+                        # the control loop prunes with
+                        self.tracker.register_eviction(pod, self.walltime())
                     self._wait_pods_gone(r.node, victims)
                     from kubernetes_autoscaler_tpu.metrics.metrics import (
                         default_registry,

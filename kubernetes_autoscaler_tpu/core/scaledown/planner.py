@@ -593,7 +593,10 @@ class Planner:
         # seconds; tests/test_native_confirm.py proves plan-equality vs the
         # Python pass below.
         pdbs = self.pdb_tracker.get_pdbs() if self.pdb_tracker else []
-        if not atomic_gids and len(pdbs) <= 64:
+        # anticipated evicted-pod phantoms need per-move host re-placement
+        # (below) that the native pass doesn't model — rare, python pass
+        if not atomic_gids and len(pdbs) <= 64 \
+                and not self.state.injected_pods:
             from kubernetes_autoscaler_tpu.core.scaledown import native_confirm
 
             moved_groups = np.unique(group_ref[
@@ -662,6 +665,13 @@ class Planner:
             received_slots: dict[int, list[int]] = {}
             moved_marks: set[tuple[int, int]] = set()
             final_dest: dict[int, int] = {}
+            # anticipated evicted-pod phantoms by CURRENT host (their alloc
+            # charge rides the node they were injected onto; removing that
+            # node must re-home them or fail, else consolidation reclaims
+            # exactly the capacity the injection reserved)
+            phantom_on: dict[str, list] = {}
+            for q in self.state.injected_pods:
+                phantom_on.setdefault(q.node_name, []).append(q)
             quota_status = None
             if self.quota is not None:
                 quota_status = self.quota.status_from_encoded(enc)
@@ -743,6 +753,7 @@ class Planner:
                 moves: dict[int, int] = {}
                 local_marks: set[tuple[int, int]] = set()
                 local_pod_moves: list[tuple[object, str, object]] = []
+                phantom_moves: list[tuple[object, np.ndarray, int]] = []
                 ok = True
                 slots_by_group: dict[int, list[int]] = {}
                 for slot in victim_slots:
@@ -809,11 +820,49 @@ class Planner:
                             local_marks.add((g_ref, d))
                     if not ok:
                         break
+                # re-home anticipated evicted-pod phantoms riding this node:
+                # their reserved capacity must survive the node's removal or
+                # the removal must not happen (without this, deleting the
+                # node they were injected onto silently reclaims exactly the
+                # capacity the injection protects)
+                if ok and phantom_on.get(name):
+                    from kubernetes_autoscaler_tpu.models.encode import (
+                        pod_request_vector,
+                    )
+
+                    for q in phantom_on[name]:
+                        qreq, _ = pod_request_vector(q, enc.registry)
+                        cand_d = np.nonzero(
+                            node_valid & ~deleted_mask
+                            & (free >= qreq[None, :]).all(axis=1))[0]
+                        d_found = -1
+                        for d in cand_d:
+                            d = int(d)
+                            if d == i:
+                                continue
+                            # rows beyond the real node list are injected
+                            # template capacity — capacity-only check there
+                            if d < len(nodes) and not oracle_world.check(
+                                    q, nodes[d]):
+                                continue
+                            d_found = d
+                            break
+                        if d_found < 0:
+                            ok = False
+                            break
+                        dst_name = (nodes[d_found].name
+                                    if d_found < len(nodes) else "")
+                        oracle_world.move(q, name, dst_name)
+                        local_pod_moves.append((q, name, dst_name))
+                        charge(d_found, qreq, +1)
+                        phantom_moves.append((q, qreq, d_found))
                 if not ok:
                     # revert charges; try again next loop (destinations taken
                     # by an earlier candidate this round)
                     for slot, d in moves.items():
                         charge(d, reqs[slot], -1)
+                    for q, qreq, d in phantom_moves:
+                        charge(d, qreq, -1)
                     for pod_obj, src_name, dst_name in local_pod_moves:
                         oracle_world.move(pod_obj, dst_name, src_name)
                     self._mark(name, "NoPlaceToMovePods", now)
@@ -838,6 +887,12 @@ class Planner:
                     received_slots.setdefault(d, []).append(slot)
                     final_dest[slot] = d
                 moved_marks |= local_marks
+                if phantom_moves:
+                    phantom_on.pop(name, None)
+                    for q, _qreq, d in phantom_moves:
+                        dst = (nodes[d].name if d < len(nodes)
+                               else f"__injected-row-{d}")
+                        phantom_on.setdefault(dst, []).append(q)
                 # The actuator evicts only pods physically on the node;
                 # received slots were capacity bookkeeping for the pass.
                 out.append(NodeToRemove(nd, bool(is_empty),

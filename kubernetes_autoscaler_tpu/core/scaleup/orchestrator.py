@@ -95,6 +95,10 @@ class ScaleUpOrchestrator:
         # accepted scale-up and are refreshed as two small arrays instead of
         # re-encoding + re-uploading the whole NodeGroupTensors per loop
         self._group_tensor_cache: tuple | None = None
+        # DaemonSet workloads for template DS-overhead charging (set per
+        # loop by StaticAutoscaler; reference: node_info_utils.go:45 threads
+        # the daemonset lister into every sanitized template)
+        self.daemonsets: list = []
 
     # ---- node-group validity (reference: filterValidScaleUpNodeGroups :152) ----
 
@@ -240,15 +244,24 @@ class ScaleUpOrchestrator:
         deadline = time.monotonic() + self.options.max_binpacking_time_s
         gpu_slot = enc.registry.slots.get(self.provider.gpu_resource_name())
         out = []
+        from kubernetes_autoscaler_tpu.utils.daemonset import (
+            daemonset_pods_for_node,
+        )
+
         for opt in options:
             g_t = groups[opt.group_index].template_node_info()
+            # the exact tier sees the same DS-loaded fresh node the dense
+            # capacity rows encode (node_info_utils.go:45)
+            ds_pods = daemonset_pods_for_node(g_t, self.daemonsets) \
+                if self.daemonsets else None
             refuted: list[int] = []
             for gi in np.nonzero(flagged)[0]:
                 if scheduled[opt.group_index, gi] <= 0:
                     continue
                 if gi < len(enc.group_pods) and enc.group_pods[gi]:
                     exemplar = enc.pending_pods[enc.group_pods[gi][0]]
-                    if not oracle_world.check_on_new_node(exemplar, g_t):
+                    if not oracle_world.check_on_new_node(
+                            exemplar, g_t, resident_pods=ds_pods):
                         refuted.append(int(gi))
             if not refuted:
                 out.append(opt)
@@ -301,6 +314,23 @@ class ScaleUpOrchestrator:
             tuple(sorted(enc.registry.slots.items())),
             tuple(sorted(enc.zone_table.ids.items())),
             enc.dims,
+            # DS churn changes the charged capacity rows — every field
+            # daemonset_overhead consults: requests + overhead (the charge),
+            # selector/affinity/tolerations (the node match)
+            tuple(
+                (w.namespace, w.name, w.uid,
+                 (tuple(sorted((k, float(v))
+                               for k, v in w.template.requests.items())),
+                  tuple(sorted((k, float(v))
+                               for k, v in w.template.overhead.items())),
+                  tuple(sorted(w.template.node_selector.items())),
+                  tuple(tuple((r.key, r.operator, r.values) for r in term)
+                        for term in w.template.affinity_node_terms()),
+                  tuple((t.key, t.value, t.effect, t.operator)
+                        for t in w.template.tolerations))
+                 if w.template is not None else None)
+                for w in self.daemonsets
+            ),
         )
         cached = self._group_tensor_cache
         if cached is not None and cached[0] == fp:
@@ -317,7 +347,7 @@ class ScaleUpOrchestrator:
                 self._group_tensor_cache = (fp, gt)
                 return gt
         gt = encode_node_groups(templates, enc.registry, enc.zone_table,
-                                enc.dims)
+                                enc.dims, daemonsets=self.daemonsets)
         self._group_tensor_cache = (fp, gt)
         return gt
 

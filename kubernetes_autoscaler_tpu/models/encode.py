@@ -820,12 +820,18 @@ def encode_node_groups(
     zone_table: ZoneTable,
     dims: Dims = DEFAULT_DIMS,
     bucket: int = 8,
+    daemonsets: list | None = None,
 ) -> NodeGroupTensors:
     """Lower node-group templates (template node, max_new, price/node) to tensors.
 
     Reference: MixedTemplateNodeInfoProvider (processors/nodeinfosprovider)
     produces a NodeInfo per group; sanitization (simulator/node_info_utils.go)
     is mirrored by the caller passing a clean template Node.
+
+    `daemonsets` (Workloads of kind DaemonSet) charge their matching pods'
+    requests against each template's capacity row — the reference builds
+    template NodeInfos WITH their DS pods (node_info_utils.go:45 via
+    daemonset.go:39), so every simulated new node starts DS-loaded.
     """
     ng_pad = pad_to(max(len(templates), 1), bucket)
     r = res.NUM_RESOURCES
@@ -839,6 +845,13 @@ def encode_node_groups(
     valid = np.zeros((ng_pad,), bool)
     for i, (tmpl, mx, pr) in enumerate(templates):
         cap[i] = node_capacity_vector(tmpl, registry)
+        if daemonsets:
+            from kubernetes_autoscaler_tpu.utils.daemonset import (
+                daemonset_overhead,
+            )
+
+            cap[i] = np.maximum(
+                cap[i] - daemonset_overhead(tmpl, daemonsets, registry), 0)
         _fill(label_hash[i], _label_items(tmpl.labels))
         tx, tk = [], []
         for t in tmpl.taints:

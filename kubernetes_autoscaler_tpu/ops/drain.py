@@ -96,10 +96,26 @@ def simulate_removals(
         jnp.asarray(candidates, jnp.int32),
         jnp.zeros((pad_c - c_total,), jnp.int32),
     ])
-    res = _simulate_removals_jit(
-        nodes, specs, scheduled, cand_pad, jnp.asarray(dest_allowed),
-        max_pods_per_node, chunk, max_groups_per_node, planes, max_zones,
-        with_constraints)
+    try:
+        res = _simulate_removals_jit(
+            nodes, specs, scheduled, cand_pad, jnp.asarray(dest_allowed),
+            max_pods_per_node, chunk, max_groups_per_node, planes, max_zones,
+            with_constraints)
+    except ValueError as e:
+        # jax 0.9.0 executable-cache corruption: after compiles at OTHER
+        # shapes, a dispatch can nondeterministically pair the call with an
+        # executable expecting one more (hoisted-constant) parameter —
+        # "Execution supplied N buffers but compiled program expected N+1".
+        # Avals/treedefs are verified identical across such calls, and a
+        # fresh compile of the same call succeeds, so: drop the poisoned
+        # entries and retry once.
+        if "buffers but compiled program expected" not in str(e):
+            raise
+        _simulate_removals_jit.clear_cache()
+        res = _simulate_removals_jit(
+            nodes, specs, scheduled, cand_pad, jnp.asarray(dest_allowed),
+            max_pods_per_node, chunk, max_groups_per_node, planes, max_zones,
+            with_constraints)
     return RemovalResult(
         drainable=res.drainable[:c_total],
         has_blocker=res.has_blocker[:c_total],

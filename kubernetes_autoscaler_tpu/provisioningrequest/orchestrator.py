@@ -117,7 +117,8 @@ class ProvReqOrchestrator:
             for g in groups
         ]
         group_tensors = encode_node_groups(
-            templates, enc.registry, enc.zone_table, enc.dims
+            templates, enc.registry, enc.zone_table, enc.dims,
+            daemonsets=getattr(self, "daemonsets", None),
         )
         estimator = BinpackingEstimator(
             enc.dims, max_new_nodes_static=self.max_new_nodes_static

@@ -119,10 +119,13 @@ class TensorClusterSnapshot:
 
     # ---- node mutation (reference AddNodeInfo/RemoveNodeInfo) ----
 
-    def add_node(self, node: Node, group_id: int = -1) -> int:
+    def add_node(self, node: Node, group_id: int = -1,
+                 alloc_row=None) -> int:
         """Add a (template-instantiated) node; grows padded space if needed.
         Reference analog: estimator adding template nodes
-        (binpacking_estimator.go:330 via SanitizedNodeInfo)."""
+        (binpacking_estimator.go:330 via SanitizedNodeInfo). `alloc_row`
+        pre-charges the fresh node (DaemonSet overhead — the reference's
+        template NodeInfos carry their DS pods, node_info_utils.go:45)."""
         s = self.state
         if node.name in s.node_index:
             raise SnapshotError(f"node {node.name} already in snapshot")
@@ -140,7 +143,8 @@ class TensorClusterSnapshot:
         nt = s.nodes
         s.nodes = nt.replace(
             cap=nt.cap.at[i].set(jnp.asarray(row["cap"])),
-            alloc=nt.alloc.at[i].set(0),
+            alloc=nt.alloc.at[i].set(
+                0 if alloc_row is None else jnp.asarray(alloc_row)),
             label_hash=nt.label_hash.at[i].set(jnp.asarray(row["label_hash"])),
             taint_exact=nt.taint_exact.at[i].set(jnp.asarray(row["taint_exact"])),
             taint_key=nt.taint_key.at[i].set(jnp.asarray(row["taint_key"])),

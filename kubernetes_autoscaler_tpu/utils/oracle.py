@@ -348,12 +348,17 @@ def check_pod_on_new_node(
     registry: res.ExtendedResourceRegistry | None = None,
     fresh_name: str = "template-fresh-node",
     namespaces: dict[str, dict[str, str]] | None = None,
+    resident_pods: list[Pod] | None = None,
 ) -> bool:
     """Can `pod` schedule on a FRESH node stamped from `template`, given the
     current cluster? This is the scale-up winner-verification question
     (reference: the estimator schedules against a sanitized template NodeInfo
-    added to the forked snapshot, binpacking_estimator.go:330)."""
+    added to the forked snapshot, binpacking_estimator.go:330).
+    `resident_pods` pre-load the fresh node — DaemonSet overhead, the
+    reference's DS-loaded template NodeInfos (node_info_utils.go:45)."""
     fresh = fresh_node_from_template(template, fresh_name)
+    if resident_pods:
+        pods_by_node = {**pods_by_node, fresh.name: list(resident_pods)}
     return check_pod_in_cluster(
         pod, fresh, list(nodes) + [fresh], pods_by_node, registry,
         namespaces=namespaces,

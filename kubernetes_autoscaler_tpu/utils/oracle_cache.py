@@ -149,11 +149,19 @@ class ConfirmOracle:
                     idx.domains[v] = idx.domains.get(v, 0) + 1
 
     def check_on_new_node(self, pod: Pod, template: Node,
-                          fresh_name: str = "template-fresh-node") -> bool:
+                          fresh_name: str = "template-fresh-node",
+                          resident_pods: list | None = None) -> bool:
         """≡ oracle.check_pod_on_new_node over the cache's current world:
-        can `pod` schedule on a FRESH node stamped from `template`?"""
+        can `pod` schedule on a FRESH node stamped from `template`?
+        `resident_pods` pre-load the fresh node (DaemonSet overhead —
+        reference template NodeInfos carry their DS pods)."""
         fresh = _o.fresh_node_from_template(template, fresh_name)
         self.add_node(fresh)
+        if resident_pods:
+            self.pods_by_node[fresh.name] = list(resident_pods)
+            for q in resident_pods:          # symmetric with remove_node's -1
+                for idx in self._matched_indexes(q):
+                    idx.bump(fresh, +1)
         try:
             return self.check(pod, fresh)
         finally:

@@ -209,6 +209,65 @@ def test_planner_injection_counts_unplaceable():
     assert st.evictions_uninjectable == 1 and st.evictions_injected == 0
 
 
+def test_phantom_charge_survives_candidate_node_removal():
+    """An injected phantom riding a removal candidate must be re-homed by
+    the confirm pass — or block the removal — so consolidation can never
+    reclaim the capacity the injection reserved (review round-5 finding)."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    nodes = []
+    for name in ("node-a", "node-b"):
+        nd = build_test_node(name, cpu_milli=4000, mem_mib=8192)
+        fake.add_existing_node("ng1", nd)
+        nodes.append(nd)
+    pods = [
+        build_test_pod("pa", cpu_milli=400, mem_mib=128, node_name="node-a"),
+        build_test_pod("pb", cpu_milli=2200, mem_mib=128, node_name="node-b"),
+    ]
+    for p in pods:
+        p.phase = "Running"
+        fake.add_pod(p)
+    opts = AutoscalingOptions(node_group_defaults=NodeGroupDefaults())
+
+    # control: without the phantom, node-a consolidates away
+    planner = Planner(fake.provider, opts)
+    enc = _encode(nodes, pods)
+    planner.update(enc, nodes, now=0.0)
+    assert "node-a" in planner.state.unneeded
+    out = planner.nodes_to_delete(enc, nodes, now=1e6)
+    assert [r.node.name for r in out] == ["node-a"]
+
+    # phantom (1.5 cpu) lands on node-a (free 3.6); node-b's headroom (1.8)
+    # can absorb pa (0.4) but NOT pa + phantom -> removal must be blocked
+    phantom = build_test_pod("gone-0", cpu_milli=1500, mem_mib=128)
+    planner2 = Planner(fake.provider, opts)
+    enc2 = _encode(nodes, pods)
+    st = planner2.update(enc2, nodes, now=0.0, inject_pods=[phantom])
+    assert st.evictions_injected == 1
+    assert st.injected_pods[0].node_name == "node-a"
+    # device sweep sees only real pods, so node-a still looks drainable —
+    # the confirm pass is what must catch the phantom
+    assert "node-a" in st.unneeded
+    out2 = planner2.nodes_to_delete(enc2, nodes, now=1e6)
+    assert [r.node.name for r in out2] == []
+
+
+def test_phantom_rehomes_when_capacity_allows():
+    """When the destination CAN absorb both the drained pods and the
+    phantom, the removal goes through (the phantom re-homes, not blocks)."""
+    fake, nodes, pods = _planner_world()   # pa=1.0 on a, pb=1.0 on b, 4-cpu
+    opts = AutoscalingOptions(node_group_defaults=NodeGroupDefaults())
+    phantom = build_test_pod("gone-0", cpu_milli=500, mem_mib=64)
+    planner = Planner(fake.provider, opts)
+    enc = _encode(nodes, pods)
+    st = planner.update(enc, nodes, now=0.0, inject_pods=[phantom])
+    assert st.evictions_injected == 1
+    out = planner.nodes_to_delete(enc, nodes, now=1e6)
+    # node-b free 3.0 >= pa 1.0 + phantom 0.5
+    assert "node-a" in [r.node.name for r in out]
+
+
 # ---------- the recreated filter (static_autoscaler side) ----------
 
 
