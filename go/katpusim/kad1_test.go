@@ -1,0 +1,230 @@
+package katpusim
+
+// Conformance: replay the exported golden scenarios (testdata/<name>.json,
+// written by kubernetes_autoscaler_tpu/sidecar/go_fixtures.py) through this
+// encoder and compare against the committed payload bytes
+// (testdata/<name>_<i>.bin):
+//   - the KAD1 body must be BYTE-IDENTICAL,
+//   - the KAUX trailer is JSON and compares SEMANTICALLY (map ordering is
+//     implementation-defined), per docs/SIDECAR_WIRE.md §Conformance.
+//
+// Only the standard library is required: `go vet ./... && go test ./...`.
+
+import (
+	"bytes"
+	"encoding/binary"
+	"encoding/json"
+	"os"
+	"path/filepath"
+	"reflect"
+	"sort"
+	"testing"
+)
+
+type fixtureRecord struct {
+	Op   string `json:"op"`
+	Name string `json:"name"`
+	// upsert_node
+	Labels [][2]string `json:"labels"`
+	Taints []struct {
+		Key    string `json:"key"`
+		Value  string `json:"value"`
+		Effect byte   `json:"effect"`
+	} `json:"taints"`
+	Cap           []int32 `json:"cap"`
+	Ready         bool    `json:"ready"`
+	Unschedulable bool    `json:"unschedulable"`
+	GroupID       int32   `json:"group_id"`
+	Zone          string  `json:"zone"`
+	// upsert_pod
+	UID      string      `json:"uid"`
+	Node     string      `json:"node"`
+	Req      []int32     `json:"req"`
+	Selector [][2]string `json:"selector"`
+	Tols     []struct {
+		Key    string `json:"key"`
+		Exists bool   `json:"exists"`
+		Value  string `json:"value"`
+		Effect byte   `json:"effect"`
+	} `json:"tolerations"`
+	Ports []struct {
+		Port uint16 `json:"port"`
+		UDP  bool   `json:"udp"`
+	} `json:"ports"`
+	Movable  bool            `json:"movable"`
+	Blocks   bool            `json:"blocks"`
+	AntiSelf bool            `json:"anti_self"`
+	Lossy    bool            `json:"lossy"`
+	EqKey    string          `json:"eqkey"`
+	Aux      json.RawMessage `json:"aux"`
+}
+
+type fixtureDelta struct {
+	Payload    string          `json:"payload"`
+	Records    []fixtureRecord `json:"records"`
+	AuxDeletes []string        `json:"aux_deletes"`
+	HasAux     bool            `json:"has_aux"`
+}
+
+type fixtureFile struct {
+	Scenario string         `json:"scenario"`
+	Deltas   []fixtureDelta `json:"deltas"`
+}
+
+// splitPayload separates [KAD1][u32 count][body] from the optional
+// [json][u32 len][u32 crc][KAUX] trailer.
+func splitPayload(t *testing.T, p []byte) (body []byte, aux []byte) {
+	t.Helper()
+	if len(p) < 8 || string(p[:4]) != "KAD1" {
+		t.Fatalf("bad magic")
+	}
+	rest := p[8:]
+	if bytes.HasSuffix(rest, []byte("KAUX")) {
+		n := len(rest)
+		docLen := int(binary.LittleEndian.Uint32(rest[n-12 : n-8]))
+		return rest[:n-12-docLen], rest[n-12-docLen : n-12]
+	}
+	return rest, nil
+}
+
+func replay(t *testing.T, d fixtureDelta) *DeltaWriter {
+	t.Helper()
+	w := NewDeltaWriter()
+	for _, rec := range d.Records {
+		switch rec.Op {
+		case "upsert_node":
+			n := Node{Name: rec.Name, Labels: rec.Labels, Ready: rec.Ready,
+				Unschedulable: rec.Unschedulable, GroupID: rec.GroupID,
+				Zone: rec.Zone}
+			for _, tn := range rec.Taints {
+				n.Taints = append(n.Taints,
+					Taint{Key: tn.Key, Value: tn.Value, Effect: tn.Effect})
+			}
+			copy(n.Cap[:], rec.Cap)
+			w.UpsertNode(n)
+		case "delete_node":
+			w.DeleteNode(rec.Name)
+		case "upsert_pod":
+			p := Pod{UID: rec.UID, NodeName: rec.Node,
+				Selector: rec.Selector, Movable: rec.Movable,
+				Blocks: rec.Blocks, AntiSelf: rec.AntiSelf,
+				Lossy: rec.Lossy, EqKey: rec.EqKey}
+			copy(p.Req[:], rec.Req)
+			for _, tl := range rec.Tols {
+				p.Tols = append(p.Tols, Toleration{Key: tl.Key,
+					Exists: tl.Exists, Value: tl.Value, Effect: tl.Effect})
+			}
+			for _, hp := range rec.Ports {
+				p.Ports = append(p.Ports, HostPort{Port: hp.Port, UDP: hp.UDP})
+			}
+			var aux *AuxRecord
+			if len(rec.Aux) > 0 && string(rec.Aux) != "null" {
+				aux = &AuxRecord{}
+				if err := json.Unmarshal(rec.Aux, aux); err != nil {
+					t.Fatalf("aux unmarshal: %v", err)
+				}
+			}
+			w.UpsertPod(p, aux)
+		case "delete_pod":
+			w.DeletePod(rec.UID)
+		default:
+			t.Fatalf("unknown op %q", rec.Op)
+		}
+	}
+	return w
+}
+
+func normalizeAux(t *testing.T, doc []byte) map[string]any {
+	t.Helper()
+	if doc == nil {
+		return nil
+	}
+	var m map[string]any
+	if err := json.Unmarshal(doc, &m); err != nil {
+		t.Fatalf("aux json: %v", err)
+	}
+	// the del list is order-insensitive (a uid is deleted or not)
+	if del, ok := m["del"].([]any); ok {
+		ss := make([]string, len(del))
+		for i, v := range del {
+			ss[i] = v.(string)
+		}
+		sort.Strings(ss)
+		anys := make([]any, len(ss))
+		for i, s := range ss {
+			anys[i] = s
+		}
+		m["del"] = anys
+	}
+	return m
+}
+
+func TestGoldenScenariosByteConformance(t *testing.T) {
+	matches, err := filepath.Glob(filepath.Join("testdata", "*.json"))
+	if err != nil || len(matches) == 0 {
+		t.Fatalf("no fixtures (run go_fixtures.py): %v", err)
+	}
+	for _, path := range matches {
+		raw, err := os.ReadFile(path)
+		if err != nil {
+			t.Fatal(err)
+		}
+		var fx fixtureFile
+		if err := json.Unmarshal(raw, &fx); err != nil {
+			t.Fatalf("%s: %v", path, err)
+		}
+		t.Run(fx.Scenario, func(t *testing.T) {
+			for i, d := range fx.Deltas {
+				want, err := os.ReadFile(filepath.Join("testdata", d.Payload))
+				if err != nil {
+					t.Fatal(err)
+				}
+				got, err := replay(t, d).Payload()
+				if err != nil {
+					t.Fatalf("delta %d: %v", i, err)
+				}
+				wantBody, wantAux := splitPayload(t, want)
+				gotBody, gotAux := splitPayload(t, got)
+				if !bytes.Equal(wantBody, gotBody) {
+					for j := range wantBody {
+						if j >= len(gotBody) || wantBody[j] != gotBody[j] {
+							t.Fatalf("delta %d: KAD1 bodies differ at byte %d "+
+								"(want len %d, got len %d)",
+								i, j, len(wantBody), len(gotBody))
+						}
+					}
+					t.Fatalf("delta %d: got KAD1 body overruns want "+
+						"(want len %d, got len %d)",
+						i, len(wantBody), len(gotBody))
+				}
+				if (wantAux == nil) != (gotAux == nil) {
+					t.Fatalf("delta %d: aux presence differs (want %v, got %v)",
+						i, wantAux != nil, gotAux != nil)
+				}
+				if !reflect.DeepEqual(normalizeAux(t, wantAux),
+					normalizeAux(t, gotAux)) {
+					t.Fatalf("delta %d: KAUX trailers differ semantically\n"+
+						"want: %s\ngot:  %s", i, wantAux, gotAux)
+				}
+			}
+		})
+	}
+}
+
+func TestFold32MatchesPythonHash(t *testing.T) {
+	// pinned values from kubernetes_autoscaler_tpu/utils/hashing.fold32
+	// (string -> fnv1a32 folded to signed-int32 avoiding 0)
+	cases := map[string]int32{}
+	raw, err := os.ReadFile(filepath.Join("testdata", "fold32_cases.json"))
+	if err != nil {
+		t.Skip("fold32 fixture not exported")
+	}
+	if err := json.Unmarshal(raw, &cases); err != nil {
+		t.Fatal(err)
+	}
+	for s, want := range cases {
+		if got := Fold32(s); got != want {
+			t.Fatalf("Fold32(%q) = %d, want %d", s, got, want)
+		}
+	}
+}

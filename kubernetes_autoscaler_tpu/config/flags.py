@@ -182,6 +182,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "only deltas (reference rationale: DeltaSnapshotStore)")
     p.add_argument("--incremental-resync-loops", type=int, default=240,
                    help="compacting full re-encode every N loops (0 = never)")
+    p.add_argument("--incremental-verify-loops", type=int, default=0,
+                   help="semantically verify the incremental tensors against "
+                        "a fresh encode every N loops; mismatch forces a "
+                        "resync and raises an error metric (0 = off)")
 
     # runner (standalone mode)
     p.add_argument("--scenario", default="",
@@ -295,6 +299,7 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         async_node_deletion=args.async_node_deletion,
         incremental_encode=args.incremental_encode,
         incremental_resync_loops=args.incremental_resync_loops,
+        incremental_verify_loops=args.incremental_verify_loops,
     )
 
 

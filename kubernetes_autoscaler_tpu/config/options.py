@@ -170,3 +170,9 @@ class AutoscalingOptions:
     # force a compacting full re-encode every N loops (0 = never); bounds
     # ghost-row growth from long-running node/equivalence churn
     incremental_resync_loops: int = 240
+    # every N loops, semantically diff the incrementally-maintained tensors
+    # against a fresh encode; a mismatch (= a source violating the replace-
+    # on-update contract, e.g. in-place dict mutation) forces a resync and
+    # raises the incremental_verify_failures_total metric instead of
+    # producing silently stale verdicts. 0 = off (production default)
+    incremental_verify_loops: int = 0

@@ -390,11 +390,17 @@ class StaticAutoscaler:
                             group_bucket=self.options.group_shape_bucket,
                             drain_opts=drain_opts,
                             resync_loops=self.options.incremental_resync_loops,
+                            verify_loops=self.options.incremental_verify_loops,
                         )
+                    fails_before = self._encoder.verify_failures
                     enc = self._encoder.encode(
                         nodes, pods, node_group_ids=node_group_ids,
                         now=now, pdb_namespaced_names=frozenset(pdb_names),
                         namespaces=ns_labels)
+                    if self._encoder.verify_failures > fails_before:
+                        self.metrics.counter(
+                            "incremental_verify_failures_total").inc(
+                            self._encoder.verify_failures - fails_before)
                 else:
                     enc = encode_cluster(
                         nodes, pods,

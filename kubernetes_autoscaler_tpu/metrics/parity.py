@@ -56,11 +56,59 @@ EMITTED = {
 }
 
 NA = {
-    "bulk_mig_instances_listing_enabled": "GCE-SDK specific",
     "dra_node_template_resources_mismatch": "DRA lowering rebuilds templates each loop; there is no cached template to drift",
     "inconsistent_instances_migs_count": "GCE-SDK specific",
     "max_node_skip_eval_duration_seconds": "no per-node eval-skip heuristic: the device sweep is exhaustive",
     "overflowing_controllers_count": "pod-injection caps per workload, not per controller cache",
+}
+
+# The COMPLETE series list of metrics/metrics.go (every `Name:` field,
+# :202-443) — the meta-test (tests/test_metrics_parity.py) asserts
+# EMITTED ∪ NA covers it exactly, mirroring the flag registry's honesty
+# contract: a series added upstream must be classified here before the
+# parity claim holds again.
+REFERENCE_SERIES = {
+    "binpacking_heterogeneity",
+    "cluster_cpu_current_cores",
+    "cluster_memory_current_bytes",
+    "cluster_safe_to_autoscale",
+    "cpu_limits_cores",
+    "created_node_groups_total",
+    "deleted_node_groups_total",
+    "dra_node_template_resources_mismatch",
+    "errors_total",
+    "evicted_pods_total",
+    "failed_gpu_scale_ups_total",
+    "failed_node_creations_total",
+    "failed_scale_ups_total",
+    "function_duration_quantile_seconds",
+    "function_duration_seconds",
+    "inconsistent_instances_migs_count",
+    "last_activity",
+    "max_node_skip_eval_duration_seconds",
+    "max_nodes_count",
+    "memory_limits_bytes",
+    "node_group_backoff_status",
+    "node_group_healthiness",
+    "node_group_max_count",
+    "node_group_min_count",
+    "node_group_target_count",
+    "node_groups_count",
+    "node_removal_latency_seconds",
+    "node_taints_count",
+    "nodes_count",
+    "old_unregistered_nodes_removed_count",
+    "overflowing_controllers_count",
+    "pending_node_deletions",
+    "scale_down_in_cooldown",
+    "scaled_down_gpu_nodes_total",
+    "scaled_down_nodes_total",
+    "scaled_up_gpu_nodes_total",
+    "scaled_up_nodes_total",
+    "skipped_scale_events_count",
+    "unneeded_nodes_count",
+    "unremovable_nodes_count",
+    "unschedulable_pods_count",
 }
 
 

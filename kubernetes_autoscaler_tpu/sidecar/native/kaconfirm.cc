@@ -7,14 +7,7 @@
 // would be too slow"). Python/numpy does this pass in seconds at 5k nodes /
 // 50k pods; this kernel does the identical algorithm in milliseconds.
 //
-// Semantics (mirrors core/scaledown/planner.py attempt(), fast-path subset —
-// no exact-oracle groups, no one-per-node groups, no atomic groups; the
-// Python loop remains the fallback for those. PDB budgets ARE handled:
-// up to 64 PodDisruptionBudgets ride as a per-slot membership bitmask +
-// a remaining-budget vector, gating candidates over their ORIGINAL
-// resident slots exactly as the Python pass's can_remove_pods +
-// accumulated reservation do — round-3 review Weak #3/#6, the all-PDB
-// cluster previously fell back to the seconds-long Python pass):
+// Semantics (mirrors core/scaledown/planner.py attempt()):
 //   * candidates processed in the given order (oldest unneeded clock first)
 //   * per candidate: its victim slots (original residents + pods RECEIVED
 //     from earlier accepted drains) re-place group-by-group, first feasible
@@ -22,11 +15,26 @@
 //   * all-or-nothing: failure reverts the candidate's placements
 //   * group min-size room, empty/drain/total budgets, and min-quota gates
 //     applied exactly as the Python pass does
+//   * ANY number of PodDisruptionBudgets ride as a per-slot MULTI-WORD
+//     membership bitmask ([pdb_words] u64 per slot; round-4 review Weak #3
+//     lifted the old single-word 64-budget cap)
+//   * CONSTRAINED TIER (round-4 verdict item 4 — the all-constrained confirm
+//     took ~37 s host-side at 5k nodes / 50k pods): zone-scope topology
+//     spread and host/zone-scope required anti-affinity evaluate natively
+//     against incrementally-maintained count planes, mirroring the Python
+//     pass's ConfirmOracle verdicts (utils/oracle.py spread_ok /
+//     anti_affinity_ok): domain counts over ELIGIBLE nodes, global minimum
+//     over eligible domains, self-match term, per-pod re-evaluation as
+//     counts shift. Groups needing more (host-spread, pod affinity, lossy
+//     encodings, min_domains/policies, host ports) stay on the Python pass —
+//     the planner's gate routes them there.
 //
 // Build: part of libkacodec.so (see ../Makefile).
 
 #include <algorithm>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <vector>
 
@@ -38,6 +46,117 @@ struct Move {
   int group;
 };
 
+// Constrained-tier state: per-group count planes + zone aggregates.
+// Aggregation convention follows the Python oracle: spread counts aggregate
+// over ELIGIBLE nodes only and zones are domains only while they still hold
+// at least one eligible node; anti counts aggregate over all nodes.
+struct ConState {
+  int n = 0, g = 0, nz = 0;
+  const int32_t* zone_id = nullptr;       // [n]; 0 = no zone
+  const uint8_t* spread_kind = nullptr;   // [g]; 0 none, 2 zone
+  const int32_t* max_skew = nullptr;      // [g]
+  const uint8_t* spread_self = nullptr;   // [g]
+  const uint8_t* has_anti_host = nullptr; // [g]
+  const uint8_t* has_anti_zone = nullptr; // [g]
+  const uint8_t* elig = nullptr;          // [g*n] spread domain eligibility
+  int32_t* cnt_node = nullptr;            // [g*n] spread matches per node
+  int32_t* anti_host_node = nullptr;      // [g*n]
+  int32_t* anti_zone_node = nullptr;      // [g*n]
+  const uint8_t* m_spread = nullptr;      // [g*g]: pod of b counts for a
+  const uint8_t* m_anti_h = nullptr;      // [g*g]
+  const uint8_t* m_anti_z = nullptr;      // [g*g]
+  const uint8_t* con_path = nullptr;      // [g] group places via this tier
+  std::vector<int64_t> cnt_zone, anti_zone, elig_zone;  // [g*nz]
+  std::vector<int> con_groups;            // groups with any constraint rows
+
+  bool active() const { return zone_id != nullptr; }
+
+  void init() {
+    cnt_zone.assign((size_t)g * nz, 0);
+    anti_zone.assign((size_t)g * nz, 0);
+    elig_zone.assign((size_t)g * nz, 0);
+    for (int a = 0; a < g; ++a) {
+      const bool any = spread_kind[a] == 2 || has_anti_host[a] ||
+                       has_anti_zone[a];
+      if (any) con_groups.push_back(a);
+      for (int i = 0; i < n; ++i) {
+        const int z = zone_id[i];
+        if (z <= 0 || z >= nz) continue;
+        if (elig[(size_t)a * n + i]) {
+          elig_zone[(size_t)a * nz + z] += 1;
+          cnt_zone[(size_t)a * nz + z] += cnt_node[(size_t)a * n + i];
+        }
+        anti_zone[(size_t)a * nz + z] += anti_zone_node[(size_t)a * n + i];
+      }
+    }
+  }
+
+  // one pod of group b lands on (+1) / leaves (-1) node i, `count` at a time
+  void apply(int b, int i, int sign, int count = 1) {
+    const int z = zone_id[i];
+    for (int a : con_groups) {
+      const size_t an = (size_t)a * n + i;
+      if (m_spread[(size_t)a * g + b]) {
+        cnt_node[an] += sign * count;
+        if (z > 0 && z < nz && elig[an])
+          cnt_zone[(size_t)a * nz + z] += sign * count;
+      }
+      if (m_anti_h[(size_t)a * g + b]) anti_host_node[an] += sign * count;
+      if (m_anti_z[(size_t)a * g + b]) {
+        anti_zone_node[an] += sign * count;
+        if (z > 0 && z < nz) anti_zone[(size_t)a * nz + z] += sign * count;
+      }
+    }
+  }
+
+  // can one pod of group a land on node i right now?
+  bool ok(int a, int i) const {
+    const int z = zone_id[i];
+    if (has_anti_host[a] && anti_host_node[(size_t)a * n + i] > 0)
+      return false;
+    if (has_anti_zone[a] && z > 0 && z < nz &&
+        anti_zone[(size_t)a * nz + z] > 0)
+      return false;
+    if (spread_kind[a] == 2) {
+      if (z <= 0 || z >= nz) return false;  // no key -> cannot satisfy
+      int64_t minc = INT64_MAX;
+      bool any = false;
+      for (int zz = 1; zz < nz; ++zz) {
+        if (elig_zone[(size_t)a * nz + zz] > 0) {
+          any = true;
+          const int64_t cc = cnt_zone[(size_t)a * nz + zz];
+          if (cc < minc) minc = cc;
+        }
+      }
+      if (!any) minc = 0;
+      const int64_t here =
+          elig_zone[(size_t)a * nz + z] > 0 ? cnt_zone[(size_t)a * nz + z] : 0;
+      if (here + (spread_self[a] ? 1 : 0) - minc > max_skew[a]) return false;
+    }
+    return true;
+  }
+
+  // candidate node removed from the world: residual (non-moved) pods vanish
+  // with it and it stops being an eligible domain member (the Python pass's
+  // oracle remove_node)
+  void remove_node(int i) {
+    const int z = zone_id[i];
+    for (int a : con_groups) {
+      const size_t an = (size_t)a * n + i;
+      if (z > 0 && z < nz) {
+        if (elig[an]) {
+          cnt_zone[(size_t)a * nz + z] -= cnt_node[an];
+          elig_zone[(size_t)a * nz + z] -= 1;
+        }
+        anti_zone[(size_t)a * nz + z] -= anti_zone_node[an];
+      }
+      cnt_node[an] = 0;
+      anti_zone_node[an] = 0;
+      anti_host_node[an] = 0;
+    }
+  }
+};
+
 }  // namespace
 
 extern "C" {
@@ -45,7 +164,9 @@ extern "C" {
 // Returns the number of accepted candidates, or -1 on bad arguments.
 // reason_out: 0 accepted, 1 no-place, 2 group-room, 3 quota, 4 budget-skip,
 //             5 pdb-budget.
-int ka_confirm(
+// The con_* block is the constrained tier; pass con_zone_id = null to
+// disable it (plain capacity-first-fit semantics).
+int ka_confirm_c(
     int n, int r, int g,
     int64_t* free_io,            // [n*r] free capacity, mutated in place
     const uint8_t* feas,         // [g*n] predicate plane (pre-capacity)
@@ -63,18 +184,69 @@ int ka_confirm(
     const int64_t* quota_min,    // [r] min limits (or null)
     const int64_t* node_cap,     // [n*r] per-node capacity (for quota deduct)
     int empty_budget, int drain_budget, int total_budget,
-    int n_pdbs,                  // 0..64 (0 = no PDB gating)
-    const uint64_t* slot_pdb,    // [max_slot_id+1] membership bitmask, or null
+    int n_pdbs,                  // >= 0 (0 = no PDB gating)
+    int pdb_words,               // words per slot = ceil(n_pdbs / 64)
+    const uint64_t* slot_pdb,    // [(max_slot_id+1) * pdb_words] bitmask rows
     int64_t* pdb_remaining,      // [n_pdbs] budgets, deducted on accept
+    // ---- constrained tier (all null/0 to disable) ----
+    int n_zones,
+    const int32_t* con_zone_id,
+    const uint8_t* con_spread_kind,
+    const int32_t* con_max_skew,
+    const uint8_t* con_spread_self,
+    const uint8_t* con_has_anti_host,
+    const uint8_t* con_has_anti_zone,
+    const uint8_t* con_elig,
+    int32_t* con_cnt_node,
+    int32_t* con_anti_host_node,
+    int32_t* con_anti_zone_node,
+    const uint8_t* con_m_spread,
+    const uint8_t* con_m_anti_h,
+    const uint8_t* con_m_anti_z,
+    const uint8_t* con_path_flag,  // [g] group routes through the tier
+    // ---- outputs ----
     uint8_t* accept_out,         // [n_cand]
     uint8_t* reason_out,         // [n_cand]
     int32_t* dest_out)           // slot id -> destination (indexed by slot id;
                                  // caller sizes it max_slot_id+1, fills -1)
 {
   if (n <= 0 || r <= 0 || g <= 0 || n_cand < 0) return -1;
-  if (n_pdbs < 0 || n_pdbs > 64) return -1;
-  if (n_pdbs > 0 && (slot_pdb == nullptr || pdb_remaining == nullptr))
+  if (n_pdbs < 0) return -1;
+  if (n_pdbs > 0 && (slot_pdb == nullptr || pdb_remaining == nullptr ||
+                     pdb_words != (n_pdbs + 63) / 64))
     return -1;
+  ConState con;
+  if (con_zone_id != nullptr) {
+    if (n_zones <= 0 || con_spread_kind == nullptr ||
+        con_max_skew == nullptr || con_spread_self == nullptr ||
+        con_has_anti_host == nullptr || con_has_anti_zone == nullptr ||
+        con_elig == nullptr || con_cnt_node == nullptr ||
+        con_anti_host_node == nullptr || con_anti_zone_node == nullptr ||
+        con_m_spread == nullptr || con_m_anti_h == nullptr ||
+        con_m_anti_z == nullptr || con_path_flag == nullptr)
+      return -1;
+    con.n = n;
+    con.g = g;
+    con.nz = n_zones;
+    con.zone_id = con_zone_id;
+    con.spread_kind = con_spread_kind;
+    con.max_skew = con_max_skew;
+    con.spread_self = con_spread_self;
+    con.has_anti_host = con_has_anti_host;
+    con.has_anti_zone = con_has_anti_zone;
+    con.elig = con_elig;
+    con.cnt_node = con_cnt_node;
+    con.anti_host_node = con_anti_host_node;
+    con.anti_zone_node = con_anti_zone_node;
+    con.m_spread = con_m_spread;
+    con.m_anti_h = con_m_anti_h;
+    con.m_anti_z = con_m_anti_z;
+    con.con_path = con_path_flag;
+    con.init();
+  }
+  // KA_CONFIRM_TRACE=1: per-placement records on stderr, for diffing the
+  // native pass against the Python pass when chasing plan-equality bugs
+  static const bool trace = std::getenv("KA_CONFIRM_TRACE") != nullptr;
   std::vector<uint8_t> deleted(n, 0);
   // pods moved ONTO a node (re-placed again if that node later drains)
   std::vector<std::vector<Move>> received(n);
@@ -113,7 +285,6 @@ int ka_confirm(
     std::vector<Move> victims;
     for (int s = slot_off[c]; s < slot_off[c + 1]; ++s)
       victims.push_back({slot_ids[s], -1, slot_group[s]});
-    const size_t n_orig = victims.size();
     for (const Move& m : received[cand]) victims.push_back(m);
     const bool is_empty = victims.empty();
     if (is_empty) {
@@ -124,15 +295,17 @@ int ka_confirm(
 
     // PDB gate over the ORIGINAL resident slots only (received pods were
     // accounted when their own node was confirmed — planner.py comment)
-    int64_t pdb_need[64];
+    std::vector<int64_t> pdb_need(n_pdbs, 0);
     if (n_pdbs > 0) {
-      for (int p = 0; p < n_pdbs; ++p) pdb_need[p] = 0;
       for (int s = slot_off[c]; s < slot_off[c + 1]; ++s) {
-        uint64_t mask = slot_pdb[slot_ids[s]];
-        while (mask) {
-          int p = __builtin_ctzll(mask);
-          mask &= mask - 1;
-          ++pdb_need[p];
+        const uint64_t* row = slot_pdb + (int64_t)slot_ids[s] * pdb_words;
+        for (int w = 0; w < pdb_words; ++w) {
+          uint64_t mask = row[w];
+          while (mask) {
+            int p = (w << 6) + __builtin_ctzll(mask);
+            mask &= mask - 1;
+            ++pdb_need[p];
+          }
         }
       }
       bool pdb_ok = true;
@@ -154,6 +327,9 @@ int ka_confirm(
                      [](const Move& a, const Move& b) { return a.group < b.group; });
     std::vector<Move> placed;
     placed.reserve(victims.size());
+    // constrained-tier pods whose contribution left `cand` but found no
+    // destination yet (revert must re-add them)
+    int out_unplaced_group = -1;
     bool ok = true;
     size_t v = 0;
     while (v < victims.size() && ok) {
@@ -163,6 +339,49 @@ int ka_confirm(
       int want = (int)(v_end - v);
       const int32_t* req = greq + (int64_t)gg * r;
       const uint8_t* fg = feas + (int64_t)gg * n;
+      const bool con_gg = con.active() && con.con_path[gg];
+
+      if (con_gg) {
+        // per-pod path, mirroring the Python exact path: move the pod's
+        // contribution off the candidate, then scan destinations re-checking
+        // the constraint as counts shift
+        for (int t = 0; t < want && ok; ++t) {
+          con.apply(gg, cand, -1);
+          int d_found = -1;
+          for (int node = 0; node < n; ++node) {
+            if (node == cand || deleted[node] || !node_valid[node] ||
+                !fg[node])
+              continue;
+            int64_t* fr = free_io + (int64_t)node * r;
+            bool fits = true;
+            for (int k = 0; k < r; ++k) {
+              if (req[k] > 0 && fr[k] < req[k]) {
+                fits = false;
+                break;
+              }
+            }
+            if (!fits) continue;
+            if (!con.ok(gg, node)) continue;
+            d_found = node;
+            break;
+          }
+          if (d_found < 0) {
+            ok = false;
+            out_unplaced_group = gg;
+            break;
+          }
+          int64_t* fr = free_io + (int64_t)d_found * r;
+          for (int k = 0; k < r; ++k) fr[k] -= req[k];
+          con.apply(gg, d_found, +1);
+          if (trace)
+            fprintf(stderr, "[kaconfirm] cand=%d con slot=%d g=%d -> %d\n",
+                    cand, victims[v + t].slot, gg, d_found);
+          placed.push_back({victims[v + t].slot, d_found, gg});
+        }
+        v = v_end;
+        continue;
+      }
+
       int node = hint[gg];
       bool advancing_frontier = true;
       while (want > 0 && node < n) {
@@ -194,6 +413,9 @@ int ka_confirm(
         advancing_frontier = false;
         int take = (int)(fits < want ? fits : want);
         for (int t = 0; t < take; ++t) {
+          if (trace)
+            fprintf(stderr, "[kaconfirm] cand=%d blk slot=%d g=%d -> %d\n",
+                    cand, victims[v + (v_end - v - want) + t].slot, gg, node);
           placed.push_back({victims[v + (v_end - v - want) + t].slot, node, gg});
         }
         for (int k = 0; k < r; ++k) fr[k] -= (int64_t)req[k] * take;
@@ -205,13 +427,19 @@ int ka_confirm(
     }
 
     if (!ok) {
+      if (trace) fprintf(stderr, "[kaconfirm] cand=%d REVERT\n", cand);
       int min_reverted = n;
       for (const Move& m : placed) {
         const int32_t* req = greq + (int64_t)m.group * r;
         int64_t* fr = free_io + (int64_t)m.node * r;
         for (int k = 0; k < r; ++k) fr[k] += req[k];
         if (m.node < min_reverted) min_reverted = m.node;
+        if (con.active() && con.con_path[m.group]) {
+          con.apply(m.group, m.node, -1);
+          con.apply(m.group, cand, +1);
+        }
       }
+      if (out_unplaced_group >= 0) con.apply(out_unplaced_group, cand, +1);
       // Restoring capacity can re-open a node that ANOTHER group's frontier
       // already skipped as full while this candidate was being placed, so
       // every group's hint must rewind to the earliest reverted destination —
@@ -231,6 +459,7 @@ int ka_confirm(
     if (n_pdbs > 0)
       for (int p = 0; p < n_pdbs; ++p) pdb_remaining[p] -= pdb_need[p];
     deleted[cand] = 1;
+    if (con.active()) con.remove_node(cand);
     group_room[gi_room] -= 1;
     if (is_empty) --empty_budget; else --drain_budget;
     if (quota_totals) {
@@ -242,7 +471,6 @@ int ka_confirm(
       dest_out[m.slot] = m.node;
       received[m.node].push_back(m);
     }
-    (void)n_orig;
   }
   return accepted;
 }

@@ -84,3 +84,13 @@ def test_per_nodegroup_series_carry_group_label():
     text = default_registry.expose_text()
     assert 'cluster_autoscaler_node_group_target_count{node_group="ng1"}' in text
     assert 'cluster_autoscaler_node_group_max_count{node_group="ng-gpu"}' in text
+
+
+def test_reference_series_fully_classified():
+    """Honesty meta-test (r4 verdict Missing #4): every series the reference
+    registers (metrics/metrics.go `Name:` fields) is either EMITTED or
+    registry-rejected with a reason — and nothing else is claimed."""
+    classified = parity.EMITTED | set(parity.NA)
+    assert classified == parity.REFERENCE_SERIES, (
+        f"unclassified: {parity.REFERENCE_SERIES - classified}; "
+        f"phantom: {classified - parity.REFERENCE_SERIES}")
