@@ -133,7 +133,12 @@ def confirm(
         sp_arr = np.ascontiguousarray(slot_pdb_mask, np.uint64)
         if sp_arr.ndim == 1:       # single-word legacy layout
             sp_arr = sp_arr[:, None]
-        assert sp_arr.shape[1] == pdb_words
+        if sp_arr.shape[1] != pdb_words:
+            # a mis-strided mask would read out-of-bounds rows natively —
+            # fail fast even under python -O
+            raise ValueError(
+                f"slot_pdb_mask has {sp_arr.shape[1]} words, "
+                f"{pdb_words} needed for {n_pdbs} budgets")
     sp = (sp_arr.ctypes.data_as(ctypes.c_void_p)
           if n_pdbs > 0 else None)
     pr = (pdb_remaining.ctypes.data_as(ctypes.c_void_p)

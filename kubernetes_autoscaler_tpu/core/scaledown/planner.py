@@ -432,7 +432,8 @@ class Planner:
                              name_to_i, node_gid, seen_groups, defaults,
                              ds_by_node, feas, node_valid, greq, pod_slot,
                              movable_f, group_ref, now, pdbs=(),
-                             con_needed=False, need_exact=None, limit_g=None):
+                             con_needed=False, need_exact=None, limit_g=None,
+                             moved_groups=None):
         """Marshal the pre-screened candidate list into the C++ pass. PDB
         budgets ride as a per-slot multi-word membership bitmask (any
         count) — the all-PDB cluster stays on the millisecond native path."""
@@ -443,10 +444,8 @@ class Planner:
             # route exactly the groups the Python pass would run through the
             # oracle (need_exact | limit_g) through the native per-pod tier
             con_path = (need_exact | limit_g)
-            moved = np.unique(group_ref[
-                _hostarr(enc, "scheduled.valid", enc.scheduled.valid)
-                & movable_f])
-            con = self._build_constraint_block(enc, feas, con_path, moved)
+            con = self._build_constraint_block(enc, feas, con_path,
+                                               moved_groups)
             if con is None:
                 return None      # beyond the tier — python pass decides
 
@@ -765,7 +764,8 @@ class Planner:
                     node_gid, seen_groups, defaults, ds_by_node,
                     feas, node_valid, greq, pod_slot, movable_f, group_ref,
                     now, pdbs, con_needed=con_needed,
-                    need_exact=need_exact, limit_g=limit_g)
+                    need_exact=need_exact, limit_g=limit_g,
+                    moved_groups=moved_groups)
                 if out is not None:
                     return out
 

@@ -253,6 +253,9 @@ int ka_confirm_c(
   // first-fit frontier hint per group: nodes before the hint are known full
   // for that group's request (capacity only shrinks; reverts rewind the hint)
   std::vector<int> hint(g, 0);
+  // per-candidate scratch, hoisted out of the hot loop (no per-candidate
+  // heap traffic)
+  std::vector<int64_t> pdb_need(n_pdbs > 0 ? n_pdbs : 0);
   int accepted = 0;
 
   for (int c = 0; c < n_cand; ++c) {
@@ -295,8 +298,8 @@ int ka_confirm_c(
 
     // PDB gate over the ORIGINAL resident slots only (received pods were
     // accounted when their own node was confirmed — planner.py comment)
-    std::vector<int64_t> pdb_need(n_pdbs, 0);
     if (n_pdbs > 0) {
+      std::fill(pdb_need.begin(), pdb_need.end(), 0);
       for (int s = slot_off[c]; s < slot_off[c + 1]; ++s) {
         const uint64_t* row = slot_pdb + (int64_t)slot_ids[s] * pdb_words;
         for (int w = 0; w < pdb_words; ++w) {

@@ -268,6 +268,67 @@ def test_phantom_rehomes_when_capacity_allows():
     assert "node-a" in [r.node.name for r in out]
 
 
+def test_async_drain_loops_with_incremental_encoder():
+    """Multi-loop integration: --async-node-deletion + incremental encoding.
+    The drained:: pending copies must keep stable identity across loops (no
+    resync storm from the renamed injections) and the loop must stay
+    coherent while a drain is parked mid-flight."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    victim = build_test_node("victim", cpu_milli=4000, mem_mib=8192)
+    other = build_test_node("other", cpu_milli=4000, mem_mib=8192)
+    fake.add_existing_node("ng1", victim)
+    fake.add_existing_node("ng1", other)
+    pod = build_test_pod("app-0", cpu_milli=1000, mem_mib=256,
+                         node_name="victim")
+    pod.phase = "Running"
+    fake.add_pod(pod)
+    filler = build_test_pod("busy-0", cpu_milli=3000, mem_mib=256,
+                            node_name="other")
+    filler.phase = "Running"
+    fake.add_pod(filler)
+
+    release = threading.Event()
+
+    class _BlockingSink:
+        def evict(self, p, nd, grace_period_s=None):
+            if not release.wait(30.0):
+                raise RuntimeError("test timeout")
+            fake.evict(p, nd, grace_period_s)
+
+    opts = AutoscalingOptions(node_group_defaults=NodeGroupDefaults(),
+                              async_node_deletion=True,
+                              incremental_encode=True,
+                              incremental_verify_loops=1,
+                              max_inactivity_s=1e9, max_failing_time_s=1e9)
+    a = StaticAutoscaler(fake.provider, fake, options=opts,
+                         eviction_sink=_BlockingSink())
+    a.actuator.start_deletion(
+        [NodeToRemove(victim, False, pods_to_move=[0])], {0: pod},
+        now=time.time(), detach=True)
+    try:
+        for k in range(4):
+            a.run_once(now=time.time() + k)
+        enc = a._encoder
+        # the injected drained:: copy is identity-stable -> after the seed
+        # loop, no forced full re-encodes and no verify failures
+        assert enc.full_encodes == 1, enc.full_encodes
+        assert enc.verify_failures == 0, enc.last_verify_error
+        # demand for the drained pod is visible in the maintained snapshot
+        assert any(r.pod.name == "drained::app-0"
+                   for r in enc._pods.values())
+    finally:
+        release.set()
+    # drain completes; next loop books it and the copies disappear
+    deadline = time.time() + 10.0
+    while a.actuator.tracker.in_flight() and time.time() < deadline:
+        time.sleep(0.05)
+    st = a.run_once(now=time.time() + 10)
+    assert "victim" not in fake.nodes
+    assert st.ran
+
+
 # ---------- the recreated filter (static_autoscaler side) ----------
 
 
