@@ -344,7 +344,7 @@ class Planner:
                 exemplars.setdefault(row, enc.pending_pods[idxs[0]])
 
         sk = _hostarr(enc, "specs.spread_kind", enc.specs.spread_kind)
-        spread_kind = (sk == 2).astype(np.uint8) * 2
+        spread_kind = np.where((sk == 1) | (sk == 2), sk, 0).astype(np.uint8)
         max_skew = _hostarr(enc, "specs.max_skew",
                             enc.specs.max_skew).astype(np.int32)
         spread_self = _hostarr(enc, "specs.spread_self",
@@ -726,11 +726,11 @@ class Planner:
 
         # NATIVE FAST PATH (sidecar/native/kaconfirm.cc): the identical
         # sequential pass in C++ for the common case AND the constrained
-        # tier — zone topology spread + host/zone required anti-affinity ride
-        # as incrementally-maintained count planes (round-4 verdict item 4:
-        # the all-constrained confirm was ~37 s host-side at 5k nodes / 50k
-        # pods; native is milliseconds). Still python: pod affinity, host
-        # spread, lossy encodings, host ports, atomic groups, phantoms.
+        # tier — zone/host topology spread + host/zone required anti-affinity
+        # ride as incrementally-maintained count planes (round-4 verdict item
+        # 4: the all-constrained confirm was ~37 s host-side at 5k nodes /
+        # 50k pods; native is milliseconds). Still python: pod affinity,
+        # lossy encodings, host ports, atomic groups, phantoms.
         # tests/test_native_confirm.py proves plan-equality vs the Python
         # pass below.
         pdbs = self.pdb_tracker.get_pdbs() if self.pdb_tracker else []
@@ -750,8 +750,9 @@ class Planner:
                 else:
                     sk = np.zeros(hostcheck.shape, np.int32)
                     ak = np.zeros(hostcheck.shape, np.int32)
-                native_ok_g = (~hostcheck & ~port_g
-                               & ((sk == 0) | (sk == 2)) & (ak == 0))
+                # spread kinds 0/1/2 all native now (host kind rides the
+                # count histogram); pod affinity stays python
+                native_ok_g = (~hostcheck & ~port_g & (ak == 0))
                 eligible = bool(native_ok_g[moved_groups].all())
                 con_needed = bool(need_exact[moved_groups].any()
                                   or limit_g[moved_groups].any())

@@ -19,13 +19,15 @@
 //     membership bitmask ([pdb_words] u64 per slot; round-4 review Weak #3
 //     lifted the old single-word 64-budget cap)
 //   * CONSTRAINED TIER (round-4 verdict item 4 — the all-constrained confirm
-//     took ~37 s host-side at 5k nodes / 50k pods): zone-scope topology
-//     spread and host/zone-scope required anti-affinity evaluate natively
+//     took ~37 s host-side at 5k nodes / 50k pods): zone- and host-scope
+//     topology spread and host/zone-scope required anti-affinity evaluate natively
 //     against incrementally-maintained count planes, mirroring the Python
 //     pass's ConfirmOracle verdicts (utils/oracle.py spread_ok /
 //     anti_affinity_ok): domain counts over ELIGIBLE nodes, global minimum
 //     over eligible domains, self-match term, per-pod re-evaluation as
-//     counts shift. Groups needing more (host-spread, pod affinity, lossy
+//     counts shift; host-kind spread maintains its global minimum O(1)
+//     through a per-group count histogram over eligible nodes. Groups
+//     needing more (pod affinity, lossy
 //     encodings, min_domains/policies, host ports) stay on the Python pass —
 //     the planner's gate routes them there.
 //
@@ -53,7 +55,7 @@ struct Move {
 struct ConState {
   int n = 0, g = 0, nz = 0;
   const int32_t* zone_id = nullptr;       // [n]; 0 = no zone
-  const uint8_t* spread_kind = nullptr;   // [g]; 0 none, 2 zone
+  const uint8_t* spread_kind = nullptr;   // [g]; 0 none, 1 host, 2 zone
   const int32_t* max_skew = nullptr;      // [g]
   const uint8_t* spread_self = nullptr;   // [g]
   const uint8_t* has_anti_host = nullptr; // [g]
@@ -68,6 +70,34 @@ struct ConState {
   const uint8_t* con_path = nullptr;      // [g] group places via this tier
   std::vector<int64_t> cnt_zone, anti_zone, elig_zone;  // [g*nz]
   std::vector<int> con_groups;            // groups with any constraint rows
+  // host-kind spread (kind 1): every ELIGIBLE node is a domain; the global
+  // minimum is maintained O(1) via a per-group count histogram over
+  // eligible nodes (counts clamp at kHistMax; a min that large means the
+  // skew check can never bind for realistic max_skew values)
+  static constexpr int kHistMax = 1023;
+  // packed: one (kHistMax+1)-bucket row PER HOST-SPREAD GROUP only (zero
+  // allocation when no group has kind 1)
+  std::vector<int64_t> hist;
+  std::vector<int> hist_row;              // [g] packed row index or -1
+  std::vector<int> hist_min;              // [g] current minimum count
+  std::vector<int64_t> elig_alive;        // [g] eligible nodes still alive
+
+  static int clampc(int64_t c) {
+    return c < 0 ? 0 : (c > kHistMax ? kHistMax : (int)c);
+  }
+
+  void hist_move(int a, int from, int to) {
+    int64_t* h = hist.data() + (size_t)hist_row[a] * (kHistMax + 1);
+    h[clampc(from)] -= 1;
+    h[clampc(to)] += 1;
+    if (to < hist_min[a]) {
+      hist_min[a] = clampc(to);
+    } else if (from == hist_min[a] && h[clampc(from)] == 0) {
+      int m = hist_min[a];
+      while (m <= kHistMax && h[m] == 0) ++m;
+      hist_min[a] = m > kHistMax ? 0 : m;  // no eligible nodes left -> min 0
+    }
+  }
 
   bool active() const { return zone_id != nullptr; }
 
@@ -75,19 +105,38 @@ struct ConState {
     cnt_zone.assign((size_t)g * nz, 0);
     anti_zone.assign((size_t)g * nz, 0);
     elig_zone.assign((size_t)g * nz, 0);
+    hist_row.assign(g, -1);
+    hist_min.assign(g, 0);
+    elig_alive.assign(g, 0);
+    int n_host = 0;
+    for (int a = 0; a < g; ++a)
+      if (spread_kind[a] == 1) hist_row[a] = n_host++;
+    hist.assign((size_t)n_host * (kHistMax + 1), 0);
     for (int a = 0; a < g; ++a) {
-      const bool any = spread_kind[a] == 2 || has_anti_host[a] ||
+      const bool any = spread_kind[a] != 0 || has_anti_host[a] ||
                        has_anti_zone[a];
       if (any) con_groups.push_back(a);
+      const bool host_spread = spread_kind[a] == 1;
+      int64_t* h = host_spread
+          ? hist.data() + (size_t)hist_row[a] * (kHistMax + 1) : nullptr;
+      int mn = kHistMax + 1;
       for (int i = 0; i < n; ++i) {
+        const bool el = elig[(size_t)a * n + i];
+        if (host_spread && el) {
+          const int c = clampc(cnt_node[(size_t)a * n + i]);
+          h[c] += 1;
+          elig_alive[a] += 1;
+          if (c < mn) mn = c;
+        }
         const int z = zone_id[i];
         if (z <= 0 || z >= nz) continue;
-        if (elig[(size_t)a * n + i]) {
+        if (el) {
           elig_zone[(size_t)a * nz + z] += 1;
           cnt_zone[(size_t)a * nz + z] += cnt_node[(size_t)a * n + i];
         }
         anti_zone[(size_t)a * nz + z] += anti_zone_node[(size_t)a * n + i];
       }
+      hist_min[a] = mn > kHistMax ? 0 : mn;
     }
   }
 
@@ -97,9 +146,12 @@ struct ConState {
     for (int a : con_groups) {
       const size_t an = (size_t)a * n + i;
       if (m_spread[(size_t)a * g + b]) {
+        const int64_t before = cnt_node[an];
         cnt_node[an] += sign * count;
         if (z > 0 && z < nz && elig[an])
           cnt_zone[(size_t)a * nz + z] += sign * count;
+        if (spread_kind[a] == 1 && elig[an])
+          hist_move(a, (int)before, (int)cnt_node[an]);
       }
       if (m_anti_h[(size_t)a * g + b]) anti_host_node[an] += sign * count;
       if (m_anti_z[(size_t)a * g + b]) {
@@ -117,6 +169,13 @@ struct ConState {
     if (has_anti_zone[a] && z > 0 && z < nz &&
         anti_zone[(size_t)a * nz + z] > 0)
       return false;
+    if (spread_kind[a] == 1) {
+      // every eligible alive node is a domain; min over them is hist_min
+      const int64_t minc = elig_alive[a] > 0 ? hist_min[a] : 0;
+      const int64_t here =
+          elig[(size_t)a * n + i] ? cnt_node[(size_t)a * n + i] : 0;
+      if (here + (spread_self[a] ? 1 : 0) - minc > max_skew[a]) return false;
+    }
     if (spread_kind[a] == 2) {
       if (z <= 0 || z >= nz) return false;  // no key -> cannot satisfy
       int64_t minc = INT64_MAX;
@@ -143,6 +202,19 @@ struct ConState {
     const int z = zone_id[i];
     for (int a : con_groups) {
       const size_t an = (size_t)a * n + i;
+      if (spread_kind[a] == 1 && elig[an]) {
+        // the node stops being a domain: drop its histogram bucket and
+        // recompute the min if it owned it
+        int64_t* h = hist.data() + (size_t)hist_row[a] * (kHistMax + 1);
+        const int c = clampc(cnt_node[an]);
+        h[c] -= 1;
+        elig_alive[a] -= 1;
+        if (c == hist_min[a] && h[c] == 0) {
+          int m = hist_min[a];
+          while (m <= kHistMax && h[m] == 0) ++m;
+          hist_min[a] = m > kHistMax ? 0 : m;
+        }
+      }
       if (z > 0 && z < nz) {
         if (elig[an]) {
           cnt_zone[(size_t)a * nz + z] -= cnt_node[an];

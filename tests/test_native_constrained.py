@@ -51,7 +51,7 @@ def _rand_world(seed):
     pods = []
     for i in range(n_nodes):
         for j in range(int(rng.integers(0, 5))):
-            kind = rng.integers(0, 4)
+            kind = rng.integers(0, 5)
             app = f"app{int(rng.integers(0, 5))}"
             p = build_test_pod(
                 f"p{i}-{j}", cpu_milli=int(rng.integers(200, 1500)),
@@ -68,6 +68,11 @@ def _rand_world(seed):
             elif kind == 3:
                 p.anti_affinity = [AffinityTerm(match_labels={"app": app},
                                                 topology_key=ZONE)]
+            elif kind == 4:
+                # HOST-kind spread: every eligible node is a domain
+                p.topology_spread = [TopologySpreadConstraint(
+                    max_skew=int(rng.integers(1, 4)), topology_key=HOST,
+                    match_labels={"app": app})]
             fake.add_pod(p)
             pods.append(p)
     enc_kw = dict(node_bucket=64, group_bucket=64)
@@ -133,6 +138,40 @@ def test_spread_skew_blocks_native_and_python_alike(monkeypatch):
     # 2 -> skew 2 > 1; ONE removal is allowed (its zone stops being a domain
     # when its only node leaves), the rest must be blocked
     assert len(native) <= 1
+
+
+def test_host_spread_one_per_node_native(monkeypatch):
+    """Host-kind spread (skew 1) is one-per-node until every node holds one:
+    consolidation must respect the per-node global minimum, natively."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=40)
+    nodes = []
+    for i in range(4):
+        nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384)
+        fake.add_existing_node("ng1", nd)
+        nodes.append(nd)
+    pods = []
+    for i in range(3):    # one spread pod on n0..n2; n3 empty
+        p = build_test_pod(f"s{i}", cpu_milli=500, mem_mib=128,
+                           owner_name="rs-s", node_name=f"n{i}",
+                           labels={"app": "s"})
+        p.phase = "Running"
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=HOST, match_labels={"app": "s"})]
+        fake.add_pod(p)
+        pods.append(p)
+    enc_kw = dict(node_bucket=64, group_bucket=64)
+    native = _plan(fake, nodes, pods, enc_kw, False, monkeypatch)
+    python = _plan(fake, nodes, pods, enc_kw, True, monkeypatch)
+    assert native == python
+    # n3 (empty domain) deletes first; after that each drain would stack 2
+    # on one node while another eligible node holds 1 -> skew 2 > 1 is only
+    # avoided by... moving to a zero-count node, but none remain: at most
+    # one further drain can land its pod on a node that then leaves the
+    # domain set. The passes must agree exactly either way (asserted above);
+    # sanity: the empty node is always in the plan
+    assert "n3" in [r[0] for r in native]
 
 
 def test_anti_self_host_one_per_node_native(monkeypatch):
