@@ -2,11 +2,12 @@
 libkacodec.so) + the planner-facing wrapper.
 
 The native kernel covers the common case AND the constrained tier (zone- and
-host-kind topology spread, host/zone required anti-affinity — round-4
-verdict item 4); `core/scaledown/planner.py` keeps the Python pass as the
-general fallback (pod affinity, lossy encodings, host ports, atomic groups,
-injected phantoms) and `tests/test_native_confirm.py` +
-`tests/test_native_constrained.py` property-test the two against each other.
+host-kind topology spread, host/zone required anti-affinity AND required
+pod affinity incl. the first-pod exception — round-4 verdict item 4);
+`core/scaledown/planner.py` keeps the Python pass as the general fallback
+(lossy encodings, host ports, atomic groups, injected phantoms) and
+`tests/test_native_confirm.py` + `tests/test_native_constrained.py`
+property-test the two against each other.
 """
 
 from __future__ import annotations
@@ -44,8 +45,9 @@ def _load():
         ctypes.c_void_p, ctypes.c_void_p, i64p,
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
-        # constrained tier
+        # constrained tier (18 pointer args after n_zones)
         ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -79,13 +81,17 @@ class ConstraintBlock:
     spread_self: np.ndarray      # u8[G]
     has_anti_host: np.ndarray    # u8[G]
     has_anti_zone: np.ndarray    # u8[G]
+    aff_kind: np.ndarray         # u8[G] (0 none, 1 host, 2 zone)
+    aff_self: np.ndarray         # u8[G]
     elig: np.ndarray             # u8[G, N]
     cnt_node: np.ndarray         # i32[G, N]
     anti_host_node: np.ndarray   # i32[G, N]
     anti_zone_node: np.ndarray   # i32[G, N]
+    aff_node: np.ndarray         # i32[G, N]
     m_spread: np.ndarray         # u8[G, G]
     m_anti_h: np.ndarray         # u8[G, G]
     m_anti_z: np.ndarray         # u8[G, G]
+    m_aff: np.ndarray            # u8[G, G]
     con_path: np.ndarray         # u8[G]
 
 
@@ -147,13 +153,15 @@ def confirm(
         con_args = [
             int(con.n_zones), _vp(con.zone_id), _vp(con.spread_kind),
             _vp(con.max_skew), _vp(con.spread_self), _vp(con.has_anti_host),
-            _vp(con.has_anti_zone), _vp(con.elig), _vp(con.cnt_node),
+            _vp(con.has_anti_zone), _vp(con.aff_kind), _vp(con.aff_self),
+            _vp(con.elig), _vp(con.cnt_node),
             _vp(con.anti_host_node), _vp(con.anti_zone_node),
+            _vp(con.aff_node),
             _vp(con.m_spread), _vp(con.m_anti_h), _vp(con.m_anti_z),
-            _vp(con.con_path),
+            _vp(con.m_aff), _vp(con.con_path),
         ]
     else:
-        con_args = [0] + [None] * 14
+        con_args = [0] + [None] * 18
     rc = lib.ka_confirm_c(
         n, r, g,
         np.ascontiguousarray(free),

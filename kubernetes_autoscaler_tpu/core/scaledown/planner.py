@@ -332,6 +332,8 @@ class Planner:
             ZONE_KEY_BETA,
         )
 
+        if enc.specs.spread_kind is None:
+            return None    # constraint tensors absent -> python pass decides
         g_total = feas.shape[0]
         # exemplar pod per equivalence row (resident or pending)
         exemplars: dict[int, object] = {}
@@ -349,11 +351,16 @@ class Planner:
                             enc.specs.max_skew).astype(np.int32)
         spread_self = _hostarr(enc, "specs.spread_self",
                                enc.specs.spread_self).astype(np.uint8)
+        ak = _hostarr(enc, "specs.aff_kind", enc.specs.aff_kind)
+        aff_kind = np.where((ak == 1) | (ak == 2), ak, 0).astype(np.uint8)
+        aff_self = _hostarr(enc, "specs.aff_self",
+                            enc.specs.aff_self).astype(np.uint8)
         has_anti_host = np.zeros((g_total,), np.uint8)
         has_anti_zone = np.zeros((g_total,), np.uint8)
         m_spread = np.zeros((g_total, g_total), np.uint8)
         m_anti_h = np.zeros((g_total, g_total), np.uint8)
         m_anti_z = np.zeros((g_total, g_total), np.uint8)
+        m_aff = np.zeros((g_total, g_total), np.uint8)
         zone_keys = (ZONE_KEY, ZONE_KEY_BETA)
         moved_set = {int(x) for x in moved_groups}
         for a, ex_a in exemplars.items():
@@ -373,6 +380,14 @@ class Planner:
                     for b, ex_b in exemplars.items():
                         m_spread[a, b] = (ex_b.namespace == ex_a.namespace
                                           and labels_match(sel, ex_b.labels))
+            if aff_kind[a] and ex_a.pod_affinity:
+                term = ex_a.pod_affinity[0]
+                if routed and (len(ex_a.pod_affinity) > 1
+                               or term.namespace_selector is not None):
+                    return None     # lossy shapes (defensive: hostcheck'd)
+                for b, ex_b in exemplars.items():
+                    m_aff[a, b] = term_matches_pod(term, ex_a, ex_b,
+                                                   enc.namespaces)
             host_terms, zone_terms = [], []
             for t in ex_a.anti_affinity:
                 if t.topology_key == HOSTNAME_KEY:
@@ -409,6 +424,9 @@ class Planner:
         anti_zone_node = np.ascontiguousarray(
             _hostarr(enc, "planes.anti_zone_cnt",
                      enc.planes.anti_zone_cnt), np.int32).copy()
+        aff_node = np.ascontiguousarray(
+            _hostarr(enc, "planes.aff_cnt", enc.planes.aff_cnt),
+            np.int32).copy()
         return ConstraintBlock(
             n_zones=int(enc.dims.max_zones),
             zone_id=np.ascontiguousarray(
@@ -418,13 +436,17 @@ class Planner:
             spread_self=spread_self,
             has_anti_host=has_anti_host,
             has_anti_zone=has_anti_zone,
+            aff_kind=aff_kind,
+            aff_self=aff_self,
             elig=np.ascontiguousarray(elig.astype(np.uint8)),
             cnt_node=cnt_node,
             anti_host_node=anti_host_node,
             anti_zone_node=anti_zone_node,
+            aff_node=aff_node,
             m_spread=np.ascontiguousarray(m_spread),
             m_anti_h=np.ascontiguousarray(m_anti_h),
             m_anti_z=np.ascontiguousarray(m_anti_z),
+            m_aff=np.ascontiguousarray(m_aff),
             con_path=np.ascontiguousarray(con_path.astype(np.uint8)),
         )
 
@@ -726,11 +748,12 @@ class Planner:
 
         # NATIVE FAST PATH (sidecar/native/kaconfirm.cc): the identical
         # sequential pass in C++ for the common case AND the constrained
-        # tier — zone/host topology spread + host/zone required anti-affinity
-        # ride as incrementally-maintained count planes (round-4 verdict item
-        # 4: the all-constrained confirm was ~37 s host-side at 5k nodes /
-        # 50k pods; native is milliseconds). Still python: pod affinity,
-        # lossy encodings, host ports, atomic groups, phantoms.
+        # tier — zone/host topology spread, host/zone required anti-affinity
+        # AND required pod affinity (first-pod exception included) ride as
+        # incrementally-maintained count planes (round-4 verdict item 4: the
+        # all-constrained confirm was ~37 s host-side at 5k nodes / 50k
+        # pods; native is milliseconds). Still python: lossy encodings,
+        # host ports, atomic groups, phantoms.
         # tests/test_native_confirm.py proves plan-equality vs the Python
         # pass below.
         pdbs = self.pdb_tracker.get_pdbs() if self.pdb_tracker else []
@@ -744,15 +767,10 @@ class Planner:
                                      enc.specs.needs_host_check)
                 port_g = (_hostarr(enc, "specs.port_hash",
                                    enc.specs.port_hash) != 0).any(axis=-1)
-                if enc.specs.spread_kind is not None:
-                    sk = _hostarr(enc, "specs.spread_kind", enc.specs.spread_kind)
-                    ak = _hostarr(enc, "specs.aff_kind", enc.specs.aff_kind)
-                else:
-                    sk = np.zeros(hostcheck.shape, np.int32)
-                    ak = np.zeros(hostcheck.shape, np.int32)
-                # spread kinds 0/1/2 all native now (host kind rides the
-                # count histogram); pod affinity stays python
-                native_ok_g = (~hostcheck & ~port_g & (ak == 0))
+                # spread (host/zone), anti-affinity (host/zone) and required
+                # pod affinity are all native now; only lossy shapes
+                # (hostcheck) and host ports route to the Python pass
+                native_ok_g = ~hostcheck & ~port_g
                 eligible = bool(native_ok_g[moved_groups].all())
                 con_needed = bool(need_exact[moved_groups].any()
                                   or limit_g[moved_groups].any())

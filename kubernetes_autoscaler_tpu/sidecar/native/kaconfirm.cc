@@ -60,15 +60,21 @@ struct ConState {
   const uint8_t* spread_self = nullptr;   // [g]
   const uint8_t* has_anti_host = nullptr; // [g]
   const uint8_t* has_anti_zone = nullptr; // [g]
+  const uint8_t* aff_kind = nullptr;      // [g]; 0 none, 1 host, 2 zone
+  const uint8_t* aff_self = nullptr;      // [g] pod matches its own term
   const uint8_t* elig = nullptr;          // [g*n] spread domain eligibility
   int32_t* cnt_node = nullptr;            // [g*n] spread matches per node
   int32_t* anti_host_node = nullptr;      // [g*n]
   int32_t* anti_zone_node = nullptr;      // [g*n]
+  int32_t* aff_node = nullptr;            // [g*n]
   const uint8_t* m_spread = nullptr;      // [g*g]: pod of b counts for a
   const uint8_t* m_anti_h = nullptr;      // [g*g]
   const uint8_t* m_anti_z = nullptr;      // [g*g]
+  const uint8_t* m_aff = nullptr;         // [g*g]
   const uint8_t* con_path = nullptr;      // [g] group places via this tier
   std::vector<int64_t> cnt_zone, anti_zone, elig_zone;  // [g*nz]
+  std::vector<int64_t> aff_zone;          // [g*nz]
+  std::vector<int64_t> aff_total;         // [g] matches anywhere alive
   std::vector<int> con_groups;            // groups with any constraint rows
   // host-kind spread (kind 1): every ELIGIBLE node is a domain; the global
   // minimum is maintained O(1) via a per-group count histogram over
@@ -105,6 +111,8 @@ struct ConState {
     cnt_zone.assign((size_t)g * nz, 0);
     anti_zone.assign((size_t)g * nz, 0);
     elig_zone.assign((size_t)g * nz, 0);
+    aff_zone.assign((size_t)g * nz, 0);
+    aff_total.assign(g, 0);
     hist_row.assign(g, -1);
     hist_min.assign(g, 0);
     elig_alive.assign(g, 0);
@@ -114,7 +122,7 @@ struct ConState {
     hist.assign((size_t)n_host * (kHistMax + 1), 0);
     for (int a = 0; a < g; ++a) {
       const bool any = spread_kind[a] != 0 || has_anti_host[a] ||
-                       has_anti_zone[a];
+                       has_anti_zone[a] || aff_kind[a] != 0;
       if (any) con_groups.push_back(a);
       const bool host_spread = spread_kind[a] == 1;
       int64_t* h = host_spread
@@ -128,6 +136,7 @@ struct ConState {
           elig_alive[a] += 1;
           if (c < mn) mn = c;
         }
+        aff_total[a] += aff_node[(size_t)a * n + i];
         const int z = zone_id[i];
         if (z <= 0 || z >= nz) continue;
         if (el) {
@@ -135,6 +144,7 @@ struct ConState {
           cnt_zone[(size_t)a * nz + z] += cnt_node[(size_t)a * n + i];
         }
         anti_zone[(size_t)a * nz + z] += anti_zone_node[(size_t)a * n + i];
+        aff_zone[(size_t)a * nz + z] += aff_node[(size_t)a * n + i];
       }
       hist_min[a] = mn > kHistMax ? 0 : mn;
     }
@@ -158,6 +168,11 @@ struct ConState {
         anti_zone_node[an] += sign * count;
         if (z > 0 && z < nz) anti_zone[(size_t)a * nz + z] += sign * count;
       }
+      if (m_aff[(size_t)a * g + b]) {
+        aff_node[an] += sign * count;
+        aff_total[a] += sign * count;
+        if (z > 0 && z < nz) aff_zone[(size_t)a * nz + z] += sign * count;
+      }
     }
   }
 
@@ -169,6 +184,17 @@ struct ConState {
     if (has_anti_zone[a] && z > 0 && z < nz &&
         anti_zone[(size_t)a * nz + z] > 0)
       return false;
+    if (aff_kind[a] != 0) {
+      int64_t here = 0;
+      if (aff_kind[a] == 1) {
+        here = aff_node[(size_t)a * n + i];
+      } else if (z > 0 && z < nz) {
+        here = aff_zone[(size_t)a * nz + z];
+      } else {
+        return false;  // zone term, node without the key
+      }
+      if (here <= 0 && !(aff_total[a] == 0 && aff_self[a])) return false;
+    }
     if (spread_kind[a] == 1) {
       // every eligible alive node is a domain; min over them is hist_min
       const int64_t minc = elig_alive[a] > 0 ? hist_min[a] : 0;
@@ -215,16 +241,19 @@ struct ConState {
           hist_min[a] = m > kHistMax ? 0 : m;
         }
       }
+      aff_total[a] -= aff_node[an];
       if (z > 0 && z < nz) {
         if (elig[an]) {
           cnt_zone[(size_t)a * nz + z] -= cnt_node[an];
           elig_zone[(size_t)a * nz + z] -= 1;
         }
         anti_zone[(size_t)a * nz + z] -= anti_zone_node[an];
+        aff_zone[(size_t)a * nz + z] -= aff_node[an];
       }
       cnt_node[an] = 0;
       anti_zone_node[an] = 0;
       anti_host_node[an] = 0;
+      aff_node[an] = 0;
     }
   }
 };
@@ -268,13 +297,17 @@ int ka_confirm_c(
     const uint8_t* con_spread_self,
     const uint8_t* con_has_anti_host,
     const uint8_t* con_has_anti_zone,
+    const uint8_t* con_aff_kind,
+    const uint8_t* con_aff_self,
     const uint8_t* con_elig,
     int32_t* con_cnt_node,
     int32_t* con_anti_host_node,
     int32_t* con_anti_zone_node,
+    int32_t* con_aff_node,
     const uint8_t* con_m_spread,
     const uint8_t* con_m_anti_h,
     const uint8_t* con_m_anti_z,
+    const uint8_t* con_m_aff,
     const uint8_t* con_path_flag,  // [g] group routes through the tier
     // ---- outputs ----
     uint8_t* accept_out,         // [n_cand]
@@ -292,10 +325,12 @@ int ka_confirm_c(
     if (n_zones <= 0 || con_spread_kind == nullptr ||
         con_max_skew == nullptr || con_spread_self == nullptr ||
         con_has_anti_host == nullptr || con_has_anti_zone == nullptr ||
+        con_aff_kind == nullptr || con_aff_self == nullptr ||
         con_elig == nullptr || con_cnt_node == nullptr ||
         con_anti_host_node == nullptr || con_anti_zone_node == nullptr ||
-        con_m_spread == nullptr || con_m_anti_h == nullptr ||
-        con_m_anti_z == nullptr || con_path_flag == nullptr)
+        con_aff_node == nullptr || con_m_spread == nullptr ||
+        con_m_anti_h == nullptr || con_m_anti_z == nullptr ||
+        con_m_aff == nullptr || con_path_flag == nullptr)
       return -1;
     con.n = n;
     con.g = g;
@@ -306,13 +341,17 @@ int ka_confirm_c(
     con.spread_self = con_spread_self;
     con.has_anti_host = con_has_anti_host;
     con.has_anti_zone = con_has_anti_zone;
+    con.aff_kind = con_aff_kind;
+    con.aff_self = con_aff_self;
     con.elig = con_elig;
     con.cnt_node = con_cnt_node;
     con.anti_host_node = con_anti_host_node;
     con.anti_zone_node = con_anti_zone_node;
+    con.aff_node = con_aff_node;
     con.m_spread = con_m_spread;
     con.m_anti_h = con_m_anti_h;
     con.m_anti_z = con_m_anti_z;
+    con.m_aff = con_m_aff;
     con.con_path = con_path_flag;
     con.init();
   }

@@ -51,7 +51,7 @@ def _rand_world(seed):
     pods = []
     for i in range(n_nodes):
         for j in range(int(rng.integers(0, 5))):
-            kind = rng.integers(0, 5)
+            kind = rng.integers(0, 6)
             app = f"app{int(rng.integers(0, 5))}"
             p = build_test_pod(
                 f"p{i}-{j}", cpu_milli=int(rng.integers(200, 1500)),
@@ -73,6 +73,11 @@ def _rand_world(seed):
                 p.topology_spread = [TopologySpreadConstraint(
                     max_skew=int(rng.integers(1, 4)), topology_key=HOST,
                     match_labels={"app": app})]
+            elif kind == 5:
+                # required pod affinity (self-matching when app equal)
+                p.pod_affinity = [AffinityTerm(
+                    match_labels={"app": app},
+                    topology_key=ZONE if rng.integers(0, 2) else HOST)]
             fake.add_pod(p)
             pods.append(p)
     enc_kw = dict(node_bucket=64, group_bucket=64)
@@ -172,6 +177,40 @@ def test_host_spread_one_per_node_native(monkeypatch):
     # domain set. The passes must agree exactly either way (asserted above);
     # sanity: the empty node is always in the plan
     assert "n3" in [r[0] for r in native]
+
+
+def test_pod_affinity_coloc_native(monkeypatch):
+    """Required zone affinity keeps co-located pods together through
+    consolidation: a pod with affinity to 'db' can only land in zones that
+    hold a db pod — natively and in python alike."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=40)
+    nodes = []
+    for i, z in enumerate(["za", "za", "zb", "zb"]):
+        nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384, zone=z)
+        fake.add_existing_node("ng1", nd)
+        nodes.append(nd)
+    db = build_test_pod("db-0", cpu_milli=1000, mem_mib=256,
+                        owner_name="rs-db", node_name="n0",
+                        labels={"app": "db"})
+    db.phase = "Running"
+    fake.add_pod(db)
+    web = build_test_pod("web-0", cpu_milli=500, mem_mib=128,
+                         owner_name="rs-web", node_name="n1",
+                         labels={"app": "web"})
+    web.phase = "Running"
+    web.pod_affinity = [AffinityTerm(match_labels={"app": "db"},
+                                     topology_key=ZONE)]
+    fake.add_pod(web)
+    enc_kw = dict(node_bucket=64, group_bucket=64)
+    native = _plan(fake, nodes, pods := [db, web], enc_kw, False, monkeypatch)
+    python = _plan(fake, nodes, pods, enc_kw, True, monkeypatch)
+    assert native == python
+    # n1's drain must keep web in zone za (n0, where db lives) — never zb
+    for name, _slots, dests in native:
+        if name == "n1":
+            assert set(dests.values()) <= {0}, dests
 
 
 def test_anti_self_host_one_per_node_native(monkeypatch):
