@@ -45,13 +45,13 @@ def _load():
         ctypes.c_void_p, ctypes.c_void_p, i64p,
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
-        # constrained tier (18 pointer args after n_zones)
+        # constrained tier (20 pointer args after n_zones)
         ctypes.c_int,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         u8p, u8p, i32p,
     ]
     _lib = lib
@@ -83,6 +83,8 @@ class ConstraintBlock:
     has_anti_zone: np.ndarray    # u8[G]
     aff_kind: np.ndarray         # u8[G] (0 none, 1 host, 2 zone)
     aff_self: np.ndarray         # u8[G]
+    one_per_node: np.ndarray     # u8[G] limit_g (anti-self | host ports)
+    oracle_moved: np.ndarray     # u8[G] = need_exact (python oracle-moves)
     elig: np.ndarray             # u8[G, N]
     cnt_node: np.ndarray         # i32[G, N]
     anti_host_node: np.ndarray   # i32[G, N]
@@ -154,6 +156,7 @@ def confirm(
             int(con.n_zones), _vp(con.zone_id), _vp(con.spread_kind),
             _vp(con.max_skew), _vp(con.spread_self), _vp(con.has_anti_host),
             _vp(con.has_anti_zone), _vp(con.aff_kind), _vp(con.aff_self),
+            _vp(con.one_per_node), _vp(con.oracle_moved),
             _vp(con.elig), _vp(con.cnt_node),
             _vp(con.anti_host_node), _vp(con.anti_zone_node),
             _vp(con.aff_node),
@@ -161,7 +164,7 @@ def confirm(
             _vp(con.m_aff), _vp(con.con_path),
         ]
     else:
-        con_args = [0] + [None] * 18
+        con_args = [0] + [None] * 20
     rc = lib.ka_confirm_c(
         n, r, g,
         np.ascontiguousarray(free),

@@ -310,12 +310,17 @@ class Planner:
     def _mark(self, name: str, reason: str, now: float) -> None:
         self.unremovable.add(name, reason, now)
 
-    def _build_constraint_block(self, enc, feas, con_path, moved_groups):
+    def _build_constraint_block(self, enc, feas, con_path, moved_groups,
+                                oracle_moved, one_per_node):
         """Constrained-tier marshalling for the native pass: count planes
         from the host mirrors, zone/eligibility tables, and group-to-group
         match matrices from the equivalence exemplars. Returns None when a
         routed group's constraints exceed the native tier's model (the
         caller then falls back to the Python pass)."""
+        if not np.array_equal(con_path, oracle_moved | one_per_node):
+            raise ValueError(
+                "tier routing desynchronized: con_path must equal "
+                "need_exact | limit_g")
         import jax
 
         from kubernetes_autoscaler_tpu.core.scaledown.native_confirm import (
@@ -428,6 +433,8 @@ class Planner:
             _hostarr(enc, "planes.aff_cnt", enc.planes.aff_cnt),
             np.int32).copy()
         return ConstraintBlock(
+            one_per_node=np.ascontiguousarray(one_per_node.astype(np.uint8)),
+            oracle_moved=np.ascontiguousarray(oracle_moved.astype(np.uint8)),
             n_zones=int(enc.dims.max_zones),
             zone_id=np.ascontiguousarray(
                 _hostarr(enc, "nodes.zone_id", enc.nodes.zone_id), np.int32),
@@ -467,7 +474,9 @@ class Planner:
             # oracle (need_exact | limit_g) through the native per-pod tier
             con_path = (need_exact | limit_g)
             con = self._build_constraint_block(enc, feas, con_path,
-                                               moved_groups)
+                                               moved_groups,
+                                               oracle_moved=need_exact,
+                                               one_per_node=limit_g)
             if con is None:
                 return None      # beyond the tier — python pass decides
 
@@ -765,12 +774,11 @@ class Planner:
             if moved_groups.size:
                 hostcheck = _hostarr(enc, "specs.needs_host_check",
                                      enc.specs.needs_host_check)
-                port_g = (_hostarr(enc, "specs.port_hash",
-                                   enc.specs.port_hash) != 0).any(axis=-1)
-                # spread (host/zone), anti-affinity (host/zone) and required
-                # pod affinity are all native now; only lossy shapes
-                # (hostcheck) and host ports route to the Python pass
-                native_ok_g = ~hostcheck & ~port_g
+                # spread (host/zone), anti-affinity (host/zone), required
+                # pod affinity AND one-per-node port/anti groups are all
+                # native now; only lossy shapes (hostcheck) route to the
+                # Python pass
+                native_ok_g = ~hostcheck
                 eligible = bool(native_ok_g[moved_groups].all())
                 con_needed = bool(need_exact[moved_groups].any()
                                   or limit_g[moved_groups].any())

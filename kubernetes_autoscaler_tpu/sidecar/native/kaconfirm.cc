@@ -62,6 +62,11 @@ struct ConState {
   const uint8_t* has_anti_zone = nullptr; // [g]
   const uint8_t* aff_kind = nullptr;      // [g]; 0 none, 1 host, 2 zone
   const uint8_t* aff_self = nullptr;      // [g] pod matches its own term
+  const uint8_t* one_per_node = nullptr;  // [g] limit_g: anti-self | ports
+  // python's exact path ORACLE-MOVES only need_exact groups; pods of
+  // limit-only (pure port) groups leave the count planes stale there —
+  // mirror that staleness or plans diverge
+  const uint8_t* oracle_moved = nullptr;  // [g] = need_exact
   const uint8_t* elig = nullptr;          // [g*n] spread domain eligibility
   int32_t* cnt_node = nullptr;            // [g*n] spread matches per node
   int32_t* anti_host_node = nullptr;      // [g*n]
@@ -73,6 +78,12 @@ struct ConState {
   const uint8_t* m_aff = nullptr;         // [g*g]
   const uint8_t* con_path = nullptr;      // [g] group places via this tier
   std::vector<int64_t> cnt_zone, anti_zone, elig_zone;  // [g*nz]
+  // one-per-node marks, mirroring the Python pass's moved_marks EXACTLY:
+  // a destination a limit_g group placed on stays excluded for that group
+  // for the rest of the pass (STICKY — python never clears marks, even
+  // when the pod later cascades away); local marks vanish on candidate
+  // revert, committed marks persist
+  std::vector<uint8_t> marks_committed, marks_local;  // [g*n]
   std::vector<int64_t> aff_zone;          // [g*nz]
   std::vector<int64_t> aff_total;         // [g] matches anywhere alive
   std::vector<int> con_groups;            // groups with any constraint rows
@@ -113,6 +124,8 @@ struct ConState {
     elig_zone.assign((size_t)g * nz, 0);
     aff_zone.assign((size_t)g * nz, 0);
     aff_total.assign(g, 0);
+    marks_committed.assign((size_t)g * n, 0);
+    marks_local.assign((size_t)g * n, 0);
     hist_row.assign(g, -1);
     hist_min.assign(g, 0);
     elig_alive.assign(g, 0);
@@ -121,6 +134,9 @@ struct ConState {
       if (spread_kind[a] == 1) hist_row[a] = n_host++;
     hist.assign((size_t)n_host * (kHistMax + 1), 0);
     for (int a = 0; a < g; ++a) {
+      // marks work without con_groups membership: pure one-per-node
+      // (port-only) groups stay OUT so apply()/remove_node() never iterate
+      // their all-zero count-plane rows
       const bool any = spread_kind[a] != 0 || has_anti_host[a] ||
                        has_anti_zone[a] || aff_kind[a] != 0;
       if (any) con_groups.push_back(a);
@@ -179,6 +195,10 @@ struct ConState {
   // can one pod of group a land on node i right now?
   bool ok(int a, int i) const {
     const int z = zone_id[i];
+    if (one_per_node[a]) {
+      const size_t an = (size_t)a * n + i;
+      if (marks_committed[an] || marks_local[an]) return false;
+    }
     if (has_anti_host[a] && anti_host_node[(size_t)a * n + i] > 0)
       return false;
     if (has_anti_zone[a] && z > 0 && z < nz &&
@@ -299,6 +319,8 @@ int ka_confirm_c(
     const uint8_t* con_has_anti_zone,
     const uint8_t* con_aff_kind,
     const uint8_t* con_aff_self,
+    const uint8_t* con_one_per_node,
+    const uint8_t* con_oracle_moved,
     const uint8_t* con_elig,
     int32_t* con_cnt_node,
     int32_t* con_anti_host_node,
@@ -326,6 +348,7 @@ int ka_confirm_c(
         con_max_skew == nullptr || con_spread_self == nullptr ||
         con_has_anti_host == nullptr || con_has_anti_zone == nullptr ||
         con_aff_kind == nullptr || con_aff_self == nullptr ||
+        con_one_per_node == nullptr || con_oracle_moved == nullptr ||
         con_elig == nullptr || con_cnt_node == nullptr ||
         con_anti_host_node == nullptr || con_anti_zone_node == nullptr ||
         con_aff_node == nullptr || con_m_spread == nullptr ||
@@ -343,6 +366,8 @@ int ka_confirm_c(
     con.has_anti_zone = con_has_anti_zone;
     con.aff_kind = con_aff_kind;
     con.aff_self = con_aff_self;
+    con.one_per_node = con_one_per_node;
+    con.oracle_moved = con_oracle_moved;
     con.elig = con_elig;
     con.cnt_node = con_cnt_node;
     con.anti_host_node = con_anti_host_node;
@@ -458,9 +483,11 @@ int ka_confirm_c(
       if (con_gg) {
         // per-pod path, mirroring the Python exact path: move the pod's
         // contribution off the candidate, then scan destinations re-checking
-        // the constraint as counts shift
+        // the constraint as counts shift (pure-limit groups skip the count
+        // planes exactly as python skips their oracle moves)
+        const bool track = con.oracle_moved[gg] != 0;
         for (int t = 0; t < want && ok; ++t) {
-          con.apply(gg, cand, -1);
+          if (track) con.apply(gg, cand, -1);
           int d_found = -1;
           for (int node = 0; node < n; ++node) {
             if (node == cand || deleted[node] || !node_valid[node] ||
@@ -486,7 +513,9 @@ int ka_confirm_c(
           }
           int64_t* fr = free_io + (int64_t)d_found * r;
           for (int k = 0; k < r; ++k) fr[k] -= req[k];
-          con.apply(gg, d_found, +1);
+          if (track) con.apply(gg, d_found, +1);
+          if (con.one_per_node[gg])
+            con.marks_local[(size_t)gg * n + d_found] = 1;
           if (trace)
             fprintf(stderr, "[kaconfirm] cand=%d con slot=%d g=%d -> %d\n",
                     cand, victims[v + t].slot, gg, d_found);
@@ -549,11 +578,15 @@ int ka_confirm_c(
         for (int k = 0; k < r; ++k) fr[k] += req[k];
         if (m.node < min_reverted) min_reverted = m.node;
         if (con.active() && con.con_path[m.group]) {
-          con.apply(m.group, m.node, -1);
-          con.apply(m.group, cand, +1);
+          if (con.oracle_moved[m.group]) {
+            con.apply(m.group, m.node, -1);
+            con.apply(m.group, cand, +1);
+          }
+          con.marks_local[(size_t)m.group * n + m.node] = 0;
         }
       }
-      if (out_unplaced_group >= 0) con.apply(out_unplaced_group, cand, +1);
+      if (out_unplaced_group >= 0 && con.oracle_moved[out_unplaced_group])
+        con.apply(out_unplaced_group, cand, +1);
       // Restoring capacity can re-open a node that ANOTHER group's frontier
       // already skipped as full while this candidate was being placed, so
       // every group's hint must rewind to the earliest reverted destination —
@@ -573,7 +606,16 @@ int ka_confirm_c(
     if (n_pdbs > 0)
       for (int p = 0; p < n_pdbs; ++p) pdb_remaining[p] -= pdb_need[p];
     deleted[cand] = 1;
-    if (con.active()) con.remove_node(cand);
+    if (con.active()) {
+      for (const Move& m : placed) {
+        const size_t mi = (size_t)m.group * n + m.node;
+        if (con.marks_local[mi]) {
+          con.marks_local[mi] = 0;
+          con.marks_committed[mi] = 1;
+        }
+      }
+      con.remove_node(cand);
+    }
     group_room[gi_room] -= 1;
     if (is_empty) --empty_budget; else --drain_budget;
     if (quota_totals) {

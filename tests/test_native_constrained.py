@@ -51,7 +51,7 @@ def _rand_world(seed):
     pods = []
     for i in range(n_nodes):
         for j in range(int(rng.integers(0, 5))):
-            kind = rng.integers(0, 6)
+            kind = rng.integers(0, 7)
             app = f"app{int(rng.integers(0, 5))}"
             p = build_test_pod(
                 f"p{i}-{j}", cpu_milli=int(rng.integers(200, 1500)),
@@ -78,6 +78,9 @@ def _rand_world(seed):
                 p.pod_affinity = [AffinityTerm(
                     match_labels={"app": app},
                     topology_key=ZONE if rng.integers(0, 2) else HOST)]
+            elif kind == 6:
+                # host-port pod (one-per-node via the sticky-marks tier)
+                p.host_ports = ((8000 + int(rng.integers(0, 3)), "TCP"),)
             fake.add_pod(p)
             pods.append(p)
     enc_kw = dict(node_bucket=64, group_bucket=64)
@@ -211,6 +214,34 @@ def test_pod_affinity_coloc_native(monkeypatch):
     for name, _slots, dests in native:
         if name == "n1":
             assert set(dests.values()) <= {0}, dests
+
+
+def test_host_ports_one_per_node_native(monkeypatch):
+    """Ported pods consolidate one-per-node on the native marks tier:
+    within a pass a port group never doubles up on a destination."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=40)
+    nodes = []
+    for i in range(5):
+        nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384)
+        fake.add_existing_node("ng1", nd)
+        nodes.append(nd)
+    pods = []
+    for i in range(3):    # ported pod on n0..n2; n3/n4 empty
+        p = build_test_pod(f"w{i}", cpu_milli=500, mem_mib=128,
+                           owner_name="rs-w", node_name=f"n{i}",
+                           labels={"app": "w"}, host_port=8080)
+        p.phase = "Running"
+        fake.add_pod(p)
+        pods.append(p)
+    enc_kw = dict(node_bucket=64, group_bucket=64)
+    native = _plan(fake, nodes, pods, enc_kw, False, monkeypatch)
+    python = _plan(fake, nodes, pods, enc_kw, True, monkeypatch)
+    assert native == python
+    # every drained ported pod lands on a DISTINCT destination
+    dests = [d for _name, _slots, dd in native for d in dd.values()]
+    assert len(dests) == len(set(dests)), native
 
 
 def test_anti_self_host_one_per_node_native(monkeypatch):
