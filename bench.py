@@ -309,12 +309,18 @@ def run_bench(args, metric: str) -> None:
     # phase must never lose the already-measured evidence. It is re-printed
     # as the LAST line after the optional phases so both first-line and
     # last-line consumers read the headline metric; the runonce_e2e line
-    # sits between them.
+    # sits between them. The "phases" object decomposes the number into its
+    # cost domains (metrics/phases.py) instead of shipping it opaque.
     primary_line = json.dumps({
         "metric": metric,
         "value": round(p50, 3),
         "unit": "ms",
         "vs_baseline": round(200.0 / p50, 2),
+        "phases": {
+            "encode_ms": round(encode_s * 1000.0, 1),
+            "compile_ms": round(compile_s * 1000.0, 1),
+            "device_sim_ms": round(p50, 3),
+        },
     })
     print(primary_line, flush=True)
 
@@ -396,7 +402,8 @@ def bench_scaledown(args) -> None:
     planner.update(enc, nodes, now=1000.0)
     plan = planner.nodes_to_delete(enc, nodes, now=1000.0)
     compile_s = time.perf_counter() - t0
-    # steady state: second loop hits every jit cache
+    # steady state: second loop hits every jit cache (and the marshal cache)
+    planner.phases.reset()
     t0 = time.perf_counter()
     planner.update(enc, nodes, now=1001.0)
     update_ms = (time.perf_counter() - t0) * 1000.0
@@ -416,6 +423,8 @@ def bench_scaledown(args) -> None:
         f"{'C++ pass ~ms; remainder is Python policy pre-screen' if host_ms > 50.0 else ''})",
         file=sys.stderr,
     )
+    print(f"[bench-scaledown] steady-loop phase breakdown: "
+          f"{json.dumps(planner.phases.snapshot())}", file=sys.stderr)
 
     # worst-case confirm variant: every resident pod PDB-guarded (round-3
     # review item #6 — this shape used to abandon the native path entirely)
@@ -505,18 +514,25 @@ def bench_runonce_e2e(args) -> None:
     t0 = time.perf_counter()
     a.run_once(now=1000.0)
     cold_s = time.perf_counter() - t0
+    # the phase breakdown must decompose the STEADY p50, not the cold
+    # compile loop (bench_scaledown resets for the same reason)
+    a.planner.phases.reset()
     samples = []
     seq = 0
     burst = 0
+    # churn bounded by the world size so toy-scale runs (CI smoke) don't
+    # remove pods that never existed
+    churn = min(500, args.pods)
+    binds = min(50, churn)
     for loop in range(max(args.e2e_loops, 2)):
-        for k in range(500):  # churn: new pods arrive, old ones finish
+        for k in range(churn):  # churn: new pods arrive, old ones finish
             fake.remove_pod(f"p{seq + k}")
             fake.add_pod(build_test_pod(
                 f"p{args.pods + seq + k}", cpu_milli=500, mem_mib=512,
                 owner_name=f"prs{(seq + k) % args.pod_groups}"))
-        for k in range(50):   # kubelet binds
+        for k in range(binds):   # kubelet binds
             fake.bind(f"p{args.pods + seq + k}", f"n{(seq + k) % n_nodes}")
-        seq += 500
+        seq += churn
         if loop % 4 == 2:
             # an unfittable burst: the SCALE-UP path fires for real —
             # orchestrator + expander + executor — and the provider
@@ -549,11 +565,15 @@ def bench_runonce_e2e(args) -> None:
         f"full_encodes={enc.full_encodes if enc else -1}",
         file=sys.stderr,
     )
+    phase_snap = a.planner.phases.snapshot()
+    print(f"[bench-e2e] planner phase breakdown: {json.dumps(phase_snap)}",
+          file=sys.stderr)
     print(json.dumps({
         "metric": e2e_metric(args),
         "value": round(p50, 3),
         "unit": "ms",
         "vs_baseline": round(200.0 / p50, 2) if p50 > 0 else 0.0,
+        "phases": phase_snap["totals_ms"],
     }), flush=True)
 
 

@@ -325,7 +325,10 @@ class Actuator:
         force: bool,
         detach: bool = False,
     ) -> list[DeletionResult]:
-        now = time.time() if now is None else now
+        # default into the SAME time domain register_eviction stamps with —
+        # a logical-clock harness must not get wall-clock tracker.start()
+        # timestamps next to logical eviction stamps
+        now = self.walltime() if now is None else now
         if detach:
             # taints must land synchronously — the NEXT loop's planner and
             # filter-out-schedulable must see the nodes as leaving
@@ -353,14 +356,19 @@ class Actuator:
                          for s in needed if s in pods_by_slot}
 
             def run():
+                # results land in this shared list AS THEY COMPLETE inside
+                # _execute_deletion, so nodes that finished before an
+                # unexpected exception still reach _completed — their
+                # bookkeeping fires and their _live_nodes entries are
+                # reclaimed instead of leaking (ADVICE r5)
+                results: list[DeletionResult] = []
                 try:
-                    results = self._execute_deletion(
+                    self._execute_deletion(
                         work, slots, now, force, pre_tainted=True,
-                        defer_rollback=True)
+                        defer_rollback=True, out_results=results)
                 except Exception as e:  # noqa: BLE001 — a worker must never
                     # strand its nodes: synthesize terminal failures so
                     # drain_completed still rolls back and books them
-                    results = []
                     for r in work:
                         # whoever is still in flight got no terminal result
                         if not self.tracker.is_deleting(r.node.name):
@@ -406,7 +414,12 @@ class Actuator:
         force: bool,
         pre_tainted: bool = False,
         defer_rollback: bool = False,
+        out_results: list[DeletionResult] | None = None,
     ) -> list[DeletionResult]:
+        """`out_results`, when given, receives each DeletionResult AS IT
+        COMPLETES (appends are atomic under the GIL) — the detached worker
+        passes a shared list so partially-finished work survives an
+        unexpected crash of the remainder."""
         empty = [r for r in to_remove if r.is_empty]
         drain = [r for r in to_remove if not r.is_empty]
 
@@ -434,7 +447,8 @@ class Actuator:
                     except Exception:  # noqa: BLE001
                         pass
 
-        results: list[DeletionResult] = []
+        results: list[DeletionResult] = \
+            out_results if out_results is not None else []
         # empty nodes: batched per group (reference: delete_in_batch.go)
         by_group: dict[str, list[NodeToRemove]] = {}
         for r in empty:
@@ -461,10 +475,15 @@ class Actuator:
                     else:
                         g.delete_nodes([r.node for r in batch])
                     for r in batch:
+                        # append in the same breath as finish: once the
+                        # tracker says "not deleting", the detached crash
+                        # handler will NOT synthesize a result, so anything
+                        # raised between the two (latency observer, a later
+                        # batch member) must not lose this one
                         self.tracker.finish(r.node.name, True)
+                        results.append(DeletionResult(r.node.name, True))
                         if self.latency_tracker is not None:
                             self.latency_tracker.observe_deletion(r.node.name, now)
-                        results.append(DeletionResult(r.node.name, True))
                 except NodeGroupError as e:
                     for r in batch:
                         if not defer_rollback:
@@ -472,7 +491,10 @@ class Actuator:
                         self.tracker.finish(r.node.name, False, str(e))
                         results.append(DeletionResult(r.node.name, False, str(e)))
 
-        # drain nodes: parallel per node under the drain budget
+        # drain nodes: parallel per node under the drain budget; each
+        # worker appends its result in the same breath as tracker.finish —
+        # an exception AFTER finish (latency observer) must not strand a
+        # node the crash handler no longer sees as in flight
         def drain_one(r: NodeToRemove) -> DeletionResult:
             try:
                 if self.eviction_sink and pods_by_slot:
@@ -519,17 +541,21 @@ class Actuator:
                 else:
                     g.delete_nodes([r.node])
                 self.tracker.finish(r.node.name, True)
+                res = DeletionResult(r.node.name, True)
+                results.append(res)
                 if self.latency_tracker is not None:
                     self.latency_tracker.observe_deletion(r.node.name, now)
-                return DeletionResult(r.node.name, True)
+                return res
             except NodeGroupError as e:
                 if not defer_rollback:
                     self._rollback_node(r.node)
                 self.tracker.finish(r.node.name, False, str(e))
-                return DeletionResult(r.node.name, False, str(e))
+                res = DeletionResult(r.node.name, False, str(e))
+                results.append(res)
+                return res
 
         workers = max(self.options.max_drain_parallelism, 1)
         if drain:
             with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
-                results.extend(ex.map(drain_one, drain))
+                list(ex.map(drain_one, drain))  # results append as they land
         return results

@@ -32,7 +32,14 @@ def _load():
         return _lib
     if not os.path.exists(_LIB_PATH):
         subprocess.run(["make", "-C", _DIR, "-s"], check=True)
-    lib = ctypes.CDLL(_LIB_PATH)
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        # a binary built by a different toolchain (e.g. newer libstdc++)
+        # fails to load — rebuild once with the local compiler rather than
+        # silently abandoning the native tier
+        subprocess.run(["make", "-C", _DIR, "-s", "-B"], check=True)
+        lib = ctypes.CDLL(_LIB_PATH)
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")

@@ -34,6 +34,7 @@ from kubernetes_autoscaler_tpu.core.scaledown.unneeded import (
     UnneededNodes,
     UnremovableNodes,
 )
+from kubernetes_autoscaler_tpu.metrics.phases import PhaseStats
 from kubernetes_autoscaler_tpu.models.api import SCALE_DOWN_DISABLED_KEY, Node
 from kubernetes_autoscaler_tpu.models.encode import EncodedCluster
 from kubernetes_autoscaler_tpu.ops import utilization as util_ops
@@ -42,7 +43,23 @@ from kubernetes_autoscaler_tpu.ops.drain import (
     fetch_result,
     simulate_removals,
 )
+from kubernetes_autoscaler_tpu.ops.hostfetch import fetch_pytree
 from kubernetes_autoscaler_tpu.resourcequotas.tracker import QuotaTracker
+
+
+# post-placement device state: NEVER mirror-served, always fetched
+_ALWAYS_FETCH = ("nodes.alloc", "specs.count")
+
+
+def _mirror_hit(enc: "EncodedCluster", key: str, dev) -> bool:
+    """One definition of the mirror-substitution contract, shared by
+    `_hostarr` and the batched `Planner._fetch_host`: the mirror stands in
+    ONLY while `dev` is still the exact handed-out array (token identity),
+    and post-placement fields are excluded outright."""
+    h = enc.host_arrays
+    tok = enc.host_mirror_token
+    return (key not in _ALWAYS_FETCH and h is not None and tok is not None
+            and key in h and tok.get(key) is dev)
 
 
 def _hostarr(enc: "EncodedCluster", key: str, dev) -> np.ndarray:
@@ -53,12 +70,9 @@ def _hostarr(enc: "EncodedCluster", key: str, dev) -> np.ndarray:
     tensors (placement charging, upcoming-node injection, drainability) and
     the mirrors do not follow those replacements. nodes.alloc/specs.count
     are additionally excluded outright — post-placement state by design."""
-    assert key not in ("nodes.alloc", "specs.count")
-    h = enc.host_arrays
-    tok = enc.host_mirror_token
-    if h is not None and tok is not None and key in h \
-            and tok.get(key) is dev:
-        return np.asarray(h[key])
+    assert key not in _ALWAYS_FETCH
+    if _mirror_hit(enc, key, dev):
+        return np.asarray(enc.host_arrays[key])
     return np.asarray(dev)
 
 
@@ -83,6 +97,40 @@ class PlannerState:
     evictions_injected: int = 0
     evictions_uninjectable: int = 0
     injected_pods: list = field(default_factory=list)   # placed copies
+    # injection-prefilter observability: nodes that survived the dense
+    # prefilter (summed over pods) and nodes the exact oracle actually ran
+    # predicates on — the planner contract is oracle_nodes <= survivors
+    evictions_prefilter_survivors: int = 0
+    evictions_oracle_nodes: int = 0
+
+
+@dataclass
+class _MarshalArtifacts:
+    """Composition-keyed marshalling state for the native constrained tier,
+    reused across RunOnce iterations (the scale-down analog of
+    orchestrator._group_tensor_cache). Everything here depends only on group
+    COMPOSITION — which equivalence rows exist and their exemplars'
+    constraint content — never on pod counts or placements, so it survives
+    count-only churn untouched. The native kernel reads all of these as
+    const (kaconfirm.cc ConState); the count planes it mutates are copied
+    per call by the caller."""
+
+    fp: tuple
+    g_total: int
+    spread_kind: np.ndarray      # u8[G]
+    max_skew: np.ndarray         # i32[G]
+    spread_self: np.ndarray      # u8[G]
+    aff_kind: np.ndarray         # u8[G]
+    aff_self: np.ndarray         # u8[G]
+    has_anti_host: np.ndarray    # u8[G]
+    has_anti_zone: np.ndarray    # u8[G]
+    m_spread: np.ndarray         # u8[G, G]
+    m_anti_h: np.ndarray         # u8[G, G]
+    m_anti_z: np.ndarray         # u8[G, G]
+    m_aff: np.ndarray            # u8[G, G]
+    # groups whose constraints exceed the native tier's model; the pass must
+    # fall back to Python when any of them is actually routed this call
+    model_bad: np.ndarray        # bool[G]
 
 
 class Planner:
@@ -98,6 +146,37 @@ class Planner:
         self.state = PlannerState()
         self.pdb_tracker = pdb_tracker          # shared with the actuator
         self.latency_tracker = latency_tracker
+        # per-phase host-path accounting (metrics/phases.py); the autoscaler
+        # attaches its Registry so the breakdown rides /metrics too
+        self.phases = PhaseStats()
+        # dense prefilter for evicted-pod injection (tests flip this off to
+        # property-check plan equality against the unfiltered scan)
+        self.inject_prefilter = True
+        # constrained-tier marshal cache + the cached eligibility plane
+        self._marshal_cache: _MarshalArtifacts | None = None
+        self._elig_cache: tuple | None = None   # (key arrays, elig u8[G, N])
+        self.marshal_cache_hits = 0
+        self.marshal_cache_misses = 0
+        self.elig_cache_hits = 0
+        self.elig_cache_misses = 0
+
+    def _fetch_host(self, enc: EncodedCluster, items: dict) -> dict:
+        """Batched `_hostarr`: mirror hits are free; ALL misses ride one
+        `fetch_pytree` transfer instead of one device→host round trip each
+        (~70 ms per transfer over the TPU tunnel). `items` maps mirror key →
+        the device array to fall back to; `nodes.alloc`/`specs.count` are
+        always fetched (post-placement state — see the `_hostarr` contract)."""
+        out: dict[str, np.ndarray] = {}
+        miss: dict[str, object] = {}
+        for key, dev in items.items():
+            if _mirror_hit(enc, key, dev):
+                out[key] = np.asarray(enc.host_arrays[key])
+            else:
+                miss[key] = dev
+        if miss:
+            with self.phases.phase("fetch"):
+                out.update(fetch_pytree(miss))
+        return out
 
     # ---- evicted-pod anticipation (reference: injectRecentlyEvictedPods,
     # planner.go:230-260) ----
@@ -113,37 +192,83 @@ class Planner:
         predicates with device-true free capacity (cap − alloc, which already
         includes this loop's simulated placements), and the summed charge is
         applied to the node-allocation tensor in one device op. Pods that fit
-        nowhere are counted (the reference logs the same condition)."""
+        nowhere are counted (the reference logs the same condition).
+
+        Perf (ADVICE r5): each pod first narrows its candidates with one
+        dense numpy pass — the capacity row (free >= req) plus, for
+        non-lossy specs, the selector/taint planes
+        (ops/predicates.host_predicate_row) — and the exact oracle runs only
+        on the survivors, still in index order, so placements stay
+        byte-identical to the unfiltered scan. The prefilter only ever
+        DROPS nodes the oracle would reject (capacity/validity literally,
+        selector/taints exactly for non-lossy encodings); lossy specs fall
+        back to the capacity-only mask. `inject_prefilter=False` keeps the
+        unfiltered walk for A/B (tests/test_planner_hostpath.py)."""
         import copy as _copy
 
-        from kubernetes_autoscaler_tpu.models.encode import pod_request_vector
+        from kubernetes_autoscaler_tpu.models.encode import (
+            _encode_pod_spec,
+            pod_request_vector,
+        )
+        from kubernetes_autoscaler_tpu.ops.predicates import host_predicate_row
         from kubernetes_autoscaler_tpu.utils import oracle
+        from kubernetes_autoscaler_tpu.utils.oracle_cache import ConfirmOracle
 
-        cap = _hostarr(enc, "nodes.cap", enc.nodes.cap).astype(np.int64)
-        alloc = np.asarray(enc.nodes.alloc).astype(np.int64)
+        view = self._fetch_host(enc, {
+            "nodes.cap": enc.nodes.cap, "nodes.alloc": enc.nodes.alloc,
+            "nodes.valid": enc.nodes.valid, "nodes.ready": enc.nodes.ready,
+        })
+        cap = view["nodes.cap"].astype(np.int64)
+        alloc = view["nodes.alloc"].astype(np.int64)
         free = cap - alloc
-        ok_node = (np.asarray(_hostarr(enc, "nodes.valid", enc.nodes.valid))
-                   & np.asarray(_hostarr(enc, "nodes.ready", enc.nodes.ready)))
+        ok_node = view["nodes.valid"] & view["nodes.ready"]
         n_real = len(nodes)
         by_node: dict[str, list] = {}
         for q in enc.scheduled_pods:
             if q is None:
                 continue
             by_node.setdefault(q.node_name, []).append(q)
+        # constraint checks ride the incremental oracle world (O(domains)
+        # per verdict instead of an O(nodes × pods) walk per candidate);
+        # capacity stays on the device-true free tensor below, which the
+        # world cannot see. The world OWNS by_node from here (moves update
+        # both the lists and the domain counts).
+        world = ConfirmOracle(list(nodes), by_node, registry=enc.registry,
+                              namespaces=enc.namespaces)
+        by_node = world.pods_by_node
         delta = np.zeros_like(alloc)
         injected = failed = 0
         placed_pods: list = []
+        survivors = oracle_nodes = 0
+        label_hash = taint_exact = taint_key = None
+        if self.inject_prefilter:
+            planes = self._fetch_host(enc, {
+                "nodes.label_hash": enc.nodes.label_hash,
+                "nodes.taint_exact": enc.nodes.taint_exact,
+                "nodes.taint_key": enc.nodes.taint_key,
+            })
+            label_hash = planes["nodes.label_hash"][:n_real]
+            taint_exact = planes["nodes.taint_exact"][:n_real]
+            taint_key = planes["nodes.taint_key"][:n_real]
         for pod in pods:
             p = _copy.copy(pod)
             p.node_name = ""                      # ClearPodNodeNames
-            req, _lossy = pod_request_vector(p, enc.registry)
+            req, req_lossy = pod_request_vector(p, enc.registry)
+            if self.inject_prefilter:
+                mask = ok_node[:n_real] & (free[:n_real] >= req).all(axis=1)
+                spec = _encode_pod_spec(p, enc.dims)
+                if not (spec.lossy or req_lossy):
+                    mask &= host_predicate_row(label_hash, taint_exact,
+                                               taint_key, spec)
+                cand = [int(i) for i in np.nonzero(mask)[0]]
+            else:
+                cand = [i for i in range(n_real)
+                        if ok_node[i] and (free[i] >= req).all()]
+            survivors += len(cand)
             placed = False
-            for i in range(n_real):
-                if not ok_node[i]:
-                    continue
-                if not (free[i] >= req).all():
-                    continue
+            for i in cand:
                 nd = nodes[i]
+                oracle_nodes += 1
                 # predicate-only exact checks (capacity came from the
                 # device-true free tensor above, which check_pod_in_cluster's
                 # own resource pass cannot see)
@@ -155,18 +280,12 @@ class Planner:
                     continue
                 if not oracle.ports_free(p, by_node.get(nd.name, [])):
                     continue
-                if p.anti_affinity and not oracle.anti_affinity_ok(
-                        p, nd, nodes, by_node, enc.namespaces):
-                    continue
-                if p.pod_affinity and not oracle.pod_affinity_ok(
-                        p, nd, nodes, by_node, enc.namespaces):
-                    continue
-                if not oracle.spread_ok(p, nd, nodes, by_node):
+                if not world.check_constraints(p, nd):
                     continue
                 free[i] -= req
                 delta[i] += req
                 p.node_name = nd.name
-                by_node.setdefault(nd.name, []).append(p)
+                world.move(p, "", nd.name)
                 placed = True
                 break
             if placed:
@@ -174,6 +293,9 @@ class Planner:
                 placed_pods.append(p)
             else:
                 failed += 1
+        self.state.evictions_prefilter_survivors = survivors
+        self.state.evictions_oracle_nodes = oracle_nodes
+        self.phases.bump("inject_oracle_nodes", oracle_nodes)
         if injected:
             enc.nodes = enc.nodes.replace(
                 alloc=enc.nodes.alloc + jnp.asarray(delta, dtype=enc.nodes.alloc.dtype))
@@ -190,6 +312,8 @@ class Planner:
         self.state.evictions_injected = 0
         self.state.evictions_uninjectable = 0
         self.state.injected_pods = []
+        self.state.evictions_prefilter_survivors = 0
+        self.state.evictions_oracle_nodes = 0
         if inject_pods:
             self._inject_evicted(enc, nodes, inject_pods)
         n_real = len(nodes)
@@ -232,9 +356,12 @@ class Planner:
         # capped at max(ratio x cluster, min) via
         # --scale-down-candidates-pool-ratio, FAQ.md:1117).
         if eligible_idx:
-            sched_valid = _hostarr(enc, "scheduled.valid", enc.scheduled.valid)
+            sv = self._fetch_host(enc, {
+                "scheduled.valid": enc.scheduled.valid,
+                "scheduled.node_idx": enc.scheduled.node_idx,
+            })
             occupied = {
-                int(x) for x in _hostarr(enc, "scheduled.node_idx", enc.scheduled.node_idx)[sched_valid]
+                int(x) for x in sv["scheduled.node_idx"][sv["scheduled.valid"]]
             }
             prev = self.unneeded_nodes.since
             eligible_idx.sort(key=lambda i: (nodes[i].name not in prev,
@@ -276,20 +403,22 @@ class Planner:
         # The per-candidate device verdict is "in isolation"; the sequential
         # confirmation pass in nodes_to_delete() resolves interactions.
         dest_allowed = np.ones((enc.nodes.n,), dtype=bool)
-        removal = simulate_removals(
-            enc.nodes, enc.specs, enc.scheduled,
-            jnp.asarray(cand), jnp.asarray(dest_allowed),
-            max_pods_per_node=self.options.max_pods_per_node,
-            chunk=self.options.drain_chunk,
-            planes=enc.planes,
-            max_zones=enc.dims.max_zones,
-            with_constraints=enc.has_constraints,
-        )
+        with self.phases.phase("dispatch"):
+            removal = simulate_removals(
+                enc.nodes, enc.specs, enc.scheduled,
+                jnp.asarray(cand), jnp.asarray(dest_allowed),
+                max_pods_per_node=self.options.max_pods_per_node,
+                chunk=self.options.drain_chunk,
+                planes=enc.planes,
+                max_zones=enc.dims.max_zones,
+                with_constraints=enc.has_constraints,
+            )
         # ONE device->host transfer for the whole verdict (the fields are
         # consumed host-side here and in nodes_to_delete; per-leaf
         # device_get costs one tunnel round trip EACH — 7 leaves ≈ 0.5 s
         # per loop over the TPU tunnel)
-        removal = fetch_result(removal)
+        with self.phases.phase("fetch"):
+            removal = fetch_result(removal)
         drainable = np.asarray(removal.drainable)
         unneeded = []
         for k, i in enumerate(eligible_idx):
@@ -310,76 +439,128 @@ class Planner:
     def _mark(self, name: str, reason: str, now: float) -> None:
         self.unremovable.add(name, reason, now)
 
-    def _build_constraint_block(self, enc, feas, con_path, moved_groups,
-                                oracle_moved, one_per_node):
-        """Constrained-tier marshalling for the native pass: count planes
-        from the host mirrors, zone/eligibility tables, and group-to-group
-        match matrices from the equivalence exemplars. Returns None when a
-        routed group's constraints exceed the native tier's model (the
-        caller then falls back to the Python pass)."""
-        if not np.array_equal(con_path, oracle_moved | one_per_node):
-            raise ValueError(
-                "tier routing desynchronized: con_path must equal "
-                "need_exact | limit_g")
-        import jax
+    # ---- constrained-tier marshalling (cached across RunOnce loops) ----
 
-        from kubernetes_autoscaler_tpu.core.scaledown.native_confirm import (
-            ConstraintBlock,
+    @staticmethod
+    def _exemplar_sig(p) -> tuple:
+        """Constraint-content signature of one exemplar pod — everything the
+        G×G match matrices and the native-model validity bails read. Two
+        exemplars with equal signatures marshal identically, so a row whose
+        exemplar OBJECT churns (first member evicted, an equivalence-equal
+        sibling takes over) does not invalidate the cache."""
+        return (
+            p.namespace,
+            tuple(sorted(p.labels.items())),
+            tuple((int(c.max_skew), c.topology_key,
+                   tuple(sorted(c.match_labels.items())),
+                   tuple(c.match_label_keys or ()), int(c.min_domains),
+                   c.node_affinity_policy, c.node_taints_policy)
+                  for c in p.spread_constraints()),
+            tuple((t.topology_key, tuple(sorted(t.match_labels.items())),
+                   tuple(t.namespaces or ()),
+                   tuple(sorted(t.namespace_selector.items()))
+                   if t.namespace_selector is not None else None)
+                  for t in p.anti_affinity),
+            tuple((t.topology_key, tuple(sorted(t.match_labels.items())),
+                   tuple(t.namespaces or ()),
+                   tuple(sorted(t.namespace_selector.items()))
+                   if t.namespace_selector is not None else None)
+                  for t in p.pod_affinity),
         )
+
+    def _exemplars_and_fp(self, enc, g_total: int) -> tuple[dict, tuple]:
+        """Exemplar pod per equivalence row (resident first, then pending —
+        identical pick order to the old per-call scan, but the resident scan
+        is one numpy unique over the group_ref mirror instead of a Python
+        walk over every scheduled pod) + the composition fingerprint that
+        keys the marshal cache."""
+        exemplars: dict[int, object] = {}
+        view = self._fetch_host(enc, {
+            "scheduled.group_ref": enc.scheduled.group_ref,
+            "scheduled.valid": enc.scheduled.valid,
+        })
+        grf = view["scheduled.group_ref"]
+        # occupied slot ⇔ valid (freed slots drop pod AND valid together —
+        # models/incremental._remove_resident; full encode pads valid False)
+        m = min(len(enc.scheduled_pods), grf.shape[0])
+        nz = np.nonzero(view["scheduled.valid"][:m])[0]
+        if nz.size:
+            uniq, first = np.unique(grf[:m][nz], return_index=True)
+            for r, k in zip(uniq, first):
+                p = enc.scheduled_pods[int(nz[k])]
+                if p is not None:      # defensive: hole despite valid
+                    exemplars[int(r)] = p
+        for row, idxs in enumerate(enc.group_pods):
+            if idxs:
+                exemplars.setdefault(row, enc.pending_pods[idxs[0]])
+        ns_sig = (None if enc.namespaces is None else
+                  tuple(sorted((ns, tuple(sorted(lbls.items())))
+                               for ns, lbls in enc.namespaces.items())))
+        fp = (g_total,
+              tuple(sorted((row, self._exemplar_sig(p))
+                           for row, p in exemplars.items())),
+              ns_sig)
+        return exemplars, fp
+
+    def _marshal_artifacts(self, enc, feas) -> _MarshalArtifacts:
+        """The G×G matrices + per-group constraint vectors for the native
+        tier, rebuilt only when group COMPOSITION changes (count-only churn
+        is a cache hit — acceptance-tested by test_planner_hostpath)."""
         from kubernetes_autoscaler_tpu.models.api import (
             labels_match,
             term_matches_pod,
         )
-        from kubernetes_autoscaler_tpu.ops import predicates as preds
         from kubernetes_autoscaler_tpu.utils.oracle import (
             HOSTNAME_KEY,
             ZONE_KEY,
             ZONE_KEY_BETA,
         )
 
-        if enc.specs.spread_kind is None:
-            return None    # constraint tensors absent -> python pass decides
         g_total = feas.shape[0]
-        # exemplar pod per equivalence row (resident or pending)
-        exemplars: dict[int, object] = {}
-        grf = _hostarr(enc, "scheduled.group_ref", enc.scheduled.group_ref)
-        for j, p in enumerate(enc.scheduled_pods):
-            if p is not None:
-                exemplars.setdefault(int(grf[j]), p)
-        for row, idxs in enumerate(enc.group_pods):
-            if idxs:
-                exemplars.setdefault(row, enc.pending_pods[idxs[0]])
+        exemplars, fp = self._exemplars_and_fp(enc, g_total)
+        art = self._marshal_cache
+        if art is not None and art.fp == fp:
+            self.marshal_cache_hits += 1
+            self.phases.bump("marshal_cache_hit")
+            return art
+        self.marshal_cache_misses += 1
+        self.phases.bump("marshal_cache_miss")
 
-        sk = _hostarr(enc, "specs.spread_kind", enc.specs.spread_kind)
+        view = self._fetch_host(enc, {
+            "specs.spread_kind": enc.specs.spread_kind,
+            "specs.max_skew": enc.specs.max_skew,
+            "specs.spread_self": enc.specs.spread_self,
+            "specs.aff_kind": enc.specs.aff_kind,
+            "specs.aff_self": enc.specs.aff_self,
+        })
+        sk = view["specs.spread_kind"]
         spread_kind = np.where((sk == 1) | (sk == 2), sk, 0).astype(np.uint8)
-        max_skew = _hostarr(enc, "specs.max_skew",
-                            enc.specs.max_skew).astype(np.int32)
-        spread_self = _hostarr(enc, "specs.spread_self",
-                               enc.specs.spread_self).astype(np.uint8)
-        ak = _hostarr(enc, "specs.aff_kind", enc.specs.aff_kind)
+        max_skew = view["specs.max_skew"].astype(np.int32)
+        spread_self = view["specs.spread_self"].astype(np.uint8)
+        ak = view["specs.aff_kind"]
         aff_kind = np.where((ak == 1) | (ak == 2), ak, 0).astype(np.uint8)
-        aff_self = _hostarr(enc, "specs.aff_self",
-                            enc.specs.aff_self).astype(np.uint8)
+        aff_self = view["specs.aff_self"].astype(np.uint8)
         has_anti_host = np.zeros((g_total,), np.uint8)
         has_anti_zone = np.zeros((g_total,), np.uint8)
         m_spread = np.zeros((g_total, g_total), np.uint8)
         m_anti_h = np.zeros((g_total, g_total), np.uint8)
         m_anti_z = np.zeros((g_total, g_total), np.uint8)
         m_aff = np.zeros((g_total, g_total), np.uint8)
+        model_bad = np.zeros((g_total,), bool)
         zone_keys = (ZONE_KEY, ZONE_KEY_BETA)
-        moved_set = {int(x) for x in moved_groups}
         for a, ex_a in exemplars.items():
-            # the strict validity bails apply only to groups that will
-            # actually PLACE pods this pass — an exotic constraint on an
-            # unmoved group must not push the whole confirm off the native
-            # tier (its counts still track; its checks never run)
-            routed = bool(con_path[a]) and a in moved_set
+            # shapes beyond the tier's model are FLAGGED, not bailed on:
+            # whether they sink the native pass depends on this call's
+            # routing, which the cached artifacts must stay independent of
+            # (an exotic constraint on an unmoved group must not push the
+            # whole confirm off the native tier — its counts still track;
+            # its checks never run)
             if spread_kind[a]:
                 cons = ex_a.spread_constraints()
-                if routed and (len(cons) != 1 or int(cons[0].min_domains) > 1
-                               or cons[0].node_affinity_policy != "Honor"
-                               or cons[0].node_taints_policy != "Ignore"):
-                    return None     # beyond the tier's model
+                if (len(cons) != 1 or int(cons[0].min_domains) > 1
+                        or cons[0].node_affinity_policy != "Honor"
+                        or cons[0].node_taints_policy != "Ignore"):
+                    model_bad[a] = True     # beyond the tier's model
                 if cons:
                     sel = cons[0].merged_selector(ex_a.labels)
                     for b, ex_b in exemplars.items():
@@ -387,9 +568,9 @@ class Planner:
                                           and labels_match(sel, ex_b.labels))
             if aff_kind[a] and ex_a.pod_affinity:
                 term = ex_a.pod_affinity[0]
-                if routed and (len(ex_a.pod_affinity) > 1
-                               or term.namespace_selector is not None):
-                    return None     # lossy shapes (defensive: hostcheck'd)
+                if (len(ex_a.pod_affinity) > 1
+                        or term.namespace_selector is not None):
+                    model_bad[a] = True     # lossy shapes (defensive: hostcheck'd)
                 for b, ex_b in exemplars.items():
                     m_aff[a, b] = term_matches_pod(term, ex_a, ex_b,
                                                    enc.namespaces)
@@ -399,8 +580,8 @@ class Planner:
                     host_terms.append(t)
                 elif t.topology_key in zone_keys:
                     zone_terms.append(t)
-                elif routed:
-                    return None     # unmodeled topology key on a routed group
+                else:
+                    model_bad[a] = True     # unmodeled topology key
             has_anti_host[a] = bool(host_terms)
             has_anti_zone[a] = bool(zone_terms)
             if not host_terms and not zone_terms:
@@ -412,48 +593,122 @@ class Planner:
                 if any(term_matches_pod(t, ex_a, ex_b, enc.namespaces)
                        for t in zone_terms):
                     m_anti_z[a, b] = 1
+        art = _MarshalArtifacts(
+            fp=fp, g_total=g_total,
+            spread_kind=spread_kind, max_skew=max_skew,
+            spread_self=spread_self, aff_kind=aff_kind, aff_self=aff_self,
+            has_anti_host=has_anti_host, has_anti_zone=has_anti_zone,
+            m_spread=np.ascontiguousarray(m_spread),
+            m_anti_h=np.ascontiguousarray(m_anti_h),
+            m_anti_z=np.ascontiguousarray(m_anti_z),
+            m_aff=np.ascontiguousarray(m_aff),
+            model_bad=model_bad,
+        )
+        self._marshal_cache = art
+        return art
+
+    def _elig_plane(self, enc) -> np.ndarray:
+        """selector_match × node validity, fetched from the device once per
+        NODE/SPEC-TENSOR identity: the loop replaces whole tensors when node
+        labels, validity or group selectors change (and only then), so
+        holding the array refs and comparing with `is` is exact — the same
+        contract `_hostarr`'s mirror token uses. Saves one device dispatch +
+        one tunnel round trip per confirm on the steady path."""
+        import jax
+
+        from kubernetes_autoscaler_tpu.ops import predicates as preds
+
+        key = (enc.nodes.label_hash, enc.nodes.valid,
+               enc.specs.sel_req, enc.specs.sel_neg)
+        cached = self._elig_cache
+        if cached is not None and len(cached[0]) == len(key) and all(
+                a is b for a, b in zip(cached[0], key)):
+            self.elig_cache_hits += 1
+            self.phases.bump("elig_cache_hit")
+            return cached[1]
+        self.elig_cache_misses += 1
+        self.phases.bump("elig_cache_miss")
+        with self.phases.phase("dispatch"):
+            sel_dev = preds.selector_match(enc.nodes.label_hash, enc.specs)
+        with self.phases.phase("fetch"):
+            sel = np.asarray(jax.device_get(sel_dev))
+        elig = sel & _hostarr(enc, "nodes.valid", enc.nodes.valid)[None, :]
+        elig = np.ascontiguousarray(elig.astype(np.uint8))
+        self._elig_cache = (key, elig)
+        return elig
+
+    def _build_constraint_block(self, enc, feas, con_path, moved_groups,
+                                oracle_moved, one_per_node):
+        """Constrained-tier marshalling for the native pass: count planes
+        from the host mirrors, zone/eligibility tables, and group-to-group
+        match matrices from the equivalence exemplars — the matrices and
+        eligibility plane come from the cross-loop caches above. Returns
+        None when a routed group's constraints exceed the native tier's
+        model (the caller then falls back to the Python pass)."""
+        if not np.array_equal(con_path, oracle_moved | one_per_node):
+            raise ValueError(
+                "tier routing desynchronized: con_path must equal "
+                "need_exact | limit_g")
+        from kubernetes_autoscaler_tpu.core.scaledown.native_confirm import (
+            ConstraintBlock,
+        )
+
+        if enc.specs.spread_kind is None:
+            return None    # constraint tensors absent -> python pass decides
+        g_total = feas.shape[0]
+        art = self._marshal_artifacts(enc, feas)
+        # the strict validity bails apply only to groups that will actually
+        # PLACE pods this pass (routed = con_path ∩ moved)
+        routed = np.zeros((g_total,), bool)
+        mg = np.asarray(moved_groups, dtype=np.int64)
+        if mg.size:
+            routed[mg[mg < g_total]] = True
+        routed &= con_path.astype(bool)
+        if bool((art.model_bad & routed).any()):
+            return None     # beyond the tier's model — python pass decides
 
         if enc.planes is None:
             # no count planes -> the tier would start every domain at zero
             # and under-count residents; the Python oracle pass decides
             return None
-        elig = (np.asarray(jax.device_get(preds.selector_match(
-            enc.nodes.label_hash, enc.specs)))
-            & _hostarr(enc, "nodes.valid", enc.nodes.valid)[None, :])
-        cnt_node = np.ascontiguousarray(
-            _hostarr(enc, "planes.spread_cnt", enc.planes.spread_cnt),
-            np.int32).copy()
-        anti_host_node = np.ascontiguousarray(
-            _hostarr(enc, "planes.anti_host_cnt",
-                     enc.planes.anti_host_cnt), np.int32).copy()
-        anti_zone_node = np.ascontiguousarray(
-            _hostarr(enc, "planes.anti_zone_cnt",
-                     enc.planes.anti_zone_cnt), np.int32).copy()
-        aff_node = np.ascontiguousarray(
-            _hostarr(enc, "planes.aff_cnt", enc.planes.aff_cnt),
-            np.int32).copy()
+        elig = self._elig_plane(enc)
+        planes = self._fetch_host(enc, {
+            "planes.spread_cnt": enc.planes.spread_cnt,
+            "planes.anti_host_cnt": enc.planes.anti_host_cnt,
+            "planes.anti_zone_cnt": enc.planes.anti_zone_cnt,
+            "planes.aff_cnt": enc.planes.aff_cnt,
+            "nodes.zone_id": enc.nodes.zone_id,
+        })
+        # per-call COPIES: the kernel mutates the count planes in place
+        cnt_node = np.ascontiguousarray(planes["planes.spread_cnt"],
+                                        np.int32).copy()
+        anti_host_node = np.ascontiguousarray(planes["planes.anti_host_cnt"],
+                                              np.int32).copy()
+        anti_zone_node = np.ascontiguousarray(planes["planes.anti_zone_cnt"],
+                                              np.int32).copy()
+        aff_node = np.ascontiguousarray(planes["planes.aff_cnt"],
+                                        np.int32).copy()
         return ConstraintBlock(
             one_per_node=np.ascontiguousarray(one_per_node.astype(np.uint8)),
             oracle_moved=np.ascontiguousarray(oracle_moved.astype(np.uint8)),
             n_zones=int(enc.dims.max_zones),
-            zone_id=np.ascontiguousarray(
-                _hostarr(enc, "nodes.zone_id", enc.nodes.zone_id), np.int32),
-            spread_kind=spread_kind,
-            max_skew=max_skew,
-            spread_self=spread_self,
-            has_anti_host=has_anti_host,
-            has_anti_zone=has_anti_zone,
-            aff_kind=aff_kind,
-            aff_self=aff_self,
-            elig=np.ascontiguousarray(elig.astype(np.uint8)),
+            zone_id=np.ascontiguousarray(planes["nodes.zone_id"], np.int32),
+            spread_kind=art.spread_kind,
+            max_skew=art.max_skew,
+            spread_self=art.spread_self,
+            has_anti_host=art.has_anti_host,
+            has_anti_zone=art.has_anti_zone,
+            aff_kind=art.aff_kind,
+            aff_self=art.aff_self,
+            elig=elig,
             cnt_node=cnt_node,
             anti_host_node=anti_host_node,
             anti_zone_node=anti_zone_node,
             aff_node=aff_node,
-            m_spread=np.ascontiguousarray(m_spread),
-            m_anti_h=np.ascontiguousarray(m_anti_h),
-            m_anti_z=np.ascontiguousarray(m_anti_z),
-            m_aff=np.ascontiguousarray(m_aff),
+            m_spread=art.m_spread,
+            m_anti_h=art.m_anti_h,
+            m_anti_z=art.m_anti_z,
+            m_aff=art.m_aff,
             con_path=np.ascontiguousarray(con_path.astype(np.uint8)),
         )
 
@@ -462,10 +717,11 @@ class Planner:
                              ds_by_node, feas, node_valid, greq, pod_slot,
                              movable_f, group_ref, now, pdbs=(),
                              con_needed=False, need_exact=None, limit_g=None,
-                             moved_groups=None):
+                             moved_groups=None, *, host):
         """Marshal the pre-screened candidate list into the C++ pass. PDB
         budgets ride as a per-slot multi-word membership bitmask (any
-        count) — the all-PDB cluster stays on the millisecond native path."""
+        count) — the all-PDB cluster stays on the millisecond native path.
+        `host` is the caller's batched host view (nodes.cap/alloc/valid)."""
         from kubernetes_autoscaler_tpu.core.scaledown import native_confirm
 
         con = None
@@ -473,10 +729,11 @@ class Planner:
             # route exactly the groups the Python pass would run through the
             # oracle (need_exact | limit_g) through the native per-pod tier
             con_path = (need_exact | limit_g)
-            con = self._build_constraint_block(enc, feas, con_path,
-                                               moved_groups,
-                                               oracle_moved=need_exact,
-                                               one_per_node=limit_g)
+            with self.phases.phase("marshal"):
+                con = self._build_constraint_block(enc, feas, con_path,
+                                                   moved_groups,
+                                                   oracle_moved=need_exact,
+                                                   one_per_node=limit_g)
             if con is None:
                 return None      # beyond the tier — python pass decides
 
@@ -526,14 +783,15 @@ class Planner:
         slot_groups = group_ref[flat].astype(np.int32)
 
         quota_totals = quota_min = None
-        node_cap = _hostarr(enc, "nodes.cap", enc.nodes.cap).astype(np.int64)
+        node_cap = host["nodes.cap"].astype(np.int64)
         if self.quota is not None:
-            cap_sum = node_cap[_hostarr(enc, "nodes.valid", enc.nodes.valid)].sum(axis=0)
+            cap_sum = node_cap[host["nodes.valid"]].sum(axis=0)
             quota_totals = cap_sum.astype(np.int64)
             quota_min = self._quota_min_vector(enc)
 
-        free = (np.asarray(enc.nodes.cap)
-                - np.asarray(enc.nodes.alloc)).astype(np.int64)
+        # cap from the batched view; alloc is the device-true value the same
+        # single fetch brought back (post-placement state, `_hostarr` contract)
+        free = (node_cap - host["nodes.alloc"].astype(np.int64))
         group_room = np.asarray(room_vals, np.int32)
         max_slot = int(slot_ids.max()) if slot_ids.size else 0
         slot_pdb_mask = pdb_remaining = None
@@ -567,20 +825,21 @@ class Planner:
             # — concurrent actuator drains may have deducted already
             pdb_remaining = np.asarray(
                 self.pdb_tracker.remaining_snapshot(), np.int64)
-        accept, reason, dest = native_confirm.confirm(
-            free, feas, node_valid, greq,
-            np.asarray(cand_node, np.int32),
-            slot_ids, slot_groups,
-            slot_off.astype(np.int32),
-            np.asarray(cand_group_idx, np.int32),
-            group_room, quota_totals, quota_min, node_cap,
-            self.options.max_empty_bulk_delete,
-            self.options.max_drain_parallelism,
-            self.options.max_scale_down_parallelism,
-            max_slot,
-            slot_pdb_mask=slot_pdb_mask, pdb_remaining=pdb_remaining,
-            con=con,
-        )
+        with self.phases.phase("confirm"):
+            accept, reason, dest = native_confirm.confirm(
+                free, feas, node_valid, greq,
+                np.asarray(cand_node, np.int32),
+                slot_ids, slot_groups,
+                slot_off.astype(np.int32),
+                np.asarray(cand_group_idx, np.int32),
+                group_room, quota_totals, quota_min, node_cap,
+                self.options.max_empty_bulk_delete,
+                self.options.max_drain_parallelism,
+                self.options.max_scale_down_parallelism,
+                max_slot,
+                slot_pdb_mask=slot_pdb_mask, pdb_remaining=pdb_remaining,
+                con=con,
+            )
         reasons = {1: "NoPlaceToMovePods", 2: "NodeGroupMinSizeReached",
                    3: "MinimalResourceLimitExceeded", 5: "NotEnoughPdb"}
         out: list[NodeToRemove] = []
@@ -616,7 +875,10 @@ class Planner:
         pod usage excluded per the flags (reference: utilization/info.go
         CalculateUtilization skipDaemonSetPods/skipMirrorPods)."""
         n_real = len(nodes)
-        util = np.asarray(util_ops.node_utilization(enc.nodes))[:n_real]
+        with self.phases.phase("dispatch"):
+            util_dev = util_ops.node_utilization(enc.nodes)
+        with self.phases.phase("fetch"):
+            util = np.asarray(util_dev)[:n_real]
         defaults = _ng_defaults(self.options)
         ignore_mirror = self.options.ignore_mirror_pods_utilization
         ignore_ds_ids: set[int] = set()
@@ -633,9 +895,14 @@ class Planner:
             return util
         from kubernetes_autoscaler_tpu.models.resources import CPU, MEMORY
 
-        cap = _hostarr(enc, "nodes.cap", enc.nodes.cap).astype(np.float64)[:n_real]
-        alloc = np.asarray(enc.nodes.alloc, dtype=np.float64)[:n_real].copy()
-        reqs = _hostarr(enc, "scheduled.req", enc.scheduled.req).astype(np.float64)
+        view = self._fetch_host(enc, {
+            "nodes.cap": enc.nodes.cap,
+            "nodes.alloc": enc.nodes.alloc,
+            "scheduled.req": enc.scheduled.req,
+        })
+        cap = view["nodes.cap"].astype(np.float64)[:n_real]
+        alloc = view["nodes.alloc"].astype(np.float64)[:n_real].copy()
+        reqs = view["scheduled.req"].astype(np.float64)
         for j, p in enumerate(enc.scheduled_pods):
             if p is None:  # freed slot (incremental encoder hole)
                 continue
@@ -677,10 +944,39 @@ class Planner:
         # moves are committed into the working snapshot before the next
         # candidate is simulated, simulator/cluster.go:174-188), which the
         # independent per-candidate device sweep deliberately omits.
-        reqs = _hostarr(enc, "scheduled.req", enc.scheduled.req)
-        greq = _hostarr(enc, "specs.req", enc.specs.req)
-        group_ref = _hostarr(enc, "scheduled.group_ref", enc.scheduled.group_ref)
-        movable_f = _hostarr(enc, "scheduled.movable", enc.scheduled.movable)
+        # ONE batched host view for everything the confirmation pass reads:
+        # mirror hits are free, every miss (always nodes.alloc; every key on
+        # the non-incremental path once the loop replaced a tensor) shares a
+        # single fetch_pytree transfer instead of one round trip each
+        items: dict[str, object] = {
+            "scheduled.req": enc.scheduled.req,
+            "specs.req": enc.specs.req,
+            "scheduled.group_ref": enc.scheduled.group_ref,
+            "scheduled.movable": enc.scheduled.movable,
+            "scheduled.valid": enc.scheduled.valid,
+            "specs.needs_host_check": enc.specs.needs_host_check,
+            "nodes.valid": enc.nodes.valid,
+            "nodes.ready": enc.nodes.ready,
+            "nodes.schedulable": enc.nodes.schedulable,
+            "nodes.cap": enc.nodes.cap,
+            "nodes.alloc": enc.nodes.alloc,
+        }
+        if enc.specs.spread_kind is not None:
+            items.update({
+                "specs.spread_kind": enc.specs.spread_kind,
+                "specs.aff_kind": enc.specs.aff_kind,
+                "specs.anti_self_zone": enc.specs.anti_self_zone,
+            })
+        if enc.planes is not None:
+            items.update({
+                "planes.anti_host_cnt": enc.planes.anti_host_cnt,
+                "planes.anti_zone_cnt": enc.planes.anti_zone_cnt,
+            })
+        host = self._fetch_host(enc, items)
+        reqs = host["scheduled.req"]
+        greq = host["specs.req"]
+        group_ref = host["scheduled.group_ref"]
+        movable_f = host["scheduled.movable"]
         h = enc.host_arrays
         if h is not None and "specs.anti_affinity_self" in h:
             # one_per_node from the mirrors (a device compute + fetch saved)
@@ -692,20 +988,20 @@ class Planner:
         # encodings and topology-coupled constraints — get every destination
         # double-checked by the exact oracle during confirmation (the analog
         # of the reference running real scheduler plugins for each move).
-        need_exact = _hostarr(enc, "specs.needs_host_check", enc.specs.needs_host_check).copy()
+        need_exact = host["specs.needs_host_check"].copy()
         if enc.specs.spread_kind is not None:
-            need_exact |= (_hostarr(enc, "specs.spread_kind", enc.specs.spread_kind) > 0)
-            need_exact |= (_hostarr(enc, "specs.aff_kind", enc.specs.aff_kind) > 0)
-            need_exact |= _hostarr(enc, "specs.anti_self_zone", enc.specs.anti_self_zone)
+            need_exact |= (host["specs.spread_kind"] > 0)
+            need_exact |= (host["specs.aff_kind"] > 0)
+            need_exact |= host["specs.anti_self_zone"]
         if enc.planes is not None:
-            need_exact |= _hostarr(enc, "planes.anti_host_cnt", enc.planes.anti_host_cnt).sum(axis=1) > 0
-            need_exact |= _hostarr(enc, "planes.anti_zone_cnt", enc.planes.anti_zone_cnt).sum(axis=1) > 0
+            need_exact |= host["planes.anti_host_cnt"].sum(axis=1) > 0
+            need_exact |= host["planes.anti_zone_cnt"].sum(axis=1) > 0
         # same destination gates the device sweep applies (ops/drain.py):
         # valid & ready & schedulable — a cordoned or unready node must not
         # absorb paper capacity during confirmation
-        node_valid = (_hostarr(enc, "nodes.valid", enc.nodes.valid)
-                      & _hostarr(enc, "nodes.ready", enc.nodes.ready)
-                      & _hostarr(enc, "nodes.schedulable", enc.nodes.schedulable))
+        node_valid = (host["nodes.valid"]
+                      & host["nodes.ready"]
+                      & host["nodes.schedulable"])
         ds_by_node: dict[str, list[int]] = {}
         for j, p in enumerate(enc.scheduled_pods):
             if p is None:  # freed slot (incremental encoder hole)
@@ -770,10 +1066,9 @@ class Planner:
             from kubernetes_autoscaler_tpu.core.scaledown import native_confirm
 
             moved_groups = np.unique(group_ref[
-                _hostarr(enc, "scheduled.valid", enc.scheduled.valid) & movable_f])
+                host["scheduled.valid"] & movable_f])
             if moved_groups.size:
-                hostcheck = _hostarr(enc, "specs.needs_host_check",
-                                     enc.specs.needs_host_check)
+                hostcheck = host["specs.needs_host_check"]
                 # spread (host/zone), anti-affinity (host/zone), required
                 # pod affinity AND one-per-node port/anti groups are all
                 # native now; only lossy shapes (hostcheck) route to the
@@ -792,7 +1087,7 @@ class Planner:
                     feas, node_valid, greq, pod_slot, movable_f, group_ref,
                     now, pdbs, con_needed=con_needed,
                     need_exact=need_exact, limit_g=limit_g,
-                    moved_groups=moved_groups)
+                    moved_groups=moved_groups, host=host)
                 if out is not None:
                     return out
 
@@ -811,13 +1106,14 @@ class Planner:
 
         _trace = _os.environ.get("KA_CONFIRM_TRACE")
 
+        # cap from the host mirror; alloc is the device-true value the
+        # batched view fetched once for the whole confirmation (the device
+        # state cannot change mid-pass — attempts re-COPY, never re-fetch)
+        free_base = (host["nodes.cap"].astype(np.int64)
+                     - host["nodes.alloc"].astype(np.int64))
+
         def attempt(names: list[str]) -> tuple[list[NodeToRemove], dict[int, int], set[str]]:
-
-
-            # cap from the host mirror; alloc MUST be the device value
-            # (post-placement capacity, see _hostarr contract)
-            free = (_hostarr(enc, "nodes.cap", enc.nodes.cap)
-                    - np.asarray(enc.nodes.alloc)).astype(np.int64)
+            free = free_base.copy()
             deleted_mask = np.zeros((enc.nodes.n,), dtype=bool)
             # Incremental fits cache: fits_m[g, n] = predicate plane AND
             # capacity, built once (G x N x R) and patched per move (only the
@@ -1116,7 +1412,8 @@ class Planner:
         while True:
             names = [n for n in ordered
                      if node_gid.get(n) not in excluded_gids]
-            out, final_dest, dropped = attempt(names)
+            with self.phases.phase("confirm"):
+                out, final_dest, dropped = attempt(names)
             if not dropped:
                 break
             # the failed group's budget/capacity consumption poisoned the

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Protocol
+from typing import Callable, Protocol
 
 import numpy as np
 
@@ -84,10 +84,17 @@ class StaticAutoscaler:
         expander_priorities: dict[int, list[str]] | None = None,
         debugging_snapshotter=None,
         status_sink=None,
+        walltime: Callable[[], float] = time.time,
     ):
         self.options = options or AutoscalingOptions()
         self.provider = provider
         self.source = source
+        # the RunOnce `now` domain (wall clock in production, logical time in
+        # harnesses). Threaded into the Actuator so eviction timestamps live
+        # in the SAME domain run_once(now=...) prunes recent_evictions with —
+        # otherwise the 15-min eviction TTL never fires under logical time
+        # and unknown-owner phantoms are re-injected forever (ADVICE r5)
+        self.walltime = walltime
         self.processors = processors or AutoscalingProcessors.default()
         self.metrics = registry or default_registry
         self.health = HealthCheck(
@@ -158,10 +165,13 @@ class StaticAutoscaler:
         self.planner = Planner(provider, self.options, None,
                                pdb_tracker=self.pdb_tracker,
                                latency_tracker=self.latency_tracker)
+        # per-phase host-path breakdown rides the normal metrics exposition
+        self.planner.phases.registry = self.metrics
         self._async_group_of: dict[str, str] = {}
         self.actuator = Actuator(provider, self.options, eviction_sink,
                                  pdb_tracker=self.pdb_tracker,
-                                 latency_tracker=self.latency_tracker)
+                                 latency_tracker=self.latency_tracker,
+                                 walltime=walltime)
         # pods on still-draining nodes join the pending list pre-scale-up
         # (reference chain slot: after the expendable filter,
         # pod_list_processor.go:28-32)
@@ -227,7 +237,7 @@ class StaticAutoscaler:
     # ---- the loop body (reference: RunOnce :296) ----
 
     def run_once(self, now: float | None = None) -> RunOnceStatus:
-        now = time.time() if now is None else now
+        now = self.walltime() if now is None else now
         try:
             return self._run_once_inner(now)
         except Exception as e:
@@ -377,7 +387,8 @@ class StaticAutoscaler:
             # sources without Namespace objects leave it None
             list_ns = getattr(self.source, "list_namespaces", None)
             ns_labels = list_ns() if list_ns is not None else None
-            with self.metrics.time_function("snapshot_build"):
+            with self.metrics.time_function("snapshot_build"), \
+                    self.planner.phases.phase("encode"):
                 if self.options.incremental_encode:
                     if self._encoder is None or \
                             self._encoder.drain_opts != drain_opts:

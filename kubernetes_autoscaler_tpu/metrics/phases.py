@@ -1,0 +1,66 @@
+"""Per-phase wall-clock accounting for the planner's host path.
+
+The scale-down hot loop crosses five distinct cost domains each RunOnce —
+  encode    host objects → tensors (models/encode, models/incremental)
+  dispatch  device program launches (drain sweep, predicate planes)
+  fetch     device → host transfers (ops/hostfetch batched fetches)
+  marshal   host-side numpy marshalling for the native confirm tier
+  confirm   the confirmation pass itself (native C++ or Python fallback)
+— and a single opaque per-loop number cannot say which one regressed.
+`PhaseStats` is a zero-dependency accumulator the planner owns; it ALSO
+mirrors observations into a metrics.Registry histogram
+(`planner_phase_seconds{phase=...}`) when one is attached, so the breakdown
+rides the normal exposition path. bench.py prints `snapshot()` next to the
+headline p50 so the metric ships with its per-phase decomposition.
+
+Phases may nest (a mirror miss inside `marshal` opens a `fetch` span);
+totals then overlap — they are per-domain costs, not a partition of wall
+clock. `events` is a free-form counter side-channel for cache hit/miss
+accounting (the marshal cache, the elig-plane cache, oracle-call counts).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+PHASES = ("encode", "dispatch", "fetch", "marshal", "confirm")
+
+
+@dataclass
+class PhaseStats:
+    totals_s: dict[str, float] = field(default_factory=dict)
+    counts: dict[str, int] = field(default_factory=dict)
+    events: dict[str, int] = field(default_factory=dict)
+    registry: object | None = None      # optional metrics.Registry
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals_s[name] = self.totals_s.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+            if self.registry is not None:
+                self.registry.histogram("planner_phase_seconds").observe(
+                    dt, phase=name)
+
+    def bump(self, event: str, n: int = 1) -> None:
+        self.events[event] = self.events.get(event, 0) + n
+
+    def snapshot(self) -> dict:
+        """One JSON-friendly view: per-phase totals (ms) + spans + events."""
+        return {
+            "totals_ms": {k: round(v * 1000.0, 3)
+                          for k, v in sorted(self.totals_s.items())},
+            "spans": dict(sorted(self.counts.items())),
+            "events": dict(sorted(self.events.items())),
+        }
+
+    def reset(self) -> None:
+        self.totals_s.clear()
+        self.counts.clear()
+        self.events.clear()

@@ -189,6 +189,18 @@ def semantic_view(enc: EncodedCluster) -> dict:
 
     sched = {}
     live_rows = set()
+    # slot/valid coherence is part of the contract: an occupied slot is
+    # valid and a freed slot dropped its pod (the scale-down planner's
+    # vectorized exemplar scan, planner._exemplars_and_fp, selects slots by
+    # the valid mirror alone and would silently mis-marshal on a desync).
+    # Reported as view content — NOT asserted — so the sampled runtime
+    # verify treats a desync like any other divergence: log + resync,
+    # not a hard loop failure.
+    n_slots = min(len(enc.scheduled_pods), h["scheduled.valid"].shape[0])
+    slot_desync = tuple(
+        j for j in range(n_slots)
+        if (enc.scheduled_pods[j] is not None)
+        != bool(h["scheduled.valid"][j]))
     for j, p in enumerate(enc.scheduled_pods):
         if p is None or not bool(h["scheduled.valid"][j]):
             continue
@@ -219,14 +231,15 @@ def semantic_view(enc: EncodedCluster) -> dict:
                 name = enc.node_names[i] if i < len(enc.node_names) else f"?{i}"
                 k = (sig, f, name)
                 planes[k] = planes.get(k, 0) + int(arr[i])
-    return {"nodes": nodes, "sched": sched, "pend": pend, "planes": planes}
+    return {"nodes": nodes, "sched": sched, "pend": pend, "planes": planes,
+            "slot_desync": {j: True for j in slot_desync}}
 
 
 def semantic_diff(a: EncodedCluster, b: EncodedCluster) -> str | None:
     """None when semantically equal, else a description of the first
     diverging part (keys only — values can be large)."""
     va, vb = semantic_view(a), semantic_view(b)
-    for part in ("nodes", "sched", "pend", "planes"):
+    for part in ("nodes", "sched", "pend", "planes", "slot_desync"):
         if va[part] != vb[part]:
             only_a = {k for k, v in va[part].items() if vb[part].get(k) != v}
             only_b = {k for k, v in vb[part].items() if va[part].get(k) != v}

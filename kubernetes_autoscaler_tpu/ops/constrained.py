@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from kubernetes_autoscaler_tpu.models.cluster_state import (
@@ -43,7 +44,12 @@ from kubernetes_autoscaler_tpu.models.cluster_state import (
 )
 from kubernetes_autoscaler_tpu.ops.pack import fit_count
 
-BIG = jnp.int32(1 << 28)
+# a CONCRETE numpy scalar, deliberately not jnp: this module is imported
+# lazily from inside jitted bodies (ops/drain.py, ops/binpack.py), and a
+# module-level jnp constant created mid-trace would be a leaked tracer that
+# poisons every later trace (UnexpectedTracerError — surfaced when the
+# native-tier tests came back online)
+BIG = np.int32(1 << 28)
 MAX_WAVES = 128
 
 

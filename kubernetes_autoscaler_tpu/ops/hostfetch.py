@@ -50,6 +50,12 @@ def fetch_pytree(tree):
     """Return the same pytree with every leaf as a host numpy array of the
     ORIGINAL shape and dtype, using at most three device→host transfers."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if len(leaves) <= 1:
+        # one leaf is one transfer either way — skip the pack program (and
+        # its per-structure jit cache entry; the planner's batched host
+        # views hand in many distinct small dict shapes)
+        return jax.tree_util.tree_unflatten(
+            treedef, [np.asarray(jax.device_get(x)) for x in leaves])
     b, i, f = jax.device_get(_packed(tree))
     offs = {"b": 0, "i": 0, "f": 0}
     out = []

@@ -25,6 +25,18 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+# shard_map compatibility: the public `jax.shard_map` (with its `check_vma`
+# kwarg) only exists on newer JAX; older releases ship it under
+# jax.experimental with `check_rep` instead. Resolved once at import so the
+# sharded packer runs on both.
+try:
+    _shard_map = jax.shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_KW = {"check_rep": False}
+
 
 class PackResult(struct.PyTreeNode):
     free_after: jax.Array   # i32[N, R] remaining capacity after placement
@@ -106,12 +118,12 @@ def pack_groups_sharded(
     n_shards = mesh.shape[NODES_AXIS]
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(NODES_AXIS, None), P(None, NODES_AXIS), P(None, None),
                   P(None), P(None), P(None)),
         out_specs=(P(NODES_AXIS, None), P(None, NODES_AXIS), P(None)),
-        check_vma=False,
+        **_SHARD_MAP_KW,
     )
     def run(free_l, mask_l, req_r, count_r, order_r, limone_r):
         shard = jax.lax.axis_index(NODES_AXIS)

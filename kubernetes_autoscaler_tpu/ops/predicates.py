@@ -27,6 +27,7 @@ fused XLA graph); no data-dependent Python control flow.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from kubernetes_autoscaler_tpu.models.cluster_state import NodeTensors, PodGroupTensors
 
@@ -95,6 +96,44 @@ def ports_free(used_ports: jnp.ndarray, specs: PodGroupTensors) -> jnp.ndarray:
     for pp in range(specs.port_hash.shape[1]):
         conflict = conflict | _any_eq(used_ports, specs.port_hash[:, pp])
     return ~conflict
+
+
+def host_predicate_row(label_hash: np.ndarray, taint_exact: np.ndarray,
+                       taint_key: np.ndarray, spec) -> np.ndarray:
+    """Host-side (numpy) selector + taint feasibility row for ONE encoded pod
+    spec against the node planes: bool[N].
+
+    The single-pod mirror of `selector_match` and `taints_tolerated` above,
+    evaluated on the encoder's host mirrors with no device dispatch — the
+    scale-down planner's phantom-injection prefilter runs it per evicted pod
+    so the exact oracle only sees the surviving nodes. Exact for non-lossy
+    specs (same hash-equality contract as the device planes); callers must
+    not prefilter with it when `spec.lossy` is set, because a lossy encoding
+    may under-admit and the prefilter must never exclude a node the oracle
+    would accept.
+
+    `spec` is a models.encode._PodSpecEncoding (numpy fields)."""
+    n = label_hash.shape[0]
+    ok = np.ones((n,), dtype=bool)
+    # selector: every active AND-term needs >= 1 alternative hash present
+    for s in range(spec.sel_req.shape[0]):
+        alts = spec.sel_req[s]
+        alts = alts[alts != 0]
+        if alts.size == 0:
+            continue
+        ok &= np.isin(label_hash, alts).any(axis=1)
+    negs = spec.sel_neg[spec.sel_neg != 0]
+    if negs.size:
+        ok &= ~np.isin(label_hash, negs).any(axis=1)
+    # taints: every active NoSchedule/NoExecute taint must be covered by an
+    # exact (key,value,effect) or key-scoped (key,effect) toleration hash
+    if not spec.tolerate_all:
+        tol_ex = spec.tol_exact[spec.tol_exact != 0]
+        tol_ky = spec.tol_key[spec.tol_key != 0]
+        active = taint_exact != 0                       # bool[N, T]
+        covered = np.isin(taint_exact, tol_ex) | np.isin(taint_key, tol_ky)
+        ok &= (~active | covered).all(axis=1)
+    return ok
 
 
 def feasibility_mask(

@@ -67,11 +67,22 @@ class CurrentlyDrainedNodesProcessor:
     pending set while a drain is in progress. Copies are renamed
     "drained::<name>" — ':' cannot appear in real pod names, so the encoder's
     (namespace, name) keyspace stays collision-free while the original is
-    still listed."""
+    still listed.
+
+    A cached copy is INVALIDATED when the live pod is replaced (object
+    identity change — the encoder's replace-on-update contract) or its
+    request vector mutates in place, so scale-up never keeps provisioning
+    for a stale spec while the drain is in flight (ADVICE r5)."""
 
     def __init__(self, deletion_tracker):
         self.tracker = deletion_tracker          # actuator's NodeDeletionTracker
-        self._copies: dict[tuple[str, str], Pod] = {}
+        # key -> (live source pod, request signature, injected copy)
+        self._copies: dict[tuple[str, str], tuple[Pod, tuple, Pod]] = {}
+
+    @staticmethod
+    def _req_sig(p: Pod) -> tuple:
+        return (tuple(sorted(p.requests.items())),
+                tuple(sorted(p.overhead.items())))
 
     def process(self, pods, ctx):
         from kubernetes_autoscaler_tpu.models.api import is_recreatable
@@ -93,8 +104,13 @@ class CurrentlyDrainedNodesProcessor:
                 continue
             key = (p.namespace, p.name)
             live_keys.add(key)
-            cp = self._copies.get(key)
-            if cp is None:
+            sig = self._req_sig(p)
+            entry = self._copies.get(key)
+            if entry is not None:
+                src, old_sig, cp = entry
+                if src is not p or old_sig != sig:
+                    entry = None    # live pod replaced/resized mid-drain
+            if entry is None:
                 import copy as _copy
 
                 cp = _copy.copy(p)
@@ -102,7 +118,7 @@ class CurrentlyDrainedNodesProcessor:
                 cp.uid = f"drained::{p.uid}"
                 cp.node_name = ""                # ClearPodNodeNames
                 cp.phase = "Pending"
-                self._copies[key] = cp
+                self._copies[key] = (p, sig, cp)
             injected.append(cp)
         for key in list(self._copies):
             if key not in live_keys:

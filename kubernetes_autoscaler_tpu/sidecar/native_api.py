@@ -31,7 +31,13 @@ def load() -> ctypes.CDLL:
         return _lib
     if not os.path.exists(_LIB_PATH):
         _build()
-    lib = ctypes.CDLL(_LIB_PATH)
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        # stale binary from a different toolchain (loader version mismatch):
+        # force-rebuild with the local compiler, then load for real
+        subprocess.run(["make", "-C", _DIR, "-s", "-B"], check=True)
+        lib = ctypes.CDLL(_LIB_PATH)
     lib.ka_state_new.restype = ctypes.c_void_p
     lib.ka_state_new.argtypes = [ctypes.c_int] * 8
     lib.ka_state_free.argtypes = [ctypes.c_void_p]

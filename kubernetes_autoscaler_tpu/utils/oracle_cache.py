@@ -333,6 +333,20 @@ class ConfirmOracle:
                 pod_request_vector(pod, self.registry)[0].astype(int)
         return v
 
+    def check_constraints(self, pod: Pod, node: Node) -> bool:
+        """Cluster-wide-constraint-only verdict — inter-pod (anti-)affinity
+        and topology spread over the cache's current world, each O(domains)
+        instead of O(nodes × pods). For callers that gate capacity,
+        selector, taints and ports themselves (the scale-down planner's
+        phantom injection runs those against device-true free capacity the
+        oracle world cannot see). ≡ the corresponding utils/oracle checks:
+        anti_affinity_ok ∧ pod_affinity_ok ∧ spread_ok."""
+        if pod.anti_affinity and not self._anti_ok(pod, node):
+            return False
+        if pod.pod_affinity and not self._aff_ok(pod, node):
+            return False
+        return self._spread_ok(pod, node)
+
     def check(self, pod: Pod, node: Node) -> bool:
         """≡ oracle.check_pod_in_cluster over the cache's current world."""
         if not _o.node_schedulable(node):

@@ -135,3 +135,87 @@ def test_hang_then_recover_via_retries():
                              "probe", attempts=3, backoff_s=0.01,
                              sleep=lambda s: None)
     assert out == "ok" and calls["n"] == 2
+
+
+@pytest.mark.slow
+def test_marshal_cache_zero_gxg_rebuild_on_unchanged_cluster():
+    """Steady-state microbenchmark for the constrained-tier marshal cache:
+    on an UNCHANGED cluster, the second plan() cycle must do zero G×G
+    rebuild work — the composition fingerprint hits and only the per-call
+    count-plane copies remain (acceptance criterion of the host-path PR)."""
+    import numpy as np
+
+    from kubernetes_autoscaler_tpu.config.options import (
+        AutoscalingOptions,
+        NodeGroupDefaults,
+    )
+    from kubernetes_autoscaler_tpu.core.scaledown import native_confirm
+    from kubernetes_autoscaler_tpu.core.scaledown.planner import Planner
+    from kubernetes_autoscaler_tpu.models.api import (
+        AffinityTerm,
+        TopologySpreadConstraint,
+    )
+    from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+    from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
+        DrainOptions,
+        apply_drainability,
+    )
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    if not native_confirm.available():
+        pytest.skip("native toolchain unavailable")
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=16000, mem_mib=65536)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=1000)
+    nodes, pods = [], []
+    for i in range(120):
+        nd = build_test_node(f"n{i}", cpu_milli=16000, mem_mib=65536,
+                             zone=["za", "zb", "zc"][i % 3])
+        fake.add_existing_node("ng1", nd)
+        nodes.append(nd)
+        for j in range(2):
+            app = f"a{(i + j) % 8}"
+            p = build_test_pod(f"p{i}-{j}", cpu_milli=900, mem_mib=512,
+                               owner_name=f"rs-{app}", node_name=f"n{i}",
+                               labels={"app": app})
+            p.phase = "Running"
+            if (i + j) % 2:
+                p.topology_spread = [TopologySpreadConstraint(
+                    max_skew=4, topology_key="topology.kubernetes.io/zone",
+                    match_labels={"app": app})]
+            else:
+                p.anti_affinity = [AffinityTerm(
+                    match_labels={"app": app},
+                    topology_key="kubernetes.io/hostname")]
+            fake.add_pod(p)
+            pods.append(p)
+    enc = encode_cluster(nodes, pods,
+                         node_group_ids={nd.name: 0 for nd in nodes},
+                         node_bucket=64, group_bucket=64)
+    apply_drainability(enc, DrainOptions(), now=0.0)
+    opts = AutoscalingOptions(
+        max_scale_down_parallelism=200, max_drain_parallelism=200,
+        max_empty_bulk_delete=200,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0))
+    planner = Planner(fake.provider, opts)
+    planner.update(enc, nodes, now=1000.0)
+    planner.nodes_to_delete(enc, nodes, now=1000.0)
+    assert planner.marshal_cache_misses == 1, "cold loop builds the matrices"
+    gxg_before = planner.marshal_cache_misses
+    import time as _time
+
+    t0 = _time.perf_counter()
+    planner.update(enc, nodes, now=1001.0)
+    planner.nodes_to_delete(enc, nodes, now=1001.0)
+    warm_s = _time.perf_counter() - t0
+    assert planner.marshal_cache_misses == gxg_before, \
+        "unchanged cluster must not rebuild the G×G matrices"
+    assert planner.marshal_cache_hits >= 1
+    assert planner.elig_cache_misses == 1 and planner.elig_cache_hits >= 1
+    # breathing room only — the real assertion is the counter above
+    assert warm_s < 30.0

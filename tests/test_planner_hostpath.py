@@ -1,0 +1,496 @@
+"""Host-path perf rework invariants (PR: vectorized phantom injection +
+incremental planner marshalling cache).
+
+Three property families pin the optimizations to the unoptimized semantics:
+  * vectorized `_inject_evicted` must place byte-identically to the
+    unfiltered first-fit oracle scan, while running exact-oracle predicates
+    on at most the dense-prefilter survivors per pod;
+  * the constrained-tier marshal cache must serve IDENTICAL native-pass
+    inputs on a hit, hit on count-only churn, and miss (rebuild) only when
+    group composition changes;
+  * the three r5 advisor fixes (walltime threading, detached-worker partial
+    results, drained-copy invalidation) stay fixed.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.scaledown.actuator import Actuator
+from kubernetes_autoscaler_tpu.core.scaledown.planner import (
+    NodeToRemove,
+    Planner,
+)
+from kubernetes_autoscaler_tpu.models.api import (
+    AffinityTerm,
+    NodeSelectorRequirement,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from kubernetes_autoscaler_tpu.models.encode import (
+    _encode_pod_spec,
+    encode_cluster,
+)
+from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
+    DrainOptions,
+    apply_drainability,
+)
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+ZONE = "topology.kubernetes.io/zone"
+HOST = "kubernetes.io/hostname"
+
+
+# ---------------- vectorized phantom injection ----------------
+
+
+def _inject_world(seed: int):
+    """Randomized nodes (labels/taints/zones/load) + evicted pods spanning
+    every prefilter branch: plain, selector-matched, tolerating, host-port,
+    anti-affinity (oracle-only), lossy (Gt affinity), and unplaceable."""
+    rng = np.random.default_rng(seed)
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=200)
+    zones = ["za", "zb", "zc"]
+    nodes = []
+    n_nodes = int(rng.integers(12, 30))
+    for i in range(n_nodes):
+        taints = ([Taint("dedicated", "infra", "NoSchedule")]
+                  if rng.integers(0, 4) == 0 else [])
+        nd = build_test_node(
+            f"n{i}", cpu_milli=4000, mem_mib=8192,
+            labels={"disk": "ssd" if i % 3 else "hdd",
+                    "tier": f"t{int(rng.integers(0, 3))}"},
+            taints=taints, zone=zones[i % 3],
+            ready=bool(rng.integers(0, 10) > 0),
+        )
+        fake.add_existing_node("ng1", nd)
+        nodes.append(nd)
+    pods = []
+    for i in range(n_nodes):
+        for j in range(int(rng.integers(0, 3))):
+            p = build_test_pod(
+                f"r{i}-{j}", cpu_milli=int(rng.integers(200, 1200)),
+                mem_mib=256, owner_name=f"rs{int(rng.integers(0, 6))}",
+                node_name=f"n{i}", labels={"app": f"a{int(rng.integers(0, 4))}"},
+                host_port=int(rng.choice([0, 0, 0, 9100])),
+            )
+            p.phase = "Running"
+            fake.add_pod(p)
+            pods.append(p)
+    evicted = []
+    for k in range(int(rng.integers(4, 14))):
+        kind = int(rng.integers(0, 7))
+        p = build_test_pod(
+            f"gone-{k}", cpu_milli=int(rng.integers(300, 2500)), mem_mib=256,
+            owner_name=f"ev{k % 3}", labels={"app": f"a{k % 4}"},
+        )
+        if kind == 1:
+            p.node_selector = {"disk": "ssd"}
+        elif kind == 2:
+            p.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                        value="infra", effect="NoSchedule")]
+        elif kind == 3:
+            p.host_ports = ((9100, "TCP"),)
+        elif kind == 4:
+            p.anti_affinity = [AffinityTerm(match_labels={"app": p.labels["app"]},
+                                            topology_key=HOST)]
+        elif kind == 5:
+            # Gt operator -> lossy dense encoding -> capacity-only prefilter
+            p.required_node_affinity = [
+                NodeSelectorRequirement(key="tier", operator="Gt",
+                                        values=("0",))]
+        elif kind == 6:
+            p.requests["cpu"] = 64.0          # fits nowhere
+        evicted.append(p)
+    return fake, nodes, pods, evicted
+
+
+def _run_inject(seed: int, prefilter: bool):
+    fake, nodes, pods, evicted = _inject_world(seed)
+    enc = encode_cluster(nodes, pods,
+                         node_group_ids={nd.name: 0 for nd in nodes})
+    apply_drainability(enc, DrainOptions(), now=0.0)
+    planner = Planner(fake.provider,
+                      AutoscalingOptions(node_group_defaults=NodeGroupDefaults()))
+    planner.inject_prefilter = prefilter
+    planner._inject_evicted(enc, nodes, evicted)
+    st = planner.state
+    placements = [(p.name, p.node_name) for p in st.injected_pods]
+    return (placements, st.evictions_injected, st.evictions_uninjectable,
+            np.asarray(enc.nodes.alloc), st)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_inject_prefilter_plan_equality(seed):
+    """Vectorized injection ≡ unfiltered first-fit oracle scan, byte for
+    byte: same placements in the same order, same counters, same alloc
+    charge tensor."""
+    placed_f, inj_f, fail_f, alloc_f, st_f = _run_inject(seed, True)
+    placed_s, inj_s, fail_s, alloc_s, _ = _run_inject(seed, False)
+    assert placed_f == placed_s
+    assert (inj_f, fail_f) == (inj_s, fail_s)
+    assert np.array_equal(alloc_f, alloc_s)
+    # the exact oracle ran on at most the dense-prefilter survivors
+    assert st_f.evictions_oracle_nodes <= st_f.evictions_prefilter_survivors
+
+
+def test_inject_prefilter_actually_prunes():
+    """On a world where selectors exclude most nodes, the prefiltered oracle
+    workload must be strictly below the unfiltered one."""
+    _, _, _, _, st_f = _run_inject(3, True)
+    _, _, _, _, st_s = _run_inject(3, False)
+    assert st_f.evictions_oracle_nodes <= st_s.evictions_oracle_nodes
+    # the unfiltered path examines every capacity-feasible node; the dense
+    # pass must have examined no more
+    assert st_f.evictions_prefilter_survivors <= st_s.evictions_prefilter_survivors
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_host_predicate_row_matches_oracle(seed):
+    """The numpy selector/taint row ≡ the exact oracle for non-lossy specs."""
+    from kubernetes_autoscaler_tpu.ops.predicates import host_predicate_row
+    from kubernetes_autoscaler_tpu.utils import oracle
+
+    _fake, nodes, pods, evicted = _inject_world(seed)
+    enc = encode_cluster(nodes, pods)
+    n = len(nodes)
+    h = enc.host_arrays
+    label_hash = np.asarray(h["nodes.label_hash"])[:n]
+    taint_exact = np.asarray(h["nodes.taint_exact"])[:n]
+    taint_key = np.asarray(h["nodes.taint_key"])[:n]
+    checked = 0
+    for p in evicted:
+        spec = _encode_pod_spec(p, enc.dims)
+        if spec.lossy:
+            continue
+        row = host_predicate_row(label_hash, taint_exact, taint_key, spec)
+        for i, nd in enumerate(nodes):
+            want = (oracle.selector_matches(p, nd)
+                    and oracle.taints_tolerated(p, nd))
+            assert bool(row[i]) == want, (p.name, nd.name)
+            checked += 1
+    assert checked > 0
+
+
+# ---------------- marshal cache ----------------
+
+
+def _constrained_world(extra_pods=()):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=100)
+    nodes = []
+    for i in range(9):
+        nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384,
+                             zone=["za", "zb", "zc"][i % 3])
+        fake.add_existing_node("ng1", nd)
+        nodes.append(nd)
+    pods = []
+    for i in range(9):
+        p = build_test_pod(f"p{i}", cpu_milli=600, mem_mib=256,
+                           owner_name=f"rs{i % 2}", node_name=f"n{i}",
+                           labels={"app": f"a{i % 2}"})
+        p.phase = "Running"
+        if i % 2 == 0:
+            p.topology_spread = [TopologySpreadConstraint(
+                max_skew=2, topology_key=ZONE, match_labels={"app": "a0"})]
+        else:
+            p.anti_affinity = [AffinityTerm(match_labels={"app": "a1"},
+                                            topology_key=HOST)]
+        pods.append(p)
+    pods = pods + list(extra_pods)
+    for p in pods[9:]:
+        fake.add_pod(p)
+    for p in pods[:9]:
+        fake.add_pod(p)
+    return fake, nodes, pods
+
+
+def _encode_world(nodes, pods):
+    enc = encode_cluster(nodes, pods,
+                         node_group_ids={nd.name: 0 for nd in nodes})
+    apply_drainability(enc, DrainOptions(), now=0.0)
+    return enc
+
+
+def _block_args(planner, enc, nodes):
+    """One update() sweep, then the routing vectors the confirm pass would
+    hand _build_constraint_block."""
+    planner.update(enc, nodes, now=0.0)
+    feas = np.asarray(planner.state.removal.feas)
+    g = feas.shape[0]
+    need_exact = np.asarray(enc.specs.needs_host_check).copy()
+    need_exact |= np.asarray(enc.specs.spread_kind) > 0
+    need_exact |= np.asarray(enc.specs.aff_kind) > 0
+    limit_g = np.asarray(enc.specs.one_per_node())
+    con_path = need_exact | limit_g
+    grf = np.asarray(enc.scheduled.group_ref)
+    valid = np.asarray(enc.scheduled.valid)
+    moved = np.unique(grf[valid])
+    return feas, con_path, moved, need_exact, limit_g
+
+
+_BLOCK_FIELDS = (
+    "zone_id", "spread_kind", "max_skew", "spread_self", "has_anti_host",
+    "has_anti_zone", "aff_kind", "aff_self", "one_per_node", "oracle_moved",
+    "elig", "cnt_node", "anti_host_node", "anti_zone_node", "aff_node",
+    "m_spread", "m_anti_h", "m_anti_z", "m_aff", "con_path",
+)
+
+
+def _assert_blocks_equal(b1, b2):
+    assert b1.n_zones == b2.n_zones
+    for f in _BLOCK_FIELDS:
+        a, b = getattr(b1, f), getattr(b2, f)
+        assert np.array_equal(a, b), f
+
+
+def test_marshal_cache_hit_serves_identical_inputs():
+    fake, nodes, pods = _constrained_world()
+    enc = _encode_world(nodes, pods)
+    planner = Planner(fake.provider,
+                      AutoscalingOptions(node_group_defaults=NodeGroupDefaults()))
+    feas, con_path, moved, ne, lg = _block_args(planner, enc, nodes)
+    b1 = planner._build_constraint_block(enc, feas, con_path, moved,
+                                         oracle_moved=ne, one_per_node=lg)
+    assert b1 is not None
+    assert (planner.marshal_cache_misses, planner.marshal_cache_hits) == (1, 0)
+    b2 = planner._build_constraint_block(enc, feas, con_path, moved,
+                                         oracle_moved=ne, one_per_node=lg)
+    assert (planner.marshal_cache_misses, planner.marshal_cache_hits) == (1, 1)
+    _assert_blocks_equal(b1, b2)
+    # count planes are per-call copies: the kernel may mutate them without
+    # poisoning the next marshal
+    assert b1.cnt_node is not b2.cnt_node
+    # a COLD planner must marshal the same inputs the warm cache served
+    planner2 = Planner(fake.provider,
+                       AutoscalingOptions(node_group_defaults=NodeGroupDefaults()))
+    feas2, con_path2, moved2, ne2, lg2 = _block_args(planner2, enc, nodes)
+    b3 = planner2._build_constraint_block(enc, feas2, con_path2, moved2,
+                                          oracle_moved=ne2, one_per_node=lg2)
+    _assert_blocks_equal(b1, b3)
+
+
+def test_marshal_cache_counts_vs_composition():
+    """Count-only churn (one more pod of an EXISTING equivalence group) hits
+    the cache; a NEW group (composition change) rebuilds."""
+    fake, nodes, pods = _constrained_world()
+    planner = Planner(fake.provider,
+                      AutoscalingOptions(node_group_defaults=NodeGroupDefaults()))
+    enc = _encode_world(nodes, pods)
+    feas, con_path, moved, ne, lg = _block_args(planner, enc, nodes)
+    planner._build_constraint_block(enc, feas, con_path, moved,
+                                    oracle_moved=ne, one_per_node=lg)
+    assert planner.marshal_cache_misses == 1
+
+    # same composition, one more member of rs0/a0 (appended LAST so existing
+    # row order is unchanged)
+    extra = build_test_pod("p-extra", cpu_milli=600, mem_mib=256,
+                           owner_name="rs0", node_name="n1",
+                           labels={"app": "a0"})
+    extra.phase = "Running"
+    extra.topology_spread = [TopologySpreadConstraint(
+        max_skew=2, topology_key=ZONE, match_labels={"app": "a0"})]
+    enc2 = _encode_world(nodes, pods + [extra])
+    feas2, con_path2, moved2, ne2, lg2 = _block_args(planner, enc2, nodes)
+    b2 = planner._build_constraint_block(enc2, feas2, con_path2, moved2,
+                                         oracle_moved=ne2, one_per_node=lg2)
+    assert planner.marshal_cache_misses == 1      # HIT: composition unchanged
+    assert planner.marshal_cache_hits >= 1
+    # ...but the count planes reflect the NEW cluster, not the cached one
+    cnt_fresh = np.ascontiguousarray(
+        np.asarray(enc2.planes.spread_cnt), np.int32)
+    assert np.array_equal(b2.cnt_node, cnt_fresh)
+
+    # composition change: a brand-new constrained group
+    novel = build_test_pod("p-novel", cpu_milli=600, mem_mib=256,
+                           owner_name="rs-novel", node_name="n2",
+                           labels={"app": "novel"})
+    novel.phase = "Running"
+    novel.anti_affinity = [AffinityTerm(match_labels={"app": "novel"},
+                                        topology_key=ZONE)]
+    enc3 = _encode_world(nodes, pods + [novel])
+    feas3, con_path3, moved3, ne3, lg3 = _block_args(planner, enc3, nodes)
+    planner._build_constraint_block(enc3, feas3, con_path3, moved3,
+                                    oracle_moved=ne3, one_per_node=lg3)
+    assert planner.marshal_cache_misses == 2      # MISS: rebuild
+
+
+def test_elig_plane_cache_tracks_tensor_identity():
+    fake, nodes, pods = _constrained_world()
+    enc = _encode_world(nodes, pods)
+    planner = Planner(fake.provider,
+                      AutoscalingOptions(node_group_defaults=NodeGroupDefaults()))
+    e1 = planner._elig_plane(enc)
+    e2 = planner._elig_plane(enc)
+    assert e1 is e2 and planner.elig_cache_hits == 1
+    # count-only spec replacement keeps sel tensors -> still a hit
+    import jax.numpy as jnp
+
+    enc.specs = enc.specs.replace(count=enc.specs.count + jnp.int32(0))
+    assert planner._elig_plane(enc) is e1
+    # a re-encoded world replaces the tensors -> rebuild
+    enc2 = _encode_world(nodes, pods)
+    e3 = planner._elig_plane(enc2)
+    assert e3 is not e1 and planner.elig_cache_misses == 2
+    assert np.array_equal(e1, e3)
+
+
+# ---------------- r5 advisor regressions ----------------
+
+
+def test_walltime_threads_from_autoscaler_into_actuator():
+    """Eviction timestamps land in the run_once(now=...) time domain, so the
+    15-min TTL prunes under logical-time harnesses."""
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    node = build_test_node("n0", cpu_milli=4000, mem_mib=8192)
+    fake.add_existing_node("ng1", node)
+    pod = build_test_pod("p0", node_name="n0")
+    pod.phase = "Running"
+    fake.add_pod(pod)
+    logical = {"t": 50_000.0}
+    a = StaticAutoscaler(fake.provider, fake,
+                         options=AutoscalingOptions(
+                             node_group_defaults=NodeGroupDefaults()),
+                         eviction_sink=fake,
+                         walltime=lambda: logical["t"])
+    assert a.actuator.walltime() == 50_000.0
+    a.actuator.start_deletion(
+        [NodeToRemove(node, False, pods_to_move=[0])], {0: pod},
+        now=logical["t"])
+    ttl = a.actuator.tracker.evictions_ttl_s
+    # stamped at LOGICAL time: visible inside the TTL window of that domain,
+    # pruned after — with time.time() stamps neither would hold
+    assert [p.name for p in a.actuator.tracker.recent_evictions(
+        now=logical["t"] + ttl - 1)] == ["p0"]
+    assert a.actuator.tracker.recent_evictions(
+        now=logical["t"] + ttl + 1) == []
+
+
+def test_detached_worker_partial_results_survive_crash(monkeypatch):
+    """A finished node's result must reach drain_completed() even when a
+    later node's deletion dies with an unexpected exception."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    nodes, slots = [], {}
+    for i, name in enumerate(("good", "crash")):
+        nd = build_test_node(name, cpu_milli=4000, mem_mib=8192)
+        fake.add_existing_node("ng1", nd)
+        nodes.append(nd)
+        pod = build_test_pod(f"p-{name}", cpu_milli=100, mem_mib=64,
+                             node_name=name)
+        pod.phase = "Running"
+        fake.add_pod(pod)
+        slots[i] = pod
+    g = fake.provider.node_groups()[0]
+    orig = g.delete_nodes
+
+    def boom(batch):
+        if any(n.name == "crash" for n in batch):
+            raise RuntimeError("cloud API down")   # NOT a NodeGroupError
+        return orig(batch)
+
+    monkeypatch.setattr(g, "delete_nodes", boom)
+    act = Actuator(fake.provider,
+                   AutoscalingOptions(max_drain_parallelism=1,
+                                      node_group_defaults=NodeGroupDefaults()),
+                   eviction_sink=fake)
+    act.start_deletion(
+        [NodeToRemove(nodes[0], False, pods_to_move=[0]),
+         NodeToRemove(nodes[1], False, pods_to_move=[1])],
+        slots, now=0.0, detach=True)
+    done: list = []
+    deadline = time.monotonic() + 30.0
+    while len(done) < 2 and time.monotonic() < deadline:
+        done.extend(act.drain_completed())
+        time.sleep(0.02)
+    by_name = {r.node: r for r in done}
+    assert by_name["good"].ok, "finished node lost by the crashed worker"
+    assert not by_name["crash"].ok
+    assert act._live_nodes == {}                  # no leaked entries
+    assert not act.tracker.is_deleting("good")
+    assert not act.tracker.is_deleting("crash")
+
+
+def test_drained_copy_invalidated_on_spec_change():
+    from kubernetes_autoscaler_tpu.processors.processors import (
+        CurrentlyDrainedNodesProcessor,
+        ProcessorContext,
+    )
+
+    class Tracker:
+        def drain_deletions_in_progress(self):
+            return ["n1"]
+
+    proc = CurrentlyDrainedNodesProcessor(Tracker())
+    ctx = ProcessorContext(AutoscalingOptions(), provider=None)
+    p = build_test_pod("app", cpu_milli=500, mem_mib=256, node_name="n1")
+    p.phase = "Running"
+    out = proc.process([p], ctx)
+    cp1 = out[-1]
+    assert cp1.name == "drained::app"
+    # unchanged live pod -> the SAME cached copy (encoder stability)
+    assert proc.process([p], ctx)[-1] is cp1
+    # replace-on-update: a new object with new requests refreshes the copy
+    p2 = copy.copy(p)
+    p2.requests = dict(p.requests, cpu=2.0)
+    cp2 = proc.process([p2], ctx)[-1]
+    assert cp2 is not cp1
+    assert cp2.requests["cpu"] == 2.0
+    # in-place request mutation refreshes too
+    p2.requests["cpu"] = 3.0
+    cp3 = proc.process([p2], ctx)[-1]
+    assert cp3 is not cp2 and cp3.requests["cpu"] == 3.0
+
+
+# ---------------- phase accounting ----------------
+
+
+def test_phase_stats_accumulate_and_expose():
+    from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+    from kubernetes_autoscaler_tpu.metrics.phases import PhaseStats
+
+    reg = Registry()
+    ps = PhaseStats(registry=reg)
+    with ps.phase("fetch"):
+        pass
+    with ps.phase("fetch"):
+        pass
+    ps.bump("marshal_cache_hit")
+    snap = ps.snapshot()
+    assert snap["spans"]["fetch"] == 2
+    assert "fetch" in snap["totals_ms"]
+    assert snap["events"]["marshal_cache_hit"] == 1
+    assert reg.histogram("planner_phase_seconds").count(phase="fetch") == 2
+
+
+def test_planner_populates_phase_breakdown():
+    fake, nodes, pods = _constrained_world()
+    enc = _encode_world(nodes, pods)
+    planner = Planner(fake.provider, AutoscalingOptions(
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0,
+            scale_down_unready_time_s=0.0)))
+    planner.update(enc, nodes, now=0.0)
+    planner.nodes_to_delete(enc, nodes, now=0.0)
+    snap = planner.phases.snapshot()
+    assert "dispatch" in snap["totals_ms"]
+    assert "fetch" in snap["totals_ms"]
+    assert "confirm" in snap["totals_ms"]
