@@ -198,15 +198,52 @@ def main() -> None:
     ap.add_argument("--max-new-nodes", type=int, default=1024)
     ap.add_argument("--iters", type=int, default=7)
     ap.add_argument("--chain", type=int, default=25, help="long chain length k2")
-    ap.add_argument("--scaledown", type=int, default=1,
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU-backend smoke mode: small shapes on "
+                         "JAX_PLATFORMS=cpu so the bench records a real "
+                         "(non-null) value even when the TPU tunnel is down; "
+                         "scale-down/e2e phases off unless forced")
+    ap.add_argument("--wavefront", type=int, default=1,
+                    help="batch the existing-nodes pack scan into conflict-"
+                         "free wavefronts (ops/pack.py) — serial depth W "
+                         "instead of G; 0 = serial scan")
+    ap.add_argument("--mesh-devices", type=int, default=0,
+                    help="shard the sim over an N-device mesh (nodes axis → "
+                         "NODES_AXIS, nodegroup options → PODS_AXIS); 0 = "
+                         "single device. With --smoke, virtual CPU devices "
+                         "are forced.")
+    ap.add_argument("--scaledown", type=int, default=None,
                     help="also time the scale-down planner (device sweep + "
                          "host confirmation) at --nodes scale; stderr only")
-    ap.add_argument("--e2e", type=int, default=1,
+    ap.add_argument("--e2e", type=int, default=None,
                     help="also measure END-TO-END RunOnce (encode deltas + "
                          "sim + plan + confirm) at --nodes/--pods scale; "
                          "prints a second runonce_e2e_p50 JSON line")
     ap.add_argument("--e2e-loops", type=int, default=8)
     args = ap.parse_args()
+
+    if args.smoke:
+        # fixed small shape: the point is a real steady-state number from
+        # the CPU backend, not scale — tunnel-independent trajectory evidence
+        args.nodes, args.pods = 128, 1500
+        args.pod_groups, args.nodegroups = 12, 4
+        args.max_new_nodes = 32
+        args.iters, args.chain = 3, 8
+        if args.scaledown is None:
+            args.scaledown = 0
+        if args.e2e is None:
+            args.e2e = 0
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if args.mesh_devices > 1 and "xla_force_host_platform_device_count" \
+                not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={args.mesh_devices}"
+            ).strip()
+    if args.scaledown is None:
+        args.scaledown = 1
+    if args.e2e is None:
+        args.e2e = 1
 
     kp = args.pods // 1000
     kn = args.nodes // 1000 if args.nodes >= 1000 else args.nodes
@@ -227,6 +264,11 @@ def run_bench(args, metric: str) -> None:
     def _init():
         import jax
 
+        if args.smoke:
+            # the axon sitecustomize force-registers the TPU backend over
+            # JAX_PLATFORMS; the config knob wins if set before first use
+            jax.config.update("jax_platforms", "cpu")
+
         from kubernetes_autoscaler_tpu.ops.autoscale_step import scale_up_sim
 
         return jax, jax.devices()[0], scale_up_sim
@@ -234,22 +276,69 @@ def run_bench(args, metric: str) -> None:
     jax, dev, scale_up_sim = with_retries(with_timeout(_init), "backend init")
     import jax.numpy as jnp
 
+    from kubernetes_autoscaler_tpu.metrics.phases import PhaseStats
     from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS
 
+    phases = PhaseStats()
+
+    mesh = None
+    if args.mesh_devices > 1:
+        from kubernetes_autoscaler_tpu.parallel.mesh import make_mesh
+
+        n_dev = min(args.mesh_devices, len(jax.devices()))
+        pods_par = 2 if n_dev % 2 == 0 else 1
+        mesh = make_mesh(n_dev, nodes_parallel=n_dev // pods_par)
+
     # encode ships tensors to the device, so it is also a tunnel touch
+    def _encode():
+        with phases.phase("encode"):
+            return build_world(args.nodes, args.pods,
+                               args.pod_groups, args.nodegroups)
+
     enc, groups, encode_s = with_retries(
-        with_timeout(lambda: build_world(args.nodes, args.pods,
-                                         args.pod_groups, args.nodegroups),
-                     seconds=max(INIT_TIMEOUT_S, 180)),
+        with_timeout(_encode, seconds=max(INIT_TIMEOUT_S, 180)),
         "world encode + upload",
     )
-    nodes, specs, sched, groups = with_retries(
-        lambda: jax.device_put((enc.nodes, enc.specs, enc.scheduled, groups), dev),
-        "device upload",
-    )
+
+    def _upload():
+        if mesh is None:
+            return jax.device_put(
+                (enc.nodes, enc.specs, enc.scheduled, groups), dev)
+        # mesh run: node tensors sharded over NODES_AXIS, the rest
+        # replicated — inputs must span the mesh's devices, not chip 0
+        from kubernetes_autoscaler_tpu.parallel.mesh import cluster_shardings
+
+        node_spec, _pod_spec, repl = cluster_shardings(mesh)
+        nodes_s = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, node_spec(x.ndim)), enc.nodes)
+        rest = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, repl),
+            (enc.specs, enc.scheduled, groups))
+        return (nodes_s, *rest)
+
+    nodes, specs, sched, groups = with_retries(_upload, "device upload")
+
+    # wavefront plan: host coloring of the mask-overlap graph, ONCE per
+    # composition (the chain only churns counts → the cache would hit every
+    # loop in production). Mutually exclusive with the sharded pack.
+    plan = None
+    if args.wavefront and mesh is None:
+        from kubernetes_autoscaler_tpu.ops.pack import WavefrontCache
+        from kubernetes_autoscaler_tpu.ops.schedule import plan_wavefronts
+
+        wf_cache = WavefrontCache()
+        with phases.phase("fetch"):
+            plan = with_retries(
+                lambda: plan_wavefronts(nodes, specs, wf_cache, phases=phases),
+                "wavefront planning")
+        g_active = plan.n_active
+        print(f"[bench] wavefronts: W={plan.n_waves} of G={g_active} "
+              f"(worthwhile={plan.worthwhile})", file=sys.stderr)
+        if not plan.worthwhile:
+            plan = None   # overlap-heavy composition: keep the serial scan
 
     @jax.jit
-    def step(nodes, specs, sched, groups, token):
+    def step(nodes, specs, sched, groups, token, plan):
         # Thread a device scalar through each iteration so chained sims are
         # data-dependent. The bump is always 0 — token is out.best from the
         # previous sim, which lives in [-1, NG) and never hits the sentinel —
@@ -259,13 +348,14 @@ def run_bench(args, metric: str) -> None:
         return scale_up_sim.__wrapped__(
             nodes, specs, sched, groups,
             DEFAULT_DIMS, args.max_new_nodes, "least-waste",
+            None, False, mesh, plan,
         )
 
     t0 = time.perf_counter()
     out = with_retries(
         with_timeout(
             lambda: jax.block_until_ready(step(nodes, specs, sched, groups,
-                                               jnp.int32(0))),
+                                               jnp.int32(0), plan)),
             seconds=max(INIT_TIMEOUT_S, 300)),
         "compile + first dispatch",
     )
@@ -278,7 +368,7 @@ def run_bench(args, metric: str) -> None:
         t0 = time.perf_counter()
         tok = jnp.int32(0)
         for _ in range(k):
-            o = step(nodes, specs, sched, groups, tok)
+            o = step(nodes, specs, sched, groups, tok, plan)
             tok = o.best
         jax.block_until_ready(o)
         return (time.perf_counter() - t0) * 1000.0
@@ -286,22 +376,32 @@ def run_bench(args, metric: str) -> None:
     k2 = max(args.chain, 2)
     k1 = max(k2 // 5, 1)
     with_retries(lambda: chain(2), "warm-up chain")  # warm dispatch path
+    compiles_before = step._cache_size()
 
     def measure():
         samples = []
         for _ in range(args.iters):
-            samples.append((chain(k2) - chain(k1)) / (k2 - k1))
+            with phases.phase("dispatch"):
+                samples.append((chain(k2) - chain(k1)) / (k2 - k1))
         return samples
 
     samples = with_retries(measure, "measurement loop")
     p50 = float(np.percentile(samples, 50))
+    # steady-state recompile accounting: any growth of the jit cache during
+    # the measurement loop means a shape/plan leak — the JSON asserts zero
+    steady_recompiles = step._cache_size() - compiles_before
 
+    with phases.phase("fetch"):
+        best = int(out.best)
+        best_sched = int(out.estimate.scheduled[best].sum())
+        best_nodes = int(out.estimate.node_count[best])
     checks = int(np.asarray(enc.specs.count).sum()) * args.nodes
     print(
         f"[bench] device={jax.devices()[0].platform} encode={encode_s:.2f}s "
-        f"compile={compile_s:.1f}s p50={p50:.2f}ms best_group={int(out.best)} "
-        f"scheduled={int(out.estimate.scheduled[int(out.best)].sum())} "
-        f"new_nodes={int(out.estimate.node_count[int(out.best)])} "
+        f"compile={compile_s:.1f}s p50={p50:.2f}ms best_group={best} "
+        f"scheduled={best_sched} "
+        f"new_nodes={best_nodes} "
+        f"steady_recompiles={steady_recompiles} "
         f"fit_checks/s={checks / (p50 / 1e3):.3e}",
         file=sys.stderr,
     )
@@ -310,17 +410,25 @@ def run_bench(args, metric: str) -> None:
     # as the LAST line after the optional phases so both first-line and
     # last-line consumers read the headline metric; the runonce_e2e line
     # sits between them. The "phases" object decomposes the number into its
-    # cost domains (metrics/phases.py) instead of shipping it opaque.
+    # cost domains (metrics/phases.py) instead of shipping it opaque;
+    # "spans" is the live PhaseStats breakdown (encode/dispatch/fetch totals,
+    # span counts, wavefront-cache events).
     primary_line = json.dumps({
         "metric": metric,
         "value": round(p50, 3),
         "unit": "ms",
         "vs_baseline": round(200.0 / p50, 2),
+        "mode": "smoke" if args.smoke else "full",
+        "steady_state_recompiles": steady_recompiles,
+        "wavefronts": (None if plan is None
+                       else {"w": plan.n_waves, "g": plan.n_active}),
+        "mesh_devices": args.mesh_devices,
         "phases": {
             "encode_ms": round(encode_s * 1000.0, 1),
             "compile_ms": round(compile_s * 1000.0, 1),
             "device_sim_ms": round(p50, 3),
         },
+        "spans": phases.snapshot(),
     })
     print(primary_line, flush=True)
 

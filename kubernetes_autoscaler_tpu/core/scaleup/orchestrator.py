@@ -78,11 +78,21 @@ class ScaleUpOrchestrator:
             NodeGroupManager,
         )
 
+        from kubernetes_autoscaler_tpu.metrics.phases import PhaseStats
+
         self.provider = provider
         self.options = options
         self.cluster_state = cluster_state
         self.expander = expander
         self.quota = quota
+        # per-phase wall-clock breakdown of the scale-up host path, the
+        # mirror of Planner.phases on the scale-down side: encode (template
+        # tensors), dispatch (estimate + scoring programs), fetch (score
+        # readback), confirm (lossy-winner oracle verification)
+        self.phases = PhaseStats()
+        # optional device mesh threaded into the estimator (NG options over
+        # PODS_AXIS; parallel/mesh.py) — None = single-device program
+        self.mesh = None
         self.node_group_list_processor = (
             node_group_list_processor or IdentityNodeGroupListProcessor()
         )
@@ -148,6 +158,7 @@ class ScaleUpOrchestrator:
             planes=enc.planes,
             nodes=enc.nodes,
             with_constraints=enc.has_constraints,
+            mesh=self.mesh,
         )
         templates = []
         for g in groups:
@@ -158,18 +169,24 @@ class ScaleUpOrchestrator:
                 tmpl.unschedulable = False
             templates.append((tmpl, g.max_size() - g.target_size(),
                               getattr(g, "price_per_node", 1.0)))
-        group_tensors = self._group_tensors(templates, enc)
-        est = estimator.estimate_all_groups(enc.specs, group_tensors, nodes_count)
-        scores = scoring.score_options(est, group_tensors, specs=enc.specs)
+        with self.phases.phase("encode"):
+            group_tensors = self._group_tensors(templates, enc)
+        with self.phases.phase("dispatch"):
+            est = estimator.estimate_all_groups(enc.specs, group_tensors,
+                                                nodes_count)
+            scores = scoring.score_options(est, group_tensors, specs=enc.specs)
         # non-allocating lookup: try_slot_for would BURN one of the four
         # extended slots for the GPU name even on GPU-less clusters (any
         # GPU-bearing template/node already allocated it at encode time)
         gpu_slot = enc.registry.slots.get(self.provider.gpu_resource_name())
-        options = options_from_scores(scores, [g.id() for g in groups],
-                                      groups=groups, gpu_slot=gpu_slot)
-        options = self._verify_lossy_winners(
-            options, est, enc, groups, estimator, group_tensors, nodes_count
-        )
+        with self.phases.phase("fetch"):
+            options = options_from_scores(scores, [g.id() for g in groups],
+                                          groups=groups, gpu_slot=gpu_slot)
+        with self.phases.phase("confirm"):
+            options = self._verify_lossy_winners(
+                options, est, enc, groups, estimator, group_tensors,
+                nodes_count
+            )
         if not options:
             return ScaleUpResult(scaled_up=False, pods_remaining=pending_total,
                                  considered_options=[])
@@ -222,7 +239,12 @@ class ScaleUpOrchestrator:
         framework run — predicate truth always comes from exact semantics
         before actuation. The oracle sees the FULL cluster (nodes + resident
         pods), so topology spread / inter-pod affinity / multi-term node
-        affinity are all evaluated exactly (check_pod_on_new_node)."""
+        affinity are all evaluated exactly (check_pod_on_new_node).
+
+        Re-estimation is BATCHED: all oracle checks run first, then options
+        sharing a refuted-pod mask share one estimate_all dispatch (refuted
+        sets are template-determined, so similar templates coalesce) — the
+        device round trips scale with distinct masks, not flagged options."""
         import jax.numpy as jnp
 
         flagged = np.asarray(enc.specs.needs_host_check)
@@ -243,11 +265,13 @@ class ScaleUpOrchestrator:
         # computing further options)
         deadline = time.monotonic() + self.options.max_binpacking_time_s
         gpu_slot = enc.registry.slots.get(self.provider.gpu_resource_name())
-        out = []
         from kubernetes_autoscaler_tpu.utils.daemonset import (
             daemonset_pods_for_node,
         )
 
+        # pass 1: oracle-check every option, bucketing by refuted-pod mask
+        resolved: dict[int, Option | None] = {}
+        by_mask: dict[tuple, list[Option]] = {}
         for opt in options:
             g_t = groups[opt.group_index].template_node_info()
             # the exact tier sees the same DS-loaded fresh node the dense
@@ -264,23 +288,32 @@ class ScaleUpOrchestrator:
                             exemplar, g_t, resident_pods=ds_pods):
                         refuted.append(int(gi))
             if not refuted:
-                out.append(opt)
-                continue
+                resolved[id(opt)] = opt
+            else:
+                by_mask.setdefault(tuple(sorted(refuted)), []).append(opt)
+                self.phases.bump("lossy_reestimate_options")
+
+        # pass 2: one re-estimate per DISTINCT refuted mask, consumed by
+        # every surviving option that shares it
+        from kubernetes_autoscaler_tpu.models.resources import CPU, MEMORY
+
+        for refuted, opts_b in by_mask.items():
             if time.monotonic() > deadline:
-                continue  # budget exhausted: unverifiable option is dropped
-            # re-estimate this one node group with the refuted pods removed
+                continue  # budget exhausted: unverifiable options are dropped
+            self.phases.bump("lossy_reestimate_dispatches")
             count = np.asarray(enc.specs.count).copy()
-            count[refuted] = 0
+            count[list(refuted)] = 0
             masked = enc.specs.replace(count=jnp.asarray(count))
-            redo = estimator.estimate_all_groups(masked, group_tensors, nodes_count)
+            redo = estimator.estimate_all_groups(masked, group_tensors,
+                                                 nodes_count)
             sc = scoring.fetch_scores(
                 scoring.score_options(redo, group_tensors, specs=masked))
-            i = opt.group_index
-            if bool(sc.valid[i]):
-                helped = np.asarray(sc.helped_req)
-                from kubernetes_autoscaler_tpu.models.resources import CPU, MEMORY
-
-                out.append(Option(
+            helped = np.asarray(sc.helped_req)
+            for opt in opts_b:
+                i = opt.group_index
+                if not bool(sc.valid[i]):
+                    continue
+                resolved[id(opt)] = Option(
                     group_index=i, group_id=opt.group_id,
                     node_count=int(sc.nodes[i]), pod_count=int(sc.pods[i]),
                     waste=float(sc.waste[i]), price=float(sc.price[i]),
@@ -291,8 +324,11 @@ class ScaleUpOrchestrator:
                     # would overstate GPU help for options with refuted pods
                     helped_gpus=(float(helped[i, gpu_slot])
                                  if gpu_slot is not None else 0.0),
-                ))
-        return out
+                )
+        # original option order preserved (expander tie-breaks see the same
+        # sequence the serial path produced)
+        return [resolved[id(o)] for o in options
+                if resolved.get(id(o)) is not None]
 
     def _group_tensors(self, templates, enc):
         """encode_node_groups with the static planes cached across loops."""

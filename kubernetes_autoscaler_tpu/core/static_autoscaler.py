@@ -166,7 +166,9 @@ class StaticAutoscaler:
                                pdb_tracker=self.pdb_tracker,
                                latency_tracker=self.latency_tracker)
         # per-phase host-path breakdown rides the normal metrics exposition
+        # (both directions: scale-down planner and scale-up orchestrator)
         self.planner.phases.registry = self.metrics
+        self.scale_up_orchestrator.phases.registry = self.metrics
         self._async_group_of: dict[str, str] = {}
         self.actuator = Actuator(provider, self.options, eviction_sink,
                                  pdb_tracker=self.pdb_tracker,

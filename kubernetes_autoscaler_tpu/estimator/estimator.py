@@ -30,7 +30,12 @@ from kubernetes_autoscaler_tpu.ops.binpack import EstimateResult, estimate_all
 
 
 class EstimationLimiter(Protocol):
-    """reference: estimator/estimation_limiter — node-count cap per estimation."""
+    """reference: estimator/estimation_limiter — node-count cap per estimation.
+
+    Limiters may additionally implement `max_nodes_vec(cluster_size,
+    max_new)` returning an i32[NG] device array; the batched estimator path
+    composes those without any per-group host arithmetic. Limiters without
+    it fall back to a per-group `max_nodes` loop (one host fetch)."""
 
     def max_nodes(self, cluster_size: int, group_max_new: int) -> int: ...
 
@@ -44,6 +49,9 @@ class StaticThresholdLimiter:
     def max_nodes(self, cluster_size: int, group_max_new: int) -> int:
         return self.max_nodes_per_scaleup
 
+    def max_nodes_vec(self, cluster_size: int, max_new) -> jnp.ndarray:
+        return jnp.full_like(max_new, jnp.int32(self.max_nodes_per_scaleup))
+
 
 @dataclass
 class ClusterCapacityThresholdLimiter:
@@ -56,6 +64,11 @@ class ClusterCapacityThresholdLimiter:
             return 1 << 30
         return max(self.max_nodes_total - cluster_size, 0)
 
+    def max_nodes_vec(self, cluster_size: int, max_new) -> jnp.ndarray:
+        cap = (1 << 30) if self.max_nodes_total <= 0 \
+            else max(self.max_nodes_total - cluster_size, 0)
+        return jnp.full_like(max_new, jnp.int32(cap))
+
 
 @dataclass
 class SngCapacityThresholdLimiter:
@@ -64,6 +77,9 @@ class SngCapacityThresholdLimiter:
     def max_nodes(self, cluster_size: int, group_max_new: int) -> int:
         return max(group_max_new, 0)
 
+    def max_nodes_vec(self, cluster_size: int, max_new) -> jnp.ndarray:
+        return jnp.maximum(max_new, 0)
+
 
 def combined_limit(limiters: list[EstimationLimiter], cluster_size: int,
                    group_max_new: int) -> int:
@@ -71,12 +87,32 @@ def combined_limit(limiters: list[EstimationLimiter], cluster_size: int,
     return min(l.max_nodes(cluster_size, group_max_new) for l in limiters)
 
 
+def combined_limit_vec(limiters: list[EstimationLimiter], cluster_size: int,
+                       max_new) -> jnp.ndarray:
+    """Vectorized min-composition over all groups at once: the whole limiter
+    stack stays on device for built-in limiters — no per-group host loop on
+    the estimate path. A processor-injected limiter without `max_nodes_vec`
+    degrades to one bounded host loop for that limiter only."""
+    cap = jnp.full_like(max_new, jnp.int32(1 << 30))
+    for lim in limiters:
+        vec = getattr(lim, "max_nodes_vec", None)
+        if vec is not None:
+            cap = jnp.minimum(cap, vec(cluster_size, max_new))
+        else:
+            host = np.asarray(
+                [min(lim.max_nodes(cluster_size, int(m)), 1 << 30)
+                 for m in np.asarray(max_new)], np.int32)
+            cap = jnp.minimum(cap, jnp.asarray(host))
+    return cap
+
+
 class BinpackingEstimator:
     """Per-node-group Estimate() parity wrapper over the batched kernel."""
 
     def __init__(self, dims: Dims, max_new_nodes_static: int = 1024,
                  limiters: list[EstimationLimiter] | None = None,
-                 planes=None, nodes=None, with_constraints: bool = False):
+                 planes=None, nodes=None, with_constraints: bool = False,
+                 mesh=None):
         self.dims = dims
         self.max_new_nodes_static = max_new_nodes_static
         self.limiters = limiters or [StaticThresholdLimiter()]
@@ -85,6 +121,8 @@ class BinpackingEstimator:
         self.planes = planes
         self.nodes = nodes
         self.with_constraints = with_constraints
+        # optional device mesh: NG options sharded over PODS_AXIS
+        self.mesh = mesh
 
     def estimate(
         self,
@@ -104,7 +142,8 @@ class BinpackingEstimator:
         )
         result = estimate_all(specs, capped, self.dims, self.max_new_nodes_static,
                               planes=self.planes, nodes=self.nodes,
-                              with_constraints=self.with_constraints)
+                              with_constraints=self.with_constraints,
+                              mesh=self.mesh)
         return int(result.node_count[group_index]), np.asarray(result.scheduled[group_index])
 
     def estimate_all_groups(
@@ -114,17 +153,19 @@ class BinpackingEstimator:
         cluster_size: int = 0,
     ) -> EstimateResult:
         """The batched path the orchestrator actually uses: every group's
-        option in one device program, with per-group caps applied."""
-        caps = [
-            combined_limit(self.limiters, cluster_size, int(m))
-            for m in np.asarray(group_tensors.max_new)
-        ]
+        option in one device program, with per-group caps applied — the
+        limiter stack composes vectorized (combined_limit_vec), so no
+        per-group host arithmetic sits on the loop path."""
         capped = group_tensors.replace(
-            max_new=jnp.minimum(group_tensors.max_new, jnp.asarray(caps, jnp.int32))
+            max_new=jnp.minimum(
+                group_tensors.max_new,
+                combined_limit_vec(self.limiters, cluster_size,
+                                   group_tensors.max_new))
         )
         return estimate_all(specs, capped, self.dims, self.max_new_nodes_static,
                             planes=self.planes, nodes=self.nodes,
-                            with_constraints=self.with_constraints)
+                            with_constraints=self.with_constraints,
+                            mesh=self.mesh)
 
 
 def build_estimator(name: str, dims: Dims, **kw) -> BinpackingEstimator:

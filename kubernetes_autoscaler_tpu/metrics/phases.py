@@ -1,17 +1,22 @@
-"""Per-phase wall-clock accounting for the planner's host path.
+"""Per-phase wall-clock accounting for the autoscaler's host paths.
 
-The scale-down hot loop crosses five distinct cost domains each RunOnce —
-  encode    host objects → tensors (models/encode, models/incremental)
-  dispatch  device program launches (drain sweep, predicate planes)
-  fetch     device → host transfers (ops/hostfetch batched fetches)
+Both hot loops cross the same five cost domains each RunOnce —
+  encode    host objects → tensors (models/encode, models/incremental,
+            the orchestrator's template-tensor cache)
+  dispatch  device program launches (drain sweep, predicate planes,
+            estimate_all + scoring on the scale-up side)
+  fetch     device → host transfers (ops/hostfetch batched fetches,
+            option-score readback)
   marshal   host-side numpy marshalling for the native confirm tier
-  confirm   the confirmation pass itself (native C++ or Python fallback)
+  confirm   the confirmation pass itself (native C++ or Python fallback;
+            scale-up: the lossy-winner oracle verification)
 — and a single opaque per-loop number cannot say which one regressed.
-`PhaseStats` is a zero-dependency accumulator the planner owns; it ALSO
-mirrors observations into a metrics.Registry histogram
-(`planner_phase_seconds{phase=...}`) when one is attached, so the breakdown
-rides the normal exposition path. bench.py prints `snapshot()` next to the
-headline p50 so the metric ships with its per-phase decomposition.
+`PhaseStats` is a zero-dependency accumulator its owner (scale-down Planner,
+ScaleUpOrchestrator) holds; it ALSO mirrors observations into a
+metrics.Registry histogram (`planner_phase_seconds{phase=...}`) when one is
+attached, so the breakdown rides the normal exposition path. bench.py prints
+`snapshot()` next to the headline p50 so the metric ships with its per-phase
+decomposition.
 
 Phases may nest (a mirror miss inside `marshal` opens a `fetch` span);
 totals then overlap — they are per-domain costs, not a partition of wall

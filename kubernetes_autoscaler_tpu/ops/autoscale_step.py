@@ -47,7 +47,7 @@ class ScaleDownSim(struct.PyTreeNode):
 
 
 @partial(jax.jit, static_argnames=("dims", "max_new_nodes", "strategy",
-                                   "with_constraints"))
+                                   "with_constraints", "mesh"))
 def scale_up_sim(
     nodes: NodeTensors,
     specs: PodGroupTensors,
@@ -58,16 +58,26 @@ def scale_up_sim(
     strategy: str = "least-waste",
     planes=None,
     with_constraints: bool = False,
+    mesh=None,
+    wavefront_plan=None,
 ) -> ScaleUpSim:
-    """Loops A+B of the reference hot path as one program."""
+    """Loops A+B of the reference hot path as one program.
+
+    `mesh` (static: a jax.sharding.Mesh) distributes both halves — the
+    existing-nodes pack over NODES_AXIS, the NG expansion options over
+    PODS_AXIS (parallel/mesh.py axis mapping). `wavefront_plan`
+    (ops/pack.build_wavefront_plan over the host feasibility mask, cached by
+    WavefrontCache) batches the single-device pack scan to depth W < G; both
+    default to the unchanged serial single-chip path."""
     packed = schedule.schedule_pending_on_existing(
         nodes, specs, scheduled, planes=planes, max_zones=dims.max_zones,
-        with_constraints=with_constraints)
+        with_constraints=with_constraints, mesh=mesh,
+        wavefront_plan=wavefront_plan)
     remaining = jnp.maximum(specs.count - packed.scheduled, 0)
     pending = specs.replace(count=remaining)
     est = estimate_all(pending, groups, dims, max_new_nodes,
                        planes=planes, nodes=nodes,
-                       with_constraints=with_constraints)
+                       with_constraints=with_constraints, mesh=mesh)
     sc = scoring.score_options(est, groups)
     best = scoring.best_option(sc, strategy)
     return ScaleUpSim(

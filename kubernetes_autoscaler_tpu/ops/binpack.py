@@ -30,7 +30,12 @@ from kubernetes_autoscaler_tpu.models.cluster_state import (
     PodGroupTensors,
 )
 from kubernetes_autoscaler_tpu.ops import predicates
-from kubernetes_autoscaler_tpu.ops.pack import ffd_order, pack_groups
+from kubernetes_autoscaler_tpu.ops.pack import (
+    _SHARD_MAP_KW,
+    _shard_map,
+    ffd_order,
+    pack_groups,
+)
 
 
 def pack_backend() -> str:
@@ -59,6 +64,22 @@ class EstimateResult(struct.PyTreeNode):
     template_fits: jax.Array   # bool[NG, G] group's exemplar passes template predicates
 
 
+def _pack_option(cap_row, max_new, feas_col, max_new_nodes,
+                 req, count, order, limit_one):
+    """One node group's expansion option: pack every pending group into
+    `max_new` empty template bins. The single body both the serial vmap and
+    the shard_map estimator paths dispatch — their bit-identical contract
+    lives here, not in two copies."""
+    free0 = jnp.broadcast_to(cap_row[None, :],
+                             (max_new_nodes, cap_row.shape[0]))
+    bin_open = jnp.arange(max_new_nodes, dtype=jnp.int32) < max_new
+    mask = feas_col[:, None] & bin_open[None, :]
+    res = pack_groups(free0, mask, req, count, order, limit_one)
+    pods_per_node = res.placed.sum(axis=0)
+    node_cnt = (pods_per_node > 0).sum().astype(jnp.int32)
+    return node_cnt, res.scheduled, pods_per_node, res.free_after
+
+
 def estimate_all(
     specs: PodGroupTensors,
     groups: NodeGroupTensors,
@@ -67,6 +88,7 @@ def estimate_all(
     planes=None,
     nodes=None,
     with_constraints: bool = False,
+    mesh=None,
 ) -> EstimateResult:
     """Compute every node group's expansion option for the pending pod set.
 
@@ -75,7 +97,18 @@ def estimate_all(
     counts / affinity satisfaction from the REAL cluster (planes over `nodes`)
     carry into the estimate — the reference gets this for free because its
     estimator schedules against the forked real snapshot
-    (binpacking_estimator.go:126)."""
+    (binpacking_estimator.go:126).
+
+    `mesh` shards the NG expansion options over PODS_AXIS (each option is an
+    independent pack — no collectives), so a multi-chip mesh computes NG/P
+    options per chip instead of replicating all of them; bit-identical to the
+    unsharded path. Falls back silently when NG does not divide the axis or
+    the constrained tier is active (its planes are node-indexed). NOTE: the
+    sharded path packs with the lax.scan kernel on every shard even where
+    pack_backend() would pick 'pallas' — mesh parallelism currently trades
+    the fused Mosaic kernel for cross-chip scaling (a pallas-inside-shard_map
+    variant is future work); benchmark both on your shape before enabling a
+    mesh on TPU."""
     tmpl_nodes = groups.as_node_tensors(dims)
     # bool[G, NG]: placement-independent predicates vs each template
     # (capacity is enforced by the packer against the empty bins).
@@ -87,6 +120,13 @@ def estimate_all(
         return _estimate_constrained(
             specs, groups, dims, max_new_nodes, planes, nodes,
             mask_gt, order, count)
+
+    if mesh is not None:
+        from kubernetes_autoscaler_tpu.parallel.mesh import PODS_AXIS
+
+        if groups.ng % mesh.shape[PODS_AXIS] == 0:
+            return _estimate_all_sharded(
+                specs, groups, max_new_nodes, mask_gt, order, count, mesh)
 
     if pack_backend() == "pallas":
         from kubernetes_autoscaler_tpu.ops.pallas.pack_kernel import (
@@ -112,19 +152,66 @@ def estimate_all(
         )
 
     def one_group(cap_row, max_new, feas_col):
-        free0 = jnp.broadcast_to(cap_row[None, :], (max_new_nodes, cap_row.shape[0]))
-        bin_open = jnp.arange(max_new_nodes, dtype=jnp.int32) < max_new
-        mask = feas_col[:, None] & bin_open[None, :]
-        res = pack_groups(
-            free0, mask, specs.req, count, order, specs.one_per_node()
-        )
-        pods_per_node = res.placed.sum(axis=0)
-        node_cnt = (pods_per_node > 0).sum().astype(jnp.int32)
-        return node_cnt, res.scheduled, pods_per_node, res.free_after
+        return _pack_option(cap_row, max_new, feas_col, max_new_nodes,
+                            specs.req, count, order, specs.one_per_node())
 
     node_count, scheduled, pods_per_node, free_after = jax.vmap(one_group)(
         groups.cap, groups.max_new, mask_gt.T
     )
+    node_count = jnp.where(groups.valid, node_count, 0)
+    scheduled = scheduled * groups.valid[:, None]
+    return EstimateResult(
+        node_count=node_count,
+        scheduled=scheduled,
+        pods_per_node=pods_per_node,
+        free_after=free_after,
+        template_fits=mask_gt.T,
+    )
+
+
+def _estimate_all_sharded(
+    specs: PodGroupTensors,
+    groups: NodeGroupTensors,
+    max_new_nodes: int,
+    mask_gt: jax.Array,   # bool[G, NG]
+    order: jax.Array,
+    count: jax.Array,
+    mesh,
+) -> EstimateResult:
+    """NG expansion options sharded over PODS_AXIS (no inter-shard traffic).
+
+    Each device packs its slice of node groups against the full (replicated)
+    pending set — the distributed form of the reference's per-nodegroup
+    estimator goroutines (orchestrator.go:379), mapped onto the mesh axis the
+    way Tesserae shards its machine axis. The NODES_AXIS of the mesh is left
+    replicated here: template bins are per-option scratch, not cluster nodes."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from kubernetes_autoscaler_tpu.parallel.mesh import PODS_AXIS
+
+    limit_one = specs.one_per_node()
+
+    @partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=(P(PODS_AXIS, None), P(PODS_AXIS), P(PODS_AXIS, None),
+                  P(None, None), P(None), P(None), P(None)),
+        out_specs=(P(PODS_AXIS), P(PODS_AXIS, None), P(PODS_AXIS, None),
+                   P(PODS_AXIS, None, None)),
+        **_SHARD_MAP_KW,
+    )
+    def run(cap_l, max_new_l, feas_l, req_r, count_r, order_r, limone_r):
+        def one_group(cap_row, max_new, feas_col):
+            return _pack_option(cap_row, max_new, feas_col, max_new_nodes,
+                                req_r, count_r, order_r, limone_r)
+
+        return jax.vmap(one_group)(cap_l, max_new_l, feas_l)
+
+    node_count, scheduled, pods_per_node, free_after = run(
+        groups.cap, groups.max_new, mask_gt.T,
+        specs.req, count, order, limit_one)
     node_count = jnp.where(groups.valid, node_count, 0)
     scheduled = scheduled * groups.valid[:, None]
     return EstimateResult(

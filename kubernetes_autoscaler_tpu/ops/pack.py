@@ -14,6 +14,15 @@ binpacking is inherently sequential across groups (SURVEY.md §7 hard part),
 but each scan step does all-nodes work on the VPU, so the serial depth is G
 (≈ distinct pod shapes), not P (pods).
 
+Wavefront packing (`pack_groups_wavefront`) cuts that serial depth further:
+groups whose feasibility masks touch DISJOINT node sets cannot interact
+through the free-capacity carry, so they can be placed in one scan step
+without changing first-fit results. A host-side precedence-respecting
+coloring of the G×G mask-overlap graph (`compute_wavefronts`) batches the
+scan into W ≤ G wavefronts; `WavefrontCache` memoizes the coloring across
+control loops keyed by a mask fingerprint (the planner's `_marshal_artifacts`
+idiom). When masks overlap heavily W ≈ G and callers keep the serial scan.
+
 Tie-break/ordering contract: nodes are filled in ascending index order; callers
 control placement preference by passing a node permutation (the reference's
 pluggable NodeOrdering, plugin_runner.go:89-131, becomes "sort the axis").
@@ -23,6 +32,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 # shard_map compatibility: the public `jax.shard_map` (with its `check_vma`
@@ -89,6 +99,15 @@ def pack_groups(
     return PackResult(free_after=free_after, placed=placed, scheduled=placed.sum(axis=-1))
 
 
+# Standalone dispatch entry for ONE-SHOT host callers outside a larger jit:
+# the free-capacity input is DONATED, so XLA reuses its buffer for
+# free_after instead of allocating a second [N, R] plane per call. The
+# caller must not touch `free` afterwards (donation invalidates the device
+# buffer; passing a host array is always safe — each call uploads a fresh
+# one). Inside scale_up_sim the scan carry already aliases.
+pack_groups_jit = jax.jit(pack_groups, donate_argnums=(0,))
+
+
 def pack_groups_sharded(
     mesh,
     free: jnp.ndarray,       # i32[N, R]  N divisible by the nodes-axis size
@@ -151,6 +170,181 @@ def pack_groups_sharded(
         jnp.asarray(free), jnp.asarray(mask), jnp.asarray(req),
         jnp.asarray(count), jnp.asarray(order), jnp.asarray(limit_one))
     return PackResult(free_after=free_after, placed=placed, scheduled=scheduled)
+
+
+class WavefrontPlan(struct.PyTreeNode):
+    """Conflict-free batching of the group scan into W wavefronts.
+
+    `waves[w]` holds the group indices placed in step w (-1 = padding). Within
+    one wavefront all pairwise feasibility masks are disjoint, so placements
+    commute; across wavefronts every conflicting pair keeps its first-fit
+    order (the coloring is precedence-respecting, see compute_wavefronts).
+    Static fields key the jit cache: a plan reshape recompiles, a same-shape
+    re-coloring does not.
+    """
+
+    waves: jax.Array  # i32[W, S] group ids per wavefront, -1-padded
+    n_waves: int = struct.field(pytree_node=False, default=0)      # real W
+    n_active: int = struct.field(pytree_node=False, default=0)     # groups colored
+
+    @property
+    def worthwhile(self) -> bool:
+        """True when batching actually shortens the scan (W < active groups)."""
+        return self.n_waves < self.n_active
+
+
+def compute_wavefronts(mask: np.ndarray, order: np.ndarray,
+                       active: np.ndarray | None = None) -> list[list[int]]:
+    """Precedence-respecting coloring of the mask-overlap graph (host-side).
+
+    layer(g) = 1 + max(layer(h)) over groups h EARLIER in `order` whose masks
+    intersect g's — the longest-conflict-chain layering. Two invariants make
+    the wavefront pack byte-identical to the serial scan:
+      * within a layer, masks are pairwise disjoint (a conflicting earlier
+        group forces a later layer), so placements touch disjoint node sets
+        and commute;
+      * across layers, every conflicting pair keeps its `order` sequence, so
+        the free-capacity carry on shared nodes evolves exactly as serially.
+    Plain greedy smallest-color would violate the second invariant (a group
+    could be colored BEFORE an earlier conflicting group's color).
+
+    `active` masks out groups that cannot place anything (invalid / count 0);
+    they are appended to wavefront 0 — their placement rows are all-zero
+    either way, and keeping them out of the conflict graph stops an
+    everything-overlapping dead group from serializing live ones.
+    """
+    mask = np.asarray(mask, bool)
+    order = np.asarray(order)
+    g = mask.shape[0]
+    if active is None:
+        active = mask.any(axis=1)
+    else:
+        active = np.asarray(active, bool) & mask.any(axis=1)
+    conflict = (mask.astype(np.int32) @ mask.astype(np.int32).T) > 0
+    layer = np.zeros((g,), np.int64)
+    seen: list[int] = []
+    for gi in order.tolist():
+        if not active[gi]:
+            continue
+        prev = [h for h in seen if conflict[gi, h]]
+        layer[gi] = (max(layer[h] for h in prev) + 1) if prev else 0
+        seen.append(gi)
+    n_waves = int(layer[seen].max()) + 1 if seen else 1
+    waves: list[list[int]] = [[] for _ in range(n_waves)]
+    for gi in order.tolist():          # deterministic: order position within wave
+        if active[gi]:
+            waves[int(layer[gi])].append(int(gi))
+        else:
+            waves[0].append(int(gi))   # dead group: zero placement, any step
+    return waves
+
+
+def build_wavefront_plan(mask: np.ndarray, order: np.ndarray,
+                         active: np.ndarray | None = None,
+                         pad_w: int = 4, pad_s: int = 8) -> WavefrontPlan:
+    """compute_wavefronts + padding to shape buckets (bounded recompiles)."""
+    waves = compute_wavefronts(mask, order, active=active)
+    w = len(waves)
+    s = max(max((len(wv) for wv in waves), default=1), 1)
+    w_pad = ((w + pad_w - 1) // pad_w) * pad_w
+    s_pad = ((s + pad_s - 1) // pad_s) * pad_s
+    arr = np.full((w_pad, s_pad), -1, np.int32)
+    for i, wv in enumerate(waves):
+        arr[i, : len(wv)] = wv
+    n_active = int(np.asarray(mask, bool).any(axis=1).sum()) \
+        if active is None else int(np.count_nonzero(active))
+    return WavefrontPlan(waves=jnp.asarray(arr), n_waves=w,
+                         n_active=max(n_active, 1))
+
+
+class WavefrontCache:
+    """Single-entry plan cache keyed by the (mask, order) byte fingerprint.
+
+    The planner's `_marshal_artifacts` idiom: the coloring is host work that
+    only changes when group COMPOSITION changes; count-only churn between
+    control loops is a hit. Counters feed PhaseStats.events / test assertions.
+    """
+
+    def __init__(self, pad_w: int = 4, pad_s: int = 8):
+        self._entry: tuple | None = None
+        self.pad_w = pad_w
+        self.pad_s = pad_s
+        self.hits = 0
+        self.misses = 0
+
+    def plan(self, mask: np.ndarray, order: np.ndarray,
+             active: np.ndarray | None = None,
+             phases=None) -> WavefrontPlan:
+        mask = np.asarray(mask, bool)
+        order = np.asarray(order)
+        act = None if active is None else np.asarray(active, bool)
+        fp = (mask.shape, mask.tobytes(), order.tobytes(),
+              None if act is None else act.tobytes())
+        if self._entry is not None and self._entry[0] == fp:
+            self.hits += 1
+            if phases is not None:
+                phases.bump("wavefront_cache_hit")
+            return self._entry[1]
+        self.misses += 1
+        if phases is not None:
+            phases.bump("wavefront_cache_miss")
+        plan = build_wavefront_plan(mask, order, active=act,
+                                    pad_w=self.pad_w, pad_s=self.pad_s)
+        self._entry = (fp, plan)
+        return plan
+
+
+def pack_groups_wavefront(
+    free: jnp.ndarray,       # i32[N, R]
+    mask: jnp.ndarray,       # bool[G, N]
+    req: jnp.ndarray,        # i32[G, R]
+    count: jnp.ndarray,      # i32[G]
+    limit_one: jnp.ndarray,  # bool[G]
+    plan: WavefrontPlan,
+) -> PackResult:
+    """First-fit pack with the group scan batched into the plan's wavefronts.
+
+    Byte-identical to pack_groups(free, mask, req, count, order, limit_one)
+    when `plan` was built from (a superset of) `mask` in the same `order`:
+    each scan step performs segmented placement arithmetic for a whole
+    wavefront — per-slot fit counts, per-slot node-prefix sums, one fused
+    carry update — so the serial depth is W, not G. A plan mask that is a
+    SUPERSET of the runtime mask is safe (conflicts only shrink; disjointness
+    and precedence both survive), which is why callers may build the plan
+    from placement-independent predicates and still apply runtime-only
+    restrictions (e.g. resident self-anti-affinity) in `mask`.
+    """
+    free = jnp.asarray(free)
+    mask = jnp.asarray(mask)
+    req = jnp.asarray(req)
+    count = jnp.asarray(count)
+    limit_one = jnp.asarray(limit_one)
+    g_total, n = mask.shape
+
+    def step(free_c, wave):                     # wave: i32[S]
+        slot_ok = wave >= 0
+        gid = jnp.maximum(wave, 0)
+        reqw = req[gid]                         # i32[S, R]
+        cntw = jnp.where(slot_ok, count[gid], 0)
+        c = jax.vmap(fit_count, in_axes=(None, 0))(free_c, reqw)   # [S, N]
+        c = jnp.where(mask[gid] & slot_ok[:, None], c, 0)
+        c = jnp.where(limit_one[gid][:, None], jnp.minimum(c, 1), c)
+        c = jnp.minimum(c, cntw[:, None])
+        cum = jnp.cumsum(c, axis=1)
+        place = jnp.clip(cntw[:, None] - (cum - c), 0, c)          # [S, N]
+        # disjoint masks ⇒ each node is touched by ≤ 1 slot: the summed
+        # update equals the serial per-group subtraction
+        free_c = free_c - (place[:, :, None] * reqw[:, None, :]).sum(axis=0)
+        return free_c, place
+
+    free_after, placed_w = jax.lax.scan(step, free, plan.waves)    # [W, S, N]
+    flat_ids = plan.waves.reshape(-1)
+    flat_place = placed_w.reshape(-1, n)
+    # pad slots carry all-zero rows (slot_ok masking) → .add is a scatter-set
+    placed = jnp.zeros((g_total, n), placed_w.dtype).at[
+        jnp.maximum(flat_ids, 0)].add(flat_place)
+    return PackResult(free_after=free_after, placed=placed,
+                      scheduled=placed.sum(axis=-1))
 
 
 def ffd_order(req: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:

@@ -11,6 +11,7 @@ shape), and the full pods×nodes evaluation replaces hint lookups.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from kubernetes_autoscaler_tpu.models.cluster_state import (
@@ -19,7 +20,14 @@ from kubernetes_autoscaler_tpu.models.cluster_state import (
     ScheduledPodTensors,
 )
 from kubernetes_autoscaler_tpu.ops import predicates
-from kubernetes_autoscaler_tpu.ops.pack import PackResult, ffd_order, pack_groups
+from kubernetes_autoscaler_tpu.ops.pack import (
+    PackResult,
+    WavefrontPlan,
+    ffd_order,
+    pack_groups,
+    pack_groups_sharded,
+    pack_groups_wavefront,
+)
 
 
 def resident_group_counts(
@@ -43,6 +51,8 @@ def schedule_pending_on_existing(
     planes=None,
     max_zones: int = 16,
     with_constraints: bool = False,
+    mesh=None,
+    wavefront_plan: WavefrontPlan | None = None,
 ) -> PackResult:
     """First-fit all pending groups onto current free capacity.
 
@@ -51,7 +61,13 @@ def schedule_pending_on_existing(
     the role of filter-out-schedulable in RunOnce (static_autoscaler.go:530).
 
     `with_constraints` (STATIC) selects the topology-coupled pack
-    (ops/constrained.py) when the snapshot carries spread/affinity groups."""
+    (ops/constrained.py) when the snapshot carries spread/affinity groups.
+
+    `mesh` shards the N axis over NODES_AXIS (pack_groups_sharded); a
+    `wavefront_plan` (built from the placement-independent feasibility mask —
+    see plan_wavefronts) batches the group scan to depth W. The two are
+    mutually exclusive (sharded wins): the wavefront segmented arithmetic is
+    single-program, the sharded scan is per-group collective."""
     mask = predicates.feasibility_mask(nodes, specs, check_resources=False)
     if scheduled is not None:
         resident = resident_group_counts(scheduled, specs.g, nodes.n)
@@ -67,6 +83,49 @@ def schedule_pending_on_existing(
         return constrained.pack_groups_constrained(
             nodes.free(), mask, specs.req, count, order,
             specs.one_per_node(), cons, max_zones)
+    if mesh is not None:
+        from kubernetes_autoscaler_tpu.parallel.mesh import NODES_AXIS
+
+        if nodes.n % mesh.shape[NODES_AXIS] == 0:
+            return pack_groups_sharded(
+                mesh, nodes.free(), mask, specs.req, count, order,
+                specs.one_per_node())
+    if wavefront_plan is not None and wavefront_plan.worthwhile:
+        # the plan mask is a SUPERSET of the runtime mask (it omits the
+        # resident anti-affinity subtraction) — safe, see pack_groups_wavefront
+        return pack_groups_wavefront(
+            nodes.free(), mask, specs.req, count, specs.one_per_node(),
+            wavefront_plan)
     return pack_groups(
         nodes.free(), mask, specs.req, count, order, specs.one_per_node()
     )
+
+
+def plan_wavefronts(nodes: NodeTensors, specs: PodGroupTensors,
+                    cache, phases=None) -> WavefrontPlan:
+    """Host-side wavefront planning for the existing-nodes pack.
+
+    Evaluates the placement-independent feasibility mask (one small device
+    program), fetches it, and asks the cache for a coloring. Deliberately
+    SKIPS the resident self-anti-affinity subtraction the kernel applies at
+    runtime: the plan mask must be a superset of every runtime mask so that
+    resident churn between control loops cannot invalidate the coloring —
+    only composition changes (selectors/taints/labels) miss the cache. For
+    the same reason every count-dependence is kept out of the fingerprint:
+    `active` is `valid` alone, and the layering order is
+    `ffd_order(req, valid)` rather than the runtime's
+    `ffd_order(req, valid & count>0)`. The two orders differ only in where
+    count-0 groups sit, and a count-0 group places nothing wherever it
+    sits (its placement row is all-zero and the carry is untouched), while
+    the relative order of count>0 groups is identical under the stable
+    sort — so the pack stays byte-identical and count churn (including a
+    group's count crossing zero) is always a cache hit, never a
+    plan-reshape recompile of the jitted sim."""
+    import numpy as np
+
+    mask = predicates.feasibility_mask(nodes, specs, check_resources=False)
+    order = ffd_order(specs.req, specs.valid)
+    host = jax.device_get((mask, order, specs.valid))
+    mask_h, order_h, active_h = (np.asarray(host[0]), np.asarray(host[1]),
+                                 np.asarray(host[2]))
+    return cache.plan(mask_h, order_h, active=active_h, phases=phases)

@@ -1,0 +1,278 @@
+"""Wavefront packing: conflict-free batching of the first-fit group scan.
+
+The wavefront pack must be BYTE-identical to the serial `pack_groups` scan —
+the precedence-respecting coloring only batches groups whose feasibility
+masks touch disjoint node sets (they cannot interact through the
+free-capacity carry) and never reorders a conflicting pair. Property-tested
+over randomized overlapping/disjoint masks, counts and limit_one; the
+coloring cache must hit on count churn and miss on composition churn.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_autoscaler_tpu.ops.pack import (
+    WavefrontCache,
+    build_wavefront_plan,
+    compute_wavefronts,
+    ffd_order,
+    pack_groups,
+    pack_groups_jit,
+    pack_groups_wavefront,
+)
+
+
+def _assert_pack_equal(ref, got):
+    np.testing.assert_array_equal(np.asarray(ref.placed), np.asarray(got.placed))
+    np.testing.assert_array_equal(np.asarray(ref.free_after),
+                                  np.asarray(got.free_after))
+    np.testing.assert_array_equal(np.asarray(ref.scheduled),
+                                  np.asarray(got.scheduled))
+
+
+def _random_instance(rng, n=48, g=14, r=4, style="mixed"):
+    free = rng.integers(0, 30, size=(n, r)).astype(np.int32)
+    req = rng.integers(0, 5, size=(g, r)).astype(np.int32)
+    count = rng.integers(0, 50, size=(g,)).astype(np.int32)
+    mask = np.zeros((g, n), bool)
+    for gi in range(g):
+        if style == "overlap" or (style == "mixed" and gi % 3 == 0):
+            mask[gi] = rng.random(n) < 0.6        # overlaps everything
+        elif style == "disjoint" or (style == "mixed" and gi % 3 == 1):
+            blk = gi % 4                           # block-partitioned
+            mask[gi, blk * (n // 4):(blk + 1) * (n // 4)] = True
+        else:
+            mask[gi] = rng.random(n) < 0.2         # sparse random
+    limit_one = rng.random(g) < 0.3
+    order = np.asarray(ffd_order(jnp.asarray(req), jnp.ones((g,), bool)))
+    return free, mask, req, count, order, limit_one
+
+
+@pytest.mark.parametrize("style", ["mixed", "overlap", "disjoint"])
+@pytest.mark.parametrize("seed", [0, 1, 2, 7])
+def test_wavefront_matches_serial_property(style, seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(5):
+        free, mask, req, count, order, limit_one = _random_instance(
+            rng, style=style)
+        plan = build_wavefront_plan(mask, order)
+        ref = pack_groups(free, mask, req, count, order, limit_one)
+        got = pack_groups_wavefront(free, mask, req, count, limit_one, plan)
+        _assert_pack_equal(ref, got)
+
+
+def test_pack_groups_jit_donated_entry_matches():
+    """The donated one-shot entry: same results as the traced pack, and the
+    donated free buffer is safely re-uploaded from host arrays per call."""
+    rng = np.random.default_rng(9)
+    free, mask, req, count, order, limit_one = _random_instance(rng)
+    ref = pack_groups(free, mask, req, count, order, limit_one)
+    for _ in range(2):       # repeat: donation must not poison reuse of the
+        got = pack_groups_jit(free, mask, req, count, order, limit_one)
+        _assert_pack_equal(ref, got)       # host-array inputs
+
+
+def test_wavefront_runtime_mask_subset_of_plan_mask():
+    """The documented superset contract: a plan built from the predicate
+    mask stays valid when the kernel's runtime mask removes nodes (resident
+    self-anti-affinity) — conflicts only shrink."""
+    rng = np.random.default_rng(3)
+    free, plan_mask, req, count, order, limit_one = _random_instance(rng)
+    runtime_mask = plan_mask & (rng.random(plan_mask.shape) < 0.7)
+    plan = build_wavefront_plan(plan_mask, order)
+    ref = pack_groups(free, runtime_mask, req, count, order, limit_one)
+    got = pack_groups_wavefront(free, runtime_mask, req, count, limit_one, plan)
+    _assert_pack_equal(ref, got)
+
+
+def test_precedence_not_plain_greedy():
+    """Regression pin for the coloring invariant: with chain conflicts
+    a↔b, b↔c (a,c disjoint), plain smallest-color greedy would put c in
+    wave 0 BEFORE its conflicting predecessor b — the layering must not."""
+    n = 30
+    mask = np.zeros((3, n), bool)
+    mask[0, 0:10] = True                  # a
+    mask[1, 5:20] = True                  # b: conflicts a
+    mask[2, 15:25] = True                 # c: conflicts b, not a
+    order = np.arange(3)
+    waves = compute_wavefronts(mask, order)
+    layer = {g: w for w, wv in enumerate(waves) for g in wv}
+    assert layer[0] == 0 and layer[1] == 1
+    assert layer[2] == 2, "c must come after its conflicting predecessor b"
+    # and the pack agrees with serial on a capacity-contended instance
+    free = np.full((n, 2), 3, np.int32)
+    req = np.ones((3, 2), np.int32)
+    count = np.asarray([25, 40, 28], np.int32)
+    lim = np.zeros((3,), bool)
+    plan = build_wavefront_plan(mask, order)
+    _assert_pack_equal(
+        pack_groups(free, mask, req, count, order, lim),
+        pack_groups_wavefront(free, mask, req, count, lim, plan))
+
+
+def test_disjoint_selectors_collapse_to_one_wave():
+    g, n = 8, 64
+    mask = np.zeros((g, n), bool)
+    for gi in range(g):                   # perfect partition: no conflicts
+        mask[gi, gi * 8:(gi + 1) * 8] = True
+    plan = build_wavefront_plan(mask, np.arange(g))
+    assert plan.n_waves == 1
+    assert plan.worthwhile
+
+
+def test_cache_hits_on_count_churn_misses_on_composition():
+    rng = np.random.default_rng(5)
+    free, mask, req, count, order, limit_one = _random_instance(rng)
+    cache = WavefrontCache()
+    p1 = cache.plan(mask, order)
+    assert (cache.hits, cache.misses) == (0, 1)
+    # count-only churn: same mask/order → hit, same plan object
+    p2 = cache.plan(mask, order)
+    assert (cache.hits, cache.misses) == (1, 1)
+    assert p2 is p1
+    # composition churn: a group's selector flips nodes → miss
+    mask2 = mask.copy()
+    mask2[0] = ~mask2[0]
+    cache.plan(mask2, order)
+    assert (cache.hits, cache.misses) == (1, 2)
+    # PhaseStats event mirroring
+    from kubernetes_autoscaler_tpu.metrics.phases import PhaseStats
+
+    ph = PhaseStats()
+    cache.plan(mask2, order, phases=ph)
+    cache.plan(mask, order, phases=ph)
+    assert ph.events == {"wavefront_cache_hit": 1, "wavefront_cache_miss": 1}
+
+
+def test_schedule_pending_with_wavefront_plan_matches():
+    """End-to-end through schedule_pending_on_existing: plan built by
+    plan_wavefronts (superset mask) vs the serial path, on the
+    selector-partitioned world where the plan is WORTHWHILE — the wavefront
+    kernel actually runs rather than the serial fallback."""
+    import __graft_entry__ as graft
+
+    from kubernetes_autoscaler_tpu.ops.schedule import (
+        plan_wavefronts,
+        schedule_pending_on_existing,
+    )
+
+    enc, _groups = graft._partitioned_world()
+    cache = WavefrontCache()
+    plan = plan_wavefronts(enc.nodes, enc.specs, cache)
+    assert plan.worthwhile and plan.n_waves < plan.n_active
+    ref = schedule_pending_on_existing(enc.nodes, enc.specs, enc.scheduled)
+    got = schedule_pending_on_existing(enc.nodes, enc.specs, enc.scheduled,
+                                       wavefront_plan=plan)
+    _assert_pack_equal(ref, got)
+    # second loop, counts changed — including groups crossing zero (the
+    # resident-only groups go 0→1 pending, which reorders the RUNTIME ffd
+    # order): still a cache hit, because the plan's layering order is
+    # count-independent and count-0 groups are placement no-ops
+    specs2 = enc.specs.replace(count=enc.specs.count + 1)
+    plan2 = plan_wavefronts(enc.nodes, specs2, cache)
+    assert cache.hits == 1 and plan2 is plan
+    _assert_pack_equal(
+        schedule_pending_on_existing(enc.nodes, specs2, enc.scheduled),
+        schedule_pending_on_existing(enc.nodes, specs2, enc.scheduled,
+                                     wavefront_plan=plan2))
+
+
+def test_scale_up_sim_with_wavefront_plan_matches():
+    """Partitioned world: the sim's wavefront path (plan worthwhile, kernel
+    engaged) ≡ the serial sim, decision for decision."""
+    import __graft_entry__ as graft
+
+    from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS
+    from kubernetes_autoscaler_tpu.ops.autoscale_step import scale_up_sim
+    from kubernetes_autoscaler_tpu.ops.schedule import plan_wavefronts
+
+    enc, groups = graft._partitioned_world()
+    plan = plan_wavefronts(enc.nodes, enc.specs, WavefrontCache())
+    assert plan.worthwhile
+    ref = scale_up_sim(enc.nodes, enc.specs, enc.scheduled, groups,
+                       DEFAULT_DIMS, 16, "least-waste")
+    got = scale_up_sim(enc.nodes, enc.specs, enc.scheduled, groups,
+                       DEFAULT_DIMS, 16, "least-waste",
+                       wavefront_plan=plan)
+    assert int(ref.best) == int(got.best)
+    np.testing.assert_array_equal(np.asarray(ref.fits_existing),
+                                  np.asarray(got.fits_existing))
+    np.testing.assert_array_equal(np.asarray(ref.estimate.node_count),
+                                  np.asarray(got.estimate.node_count))
+    np.testing.assert_array_equal(np.asarray(ref.remaining),
+                                  np.asarray(got.remaining))
+
+
+def test_scale_up_sim_overlapping_world_falls_back_identically():
+    """Mixed small world (masks overlap, W == G): the sim must silently use
+    the serial scan and still agree — the wiring-level fallback contract."""
+    import __graft_entry__ as graft
+
+    from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS
+    from kubernetes_autoscaler_tpu.ops.autoscale_step import scale_up_sim
+    from kubernetes_autoscaler_tpu.ops.schedule import plan_wavefronts
+
+    enc, groups = graft._small_world(n_nodes=64)
+    plan = plan_wavefronts(enc.nodes, enc.specs, WavefrontCache())
+    ref = scale_up_sim(enc.nodes, enc.specs, enc.scheduled, groups,
+                       DEFAULT_DIMS, 16, "least-waste")
+    got = scale_up_sim(enc.nodes, enc.specs, enc.scheduled, groups,
+                       DEFAULT_DIMS, 16, "least-waste",
+                       wavefront_plan=plan)
+    assert int(ref.best) == int(got.best)
+    np.testing.assert_array_equal(np.asarray(ref.fits_existing),
+                                  np.asarray(got.fits_existing))
+
+
+@pytest.mark.slow
+def test_wavefront_microbench_serial_depth():
+    """Selector-partitioned fixture: the scan depth must drop from G to W
+    (W == n_waves, asserted via the plan + coloring cache counters) and the
+    wavefront pack must not be slower than ~the serial pack at equal work."""
+    import time
+
+    rng = np.random.default_rng(11)
+    g, n, r = 48, 512, 4
+    free = rng.integers(5, 40, size=(n, r)).astype(np.int32)
+    req = rng.integers(1, 5, size=(g, r)).astype(np.int32)
+    count = rng.integers(1, 80, size=(g,)).astype(np.int32)
+    mask = np.zeros((g, n), bool)
+    shard = n // 8
+    for gi in range(g):                       # 8 node pools, 6 groups each
+        blk = gi % 8
+        mask[gi, blk * shard:(blk + 1) * shard] = True
+    order = np.asarray(ffd_order(jnp.asarray(req), jnp.ones((g,), bool)))
+    cache = WavefrontCache()
+    plan = cache.plan(mask, order)
+    assert cache.misses == 1
+    assert plan.n_waves < g, "partitioned selectors must batch: W < G"
+    assert plan.n_waves <= 6 + 1              # ≤ groups per pool (+1 slack)
+
+    ser = jax.jit(pack_groups)
+    wav = jax.jit(pack_groups_wavefront)
+    args_s = (jnp.asarray(free), jnp.asarray(mask), jnp.asarray(req),
+              jnp.asarray(count), jnp.asarray(order),
+              jnp.zeros((g,), bool))
+    args_w = (jnp.asarray(free), jnp.asarray(mask), jnp.asarray(req),
+              jnp.asarray(count), jnp.zeros((g,), bool), plan)
+    ref = jax.block_until_ready(ser(*args_s))
+    got = jax.block_until_ready(wav(*args_w))
+    _assert_pack_equal(ref, got)
+
+    def clock(f, a, iters=30):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(*a)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    t_serial, t_wave = clock(ser, args_s), clock(wav, args_w)
+    print(f"[microbench] W={plan.n_waves} G={g} serial={t_serial * 1e3:.2f}ms "
+          f"wavefront={t_wave * 1e3:.2f}ms")
+    # CPU wall clock is far too noisy to assert on (observed 4x swings
+    # between consecutive runs); the hard assertions are the W < G depth
+    # reduction and the byte equality above — the wall-clock win is a
+    # TPU-serial-depth property, reported here for the record only.
