@@ -220,6 +220,12 @@ def main() -> None:
                          "sim + plan + confirm) at --nodes/--pods scale; "
                          "prints a second runonce_e2e_p50 JSON line")
     ap.add_argument("--e2e-loops", type=int, default=8)
+    ap.add_argument("--trace", default="",
+                    help="write a Perfetto/Chrome trace of recorded RunOnce "
+                         "loops (flight recorder, metrics/trace.py) — "
+                         "planner + orchestrator phase spans and a sidecar "
+                         "RPC sharing the final loop's trace id — to this "
+                         "path; runs even in --smoke mode")
     args = ap.parse_args()
 
     if args.smoke:
@@ -276,10 +282,15 @@ def run_bench(args, metric: str) -> None:
     jax, dev, scale_up_sim = with_retries(with_timeout(_init), "backend init")
     import jax.numpy as jnp
 
+    from kubernetes_autoscaler_tpu.metrics.metrics import Registry
     from kubernetes_autoscaler_tpu.metrics.phases import PhaseStats
     from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS
 
-    phases = PhaseStats()
+    # first-class registry metrics (not just bench-JSON fields): phase
+    # histograms + event counters (wavefront cache, transfers) ride the
+    # normal exposition path, and steady_state_recompiles lands as a gauge
+    registry = Registry()
+    phases = PhaseStats(owner="bench", registry=registry)
 
     mesh = None
     if args.mesh_devices > 1:
@@ -390,6 +401,11 @@ def run_bench(args, metric: str) -> None:
     # steady-state recompile accounting: any growth of the jit cache during
     # the measurement loop means a shape/plan leak — the JSON asserts zero
     steady_recompiles = step._cache_size() - compiles_before
+    registry.gauge(
+        "steady_state_recompiles",
+        help="jit-cache growth across the steady measurement loop "
+             "(nonzero = a shape or plan leak recompiling XLA programs)",
+    ).set(float(steady_recompiles))
 
     with phases.phase("fetch"):
         best = int(out.best)
@@ -450,7 +466,16 @@ def run_bench(args, metric: str) -> None:
                 "vs_baseline": 0.0,
                 "error": f"{type(e).__name__}: {e}",
             }), flush=True)
-    if args.scaledown or args.e2e:
+    if args.trace:
+        try:
+            with_timeout(lambda: bench_trace(args, args.trace), seconds=600)()
+            print("[bench] registry exposition:\n" + registry.expose_text(),
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"[bench] trace phase failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+    if args.scaledown or args.e2e or args.trace:
         print(primary_line, flush=True)
 
 
@@ -561,6 +586,106 @@ def bench_scaledown(args) -> None:
         f"within_50ms_target={'yes' if pdb_ms <= 50.0 else 'no'}",
         file=sys.stderr,
     )
+
+
+def bench_trace(args, path: str) -> None:
+    """Flight-recorder smoke (docs/OBSERVABILITY.md): a few RunOnce loops at
+    toy scale with the tracer on, dumped as ONE Perfetto file. The pending
+    pods fit no template, so the scale-up orchestrator runs its full phase
+    set without scaling and the scale-down planner runs in the SAME loop —
+    one trace carries nested spans from both, plus a sidecar RPC (gRPC over
+    localhost) sharing the final loop's trace id across processes."""
+    from kubernetes_autoscaler_tpu.config.options import (
+        AutoscalingOptions,
+        NodeGroupDefaults,
+    )
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+    from kubernetes_autoscaler_tpu.metrics import trace
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=16000, mem_mib=65536, pods=110)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=64)
+    for i in range(32):
+        nd = build_test_node(f"n{i}", cpu_milli=16000, mem_mib=65536, pods=110)
+        fake.add_existing_node("ng1", nd)
+        per_pod = 1600 if i < 8 else 6400   # low-util band → planner verdicts
+        for j in range(2):
+            fake.add_pod(build_test_pod(
+                f"r{i}-{j}", cpu_milli=per_pod, mem_mib=1024,
+                owner_name=f"rs{i % 5}", node_name=nd.name))
+    for i in range(4):   # unfittable: orchestrator runs, never scales
+        fake.add_pod(build_test_pod(f"big{i}", cpu_milli=64000, mem_mib=1024,
+                                    owner_name="big-rs"))
+    opts = AutoscalingOptions(
+        node_shape_bucket=64, group_shape_bucket=16,
+        max_new_nodes_static=32, max_pods_per_node=16, drain_chunk=32,
+        scale_down_delay_after_add_s=0.0,
+        scale_down_delay_after_failure_s=0.0,
+        flight_recorder_capacity=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=3600.0,   # plan, never actuate
+            scale_down_unready_time_s=3600.0),
+    )
+    a = StaticAutoscaler(fake.provider, fake, options=opts, eviction_sink=fake)
+    a.run_once(now=1000.0)   # cold loop (compiles) — recorded in the ring
+    a.run_once(now=1010.0)   # steady loop — recorded
+    # final loop under an OWNED tracer so the sidecar RPC lands inside the
+    # same trace id as the RunOnce spans
+    tracer = trace.Tracer()
+    with trace.active(tracer):
+        with tracer.span("bench-loop", cat="bench"):
+            a.run_once(now=1020.0)
+            _trace_sidecar_rpc()
+    a.flight_recorder.record(tracer)
+    out = a.flight_recorder.dump(path)
+    doc = a.flight_recorder.to_chrome_trace()
+    by_cat: dict = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") == "X":
+            by_cat[e["cat"]] = by_cat.get(e["cat"], 0) + 1
+    print(f"[bench-trace] wrote {out}: {len(doc['traceEvents'])} events, "
+          f"spans by category {json.dumps(by_cat, sort_keys=True)}, "
+          f"trace_ids={doc['otherData']['trace_ids']}", file=sys.stderr)
+
+
+def _trace_sidecar_rpc() -> None:
+    """One ApplyDelta + ScaleDownSim against a localhost gRPC sidecar under
+    the ACTIVE tracer — the cross-process hop on the bench trace. Degrades
+    to a stderr note when grpc or the native codec is unavailable (the
+    local-process spans still make a complete trace)."""
+    try:
+        from kubernetes_autoscaler_tpu.sidecar.server import (
+            SimulatorClient,
+            SimulatorService,
+            make_grpc_server,
+        )
+        from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+        from kubernetes_autoscaler_tpu.utils.testing import (
+            build_test_node,
+            build_test_pod,
+        )
+
+        service = SimulatorService(node_bucket=16, group_bucket=16)
+        server, port = make_grpc_server(service, port=0)
+        server.start()
+        try:
+            c = SimulatorClient(port)
+            w = DeltaWriter()
+            w.upsert_node(build_test_node("s1", cpu_milli=4000, mem_mib=8192))
+            w.upsert_pod(build_test_pod("sp1", cpu_milli=500, mem_mib=256,
+                                        owner_name="rs"))
+            c.apply_delta(w)
+            c.scale_down_sim(threshold=0.5)
+        finally:
+            server.stop(None)
+    except Exception as e:  # noqa: BLE001 — optional phase, never fatal
+        print(f"[bench-trace] sidecar RPC skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
 
 def e2e_metric(args) -> str:

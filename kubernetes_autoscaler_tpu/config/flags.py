@@ -171,6 +171,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--enable-csi-node-aware-scheduling", type=_bool, default=True)
     p.add_argument("--node-removal-latency-tracking-enabled", type=_bool, default=True)
 
+    # flight recorder / trace layer (no reference analog)
+    p.add_argument("--flight-recorder-capacity", type=int, default=8,
+                   help="ring-buffer size of retained per-loop traces "
+                        "(0 disables the tracer entirely)")
+    p.add_argument("--flight-recorder-dir", default="",
+                   help="directory for auto-persisted Perfetto dumps on a "
+                        "loop-budget breach / raise / armed /snapshotz "
+                        "(empty = keep the ring in memory only)")
+    p.add_argument("--loop-wallclock-budget", type=dur, default=0.0,
+                   help="per-RunOnce wall-clock SLO; a breach dumps the "
+                        "flight recorder (0 = no budget)")
+
     # TPU data plane (no reference analog — Go has no tracing/compile cache)
     p.add_argument("--node-shape-bucket", type=int, default=256)
     p.add_argument("--group-shape-bucket", type=int, default=64)
@@ -300,6 +312,9 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         incremental_encode=args.incremental_encode,
         incremental_resync_loops=args.incremental_resync_loops,
         incremental_verify_loops=args.incremental_verify_loops,
+        flight_recorder_capacity=args.flight_recorder_capacity,
+        flight_recorder_dir=args.flight_recorder_dir,
+        loop_wallclock_budget_s=args.loop_wallclock_budget,
     )
 
 

@@ -136,6 +136,14 @@ class AutoscalingOptions:
     # observability / process
     emit_per_nodegroup_metrics: bool = False       # --emit-per-nodegroup-metrics
     debugging_snapshot_enabled: bool = False       # --debugging-snapshot-enabled
+    # flight recorder (metrics/trace.py): ring of the last N RunOnce traces,
+    # auto-persisted on a loop-budget breach / raise / armed /snapshotz.
+    # 0 disables per-loop tracing entirely (the zero-overhead path).
+    flight_recorder_capacity: int = 8              # --flight-recorder-capacity
+    flight_recorder_dir: str = ""                  # --flight-recorder-dir ("" = ring only)
+    # per-loop wall-clock budget; a slower RunOnce counts as an SLO breach
+    # and dumps the flight recorder (0 = no budget)
+    loop_wallclock_budget_s: float = 0.0           # --loop-wallclock-budget
     write_status_configmap: bool = True            # --write-status-configmap
     status_config_map_name: str = "cluster-autoscaler-status"
     max_inactivity_s: float = 10 * 60.0            # --max-inactivity (liveness)

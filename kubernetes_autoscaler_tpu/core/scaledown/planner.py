@@ -148,7 +148,7 @@ class Planner:
         self.latency_tracker = latency_tracker
         # per-phase host-path accounting (metrics/phases.py); the autoscaler
         # attaches its Registry so the breakdown rides /metrics too
-        self.phases = PhaseStats()
+        self.phases = PhaseStats(owner="planner")
         # dense prefilter for evicted-pod injection (tests flip this off to
         # property-check plan equality against the unfiltered scan)
         self.inject_prefilter = True
@@ -174,7 +174,11 @@ class Planner:
             else:
                 miss[key] = dev
         if miss:
-            with self.phases.phase("fetch"):
+            # one batched device→host transfer for every miss; the counter
+            # makes transfer traffic visible on the trace and in the
+            # phase_events_total registry series
+            self.phases.bump("batched_fetch_transfers")
+            with self.phases.phase("fetch", leaves=len(miss)):
                 out.update(fetch_pytree(miss))
         return out
 
@@ -403,7 +407,7 @@ class Planner:
         # The per-candidate device verdict is "in isolation"; the sequential
         # confirmation pass in nodes_to_delete() resolves interactions.
         dest_allowed = np.ones((enc.nodes.n,), dtype=bool)
-        with self.phases.phase("dispatch"):
+        with self.phases.phase("dispatch", candidates=len(eligible_idx)):
             removal = simulate_removals(
                 enc.nodes, enc.specs, enc.scheduled,
                 jnp.asarray(cand), jnp.asarray(dest_allowed),

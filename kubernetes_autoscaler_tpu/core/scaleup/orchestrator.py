@@ -89,7 +89,7 @@ class ScaleUpOrchestrator:
         # mirror of Planner.phases on the scale-down side: encode (template
         # tensors), dispatch (estimate + scoring programs), fetch (score
         # readback), confirm (lossy-winner oracle verification)
-        self.phases = PhaseStats()
+        self.phases = PhaseStats(owner="scaleup")
         # optional device mesh threaded into the estimator (NG options over
         # PODS_AXIS; parallel/mesh.py) — None = single-device program
         self.mesh = None
@@ -169,9 +169,10 @@ class ScaleUpOrchestrator:
                 tmpl.unschedulable = False
             templates.append((tmpl, g.max_size() - g.target_size(),
                               getattr(g, "price_per_node", 1.0)))
-        with self.phases.phase("encode"):
+        with self.phases.phase("encode", groups=len(groups)):
             group_tensors = self._group_tensors(templates, enc)
-        with self.phases.phase("dispatch"):
+        with self.phases.phase("dispatch", groups=len(groups),
+                               pending=pending_total):
             est = estimator.estimate_all_groups(enc.specs, group_tensors,
                                                 nodes_count)
             scores = scoring.score_options(est, group_tensors, specs=enc.specs)
@@ -373,6 +374,7 @@ class ScaleUpOrchestrator:
             gt = cached[1]
             ng_pad = pad_to(max(len(templates), 1), 8)
             if gt.ng == ng_pad:
+                self.phases.bump("group_tensor_cache_hit")
                 max_new = np.zeros((ng_pad,), np.int32)
                 price = np.zeros((ng_pad,), np.float32)
                 for i, (_tmpl, mx, pr) in enumerate(templates):
@@ -382,6 +384,10 @@ class ScaleUpOrchestrator:
                                 price_per_node=jnp.asarray(price))
                 self._group_tensor_cache = (fp, gt)
                 return gt
+        # a miss re-encodes + re-uploads the whole NodeGroupTensors — a
+        # recompile-risk event on the trace (new tensor identities feed the
+        # estimator's jit)
+        self.phases.bump("group_tensor_cache_miss")
         gt = encode_node_groups(templates, enc.registry, enc.zone_table,
                                 enc.dims, daemonsets=self.daemonsets)
         self._group_tensor_cache = (fp, gt)

@@ -33,7 +33,9 @@ from kubernetes_autoscaler_tpu.core.scaleup.orchestrator import (
     ScaleUpResult,
 )
 from kubernetes_autoscaler_tpu.expander.strategies import build_expander
+from kubernetes_autoscaler_tpu.metrics import trace
 from kubernetes_autoscaler_tpu.metrics.metrics import HealthCheck, Registry, default_registry
+from kubernetes_autoscaler_tpu.metrics.trace import FlightRecorder
 from kubernetes_autoscaler_tpu.models.api import Node, Pod
 from kubernetes_autoscaler_tpu.models.encode import encode_cluster
 from kubernetes_autoscaler_tpu.observers.nodegroupchange import (
@@ -169,6 +171,12 @@ class StaticAutoscaler:
         # (both directions: scale-down planner and scale-up orchestrator)
         self.planner.phases.registry = self.metrics
         self.scale_up_orchestrator.phases.registry = self.metrics
+        # always-on flight recorder: ring of the last N RunOnce traces,
+        # persisted when a loop breaches its budget, raises, or served an
+        # armed /snapshotz (metrics/trace.py; capacity 0 = tracing off)
+        self.flight_recorder = FlightRecorder(
+            capacity=self.options.flight_recorder_capacity,
+            dump_dir=self.options.flight_recorder_dir)
         self._async_group_of: dict[str, str] = {}
         self.actuator = Actuator(provider, self.options, eviction_sink,
                                  pdb_tracker=self.pdb_tracker,
@@ -240,14 +248,55 @@ class StaticAutoscaler:
 
     def run_once(self, now: float | None = None) -> RunOnceStatus:
         now = self.walltime() if now is None else now
+        # trace ownership: an already-active tracer (bench.py --trace, an
+        # embedding harness) gets a nested RunOnce span and keeps recording
+        # responsibility; otherwise this loop owns a fresh trace and records
+        # it into the flight recorder on the way out
+        outer = trace.current_tracer()
+        tracer = outer
+        if tracer is None and self.flight_recorder.capacity > 0:
+            tracer = trace.Tracer()
+            trace.activate(tracer)
+        dbg = self.debugging_snapshotter
+        armed = dbg is not None and dbg.is_data_collection_allowed()
+        root = tracer.begin("RunOnce", cat="loop", now=now) \
+            if tracer is not None else None
+        t0 = time.perf_counter()
+        error: Exception | None = None
         try:
             return self._run_once_inner(now)
         except Exception as e:
             # liveness + errors_total (reference: errors surface through
             # metrics.RegisterError and fail the HealthCheck's failing clock)
+            error = e
             self.health.mark_failed(now)
             self.metrics.counter("errors_total").inc(type=type(e).__name__)
+            # flush-on-error: an armed /snapshotz must never hang on a loop
+            # that raised — resolve it with the partial payload + the error
+            if dbg is not None and dbg.is_data_collection_allowed():
+                self._feed_snapshot_observability(dbg, tracer)
+                dbg.flush(now, error=f"{type(e).__name__}: {e}")
             raise
+        finally:
+            loop_s = time.perf_counter() - t0
+            # the budget is an SLO, not a tracing feature: breaches count
+            # even with the recorder disabled or under an outer tracer
+            budget = self.options.loop_wallclock_budget_s
+            breach = 0.0 < budget < loop_s
+            if breach:
+                self.metrics.counter("loop_slo_breaches_total").inc()
+            if tracer is not None:
+                tracer.end(root, loop_s=round(loop_s, 6),
+                           **({"error": type(error).__name__}
+                              if error is not None else {}))
+                if outer is None:
+                    trace.activate(None)
+                    reason = ("error" if error is not None
+                              else "slo_breach" if breach
+                              else "snapshotz" if armed else "")
+                    if self.flight_recorder.record(tracer, dump_reason=reason):
+                        self.metrics.counter(
+                            "flight_recorder_dumps_total").inc(reason=reason)
 
     def _run_once_inner(self, now: float) -> RunOnceStatus:
         status = RunOnceStatus()
@@ -256,7 +305,8 @@ class StaticAutoscaler:
             # failed-node taint rollback) must land before this loop reads
             # cluster state
             self._drain_deletion_results(now)
-            self.provider.refresh()
+            with self.metrics.time_function("cloud_provider_refresh"):
+                self.provider.refresh()
             nodes = self.source.list_nodes()
             pods = self.source.list_pods()
 
@@ -406,10 +456,18 @@ class StaticAutoscaler:
                             verify_loops=self.options.incremental_verify_loops,
                         )
                     fails_before = self._encoder.verify_failures
+                    full_before = self._encoder.full_encodes
                     enc = self._encoder.encode(
                         nodes, pods, node_group_ids=node_group_ids,
                         now=now, pdb_namespaced_names=frozenset(pdb_names),
                         namespaces=ns_labels)
+                    if self._encoder.full_encodes > full_before:
+                        # a full re-encode rebuilds device tensors from
+                        # scratch — the loop-level recompile-risk event the
+                        # trace/registry counters track
+                        self.planner.phases.bump(
+                            "encoder_full_encodes",
+                            self._encoder.full_encodes - full_before)
                     if self._encoder.verify_failures > fails_before:
                         self.metrics.counter(
                             "incremental_verify_failures_total").inc(
@@ -520,7 +578,8 @@ class StaticAutoscaler:
                 # persist scale-down intent as soft taints (reference:
                 # actuation/softtaint.go UpdateSoftDeletionTaints) so a
                 # restart resumes the unneeded clocks instead of zeroing them
-                self._sync_soft_taints(nodes)
+                with self.metrics.time_function("soft_taint_unneeded"):
+                    self._sync_soft_taints(nodes)
                 self.metrics.gauge("unneeded_nodes_count").set(
                     len(status.unneeded_nodes)
                 )
@@ -589,6 +648,9 @@ class StaticAutoscaler:
                     pass
 
             if self.debugging_snapshotter is not None:
+                if self.debugging_snapshotter.is_data_collection_allowed():
+                    self._feed_snapshot_observability(
+                        self.debugging_snapshotter, trace.current_tracer())
                 self.debugging_snapshotter.flush(now)
 
             # per-loop metric sweep (reference: metrics.Update* calls spread
@@ -610,6 +672,16 @@ class StaticAutoscaler:
 
             self.health.mark_active(now)
         return status
+
+    def _feed_snapshot_observability(self, dbg, tracer) -> None:
+        """Attach the loop's phase breakdown + trace id to an armed
+        /snapshotz payload so the JSON links to the Perfetto timeline."""
+        dbg.set_phase_stats({
+            "planner": self.planner.phases.snapshot(),
+            "scale_up": self.scale_up_orchestrator.phases.snapshot(),
+        })
+        if tracer is not None:
+            dbg.set_trace_id(tracer.trace_id)
 
     # ---- scale-up dispatch (single vs salvo) ----
 

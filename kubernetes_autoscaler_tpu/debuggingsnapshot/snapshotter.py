@@ -8,6 +8,14 @@ the pending HTTP request receives the JSON once the loop completes.
 Same protocol here: `request_snapshot()` arms it (returns a handle to await),
 StaticAutoscaler calls the setters only when armed (`is_data_collection_
 allowed`), and `flush()` resolves the handle.
+
+A RunOnce that RAISES mid-loop must still resolve the handle — otherwise the
+snapshotter stays armed forever and the `/snapshotz` caller hangs on a dead
+loop. `flush(error=...)` ships whatever partial payload was collected plus
+the error string. Every snapshot also carries the loop's observability keys:
+`phaseStats` (metrics/phases.PhaseStats.snapshot() per owner) and `traceId`
+(the flight-recorder trace covering this loop, metrics/trace.py) so the JSON
+links directly to the Perfetto timeline that explains it.
 """
 
 from __future__ import annotations
@@ -107,11 +115,35 @@ class DebuggingSnapshotter:
                 return
             self._data["errors"] = list(errors)
 
-    def flush(self, now: float | None = None) -> None:
-        """End of RunOnce: resolve the armed handle (reference: Flush)."""
+    def set_phase_stats(self, phases: dict[str, Any]) -> None:
+        """Per-owner PhaseStats.snapshot() dicts for the serving loop."""
         with self._lock:
             if self._armed is None:
                 return
+            self._data["phaseStats"] = phases
+
+    def set_trace_id(self, trace_id: str) -> None:
+        with self._lock:
+            if self._armed is None:
+                return
+            self._data["traceId"] = trace_id
+
+    def flush(self, now: float | None = None, error: str | None = None) -> None:
+        """End of RunOnce: resolve the armed handle (reference: Flush).
+        `error` is the flush-on-error path — the loop raised, so the caller
+        gets the PARTIAL payload plus the error instead of hanging forever
+        on a snapshotter nothing will ever flush again."""
+        with self._lock:
+            if self._armed is None:
+                return
+            if error is None and not self._data:
+                # armed mid-loop AFTER this loop's collection points: stay
+                # armed and serve the NEXT full loop instead of resolving
+                # with an empty payload (error flushes always resolve — a
+                # raised loop must never leave the caller hanging)
+                return
+            if error is not None:
+                self._data["error"] = error
             self._data["timestamp"] = time.time() if now is None else now
             self._armed.payload = json.dumps(self._data, indent=2, default=str)
             self._armed.event.set()

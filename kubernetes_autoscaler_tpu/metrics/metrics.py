@@ -21,13 +21,19 @@ class Counter:
     name: str
     help: str = ""
     _values: dict[tuple, float] = field(default_factory=dict)
+    # updates are read-modify-write and metrics are written from multiple
+    # threads (the sidecar's gRPC pool, the parallel scale-up executor) —
+    # an unlocked inc under contention silently loses increments
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def inc(self, amount: float = 1.0, **labels) -> None:
         key = tuple(sorted(labels.items()))
-        self._values[key] = self._values.get(key, 0.0) + amount
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0.0)
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
 
 
 @dataclass
@@ -35,12 +41,15 @@ class Gauge:
     name: str
     help: str = ""
     _values: dict[tuple, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def set(self, v: float, **labels) -> None:
-        self._values[tuple(sorted(labels.items()))] = v
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = v
 
     def value(self, **labels) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0.0)
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
 
 
 @dataclass
@@ -50,20 +59,23 @@ class Histogram:
     buckets: tuple = _DEFAULT_BUCKETS
     _counts: dict[tuple, list] = field(default_factory=dict)
     _sums: dict[tuple, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def observe(self, v: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
-        counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
-        for i, b in enumerate(self.buckets):
-            if v <= b:
-                counts[i] += 1
-                break
-        else:
-            counts[-1] += 1
-        self._sums[key] = self._sums.get(key, 0.0) + v
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + v
 
     def count(self, **labels) -> int:
-        return sum(self._counts.get(tuple(sorted(labels.items())), []))
+        with self._lock:
+            return sum(self._counts.get(tuple(sorted(labels.items())), []))
 
 
 class Registry:
@@ -104,26 +116,40 @@ class Registry:
     def expose_text(self) -> str:
         """Prometheus exposition format (consumed by the /metrics endpoint)."""
         lines = []
-        for name, m in sorted(self._metrics.items()):
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        for name, m in metrics:
             full = f"{self.prefix}_{name}"
+            if getattr(m, "help", ""):
+                lines.append(f"# HELP {full} {m.help}")
+            # snapshot under the metric's own lock: a scrape racing a
+            # writer thread must neither see torn values nor die on
+            # "dict changed size during iteration"
             if isinstance(m, Counter):
                 lines.append(f"# TYPE {full} counter")
-                for key, v in m._values.items():
+                with m._lock:
+                    values = list(m._values.items())
+                for key, v in values:
                     lines.append(f"{full}{_fmt(key)} {v}")
             elif isinstance(m, Gauge):
                 lines.append(f"# TYPE {full} gauge")
-                for key, v in m._values.items():
+                with m._lock:
+                    values = list(m._values.items())
+                for key, v in values:
                     lines.append(f"{full}{_fmt(key)} {v}")
             elif isinstance(m, Histogram):
                 lines.append(f"# TYPE {full} histogram")
-                for key, counts in m._counts.items():
+                with m._lock:
+                    rows = [(key, list(counts), m._sums.get(key, 0.0))
+                            for key, counts in m._counts.items()]
+                for key, counts, total in rows:
                     cum = 0
                     for i, b in enumerate(m.buckets):
                         cum += counts[i]
                         lines.append(f'{full}_bucket{_fmt(key, le=str(b))} {cum}')
                     cum += counts[-1]
                     lines.append(f'{full}_bucket{_fmt(key, le="+Inf")} {cum}')
-                    lines.append(f"{full}_sum{_fmt(key)} {m._sums.get(key, 0.0)}")
+                    lines.append(f"{full}_sum{_fmt(key)} {total}")
                     lines.append(f"{full}_count{_fmt(key)} {cum}")
         return "\n".join(lines) + "\n"
 

@@ -62,6 +62,41 @@ NA = {
     "overflowing_controllers_count": "pod-injection caps per workload, not per controller cache",
 }
 
+# ---- the function_duration_seconds{function=...} FAMILY ----
+#
+# The reference instruments RunOnce stages with FunctionLabel values
+# (metrics.go:46-80, UpdateDurationFromStart call sites). Each label maps to
+# the label OUR time_function wrapper observes for the same work — so a
+# dashboard ported from the reference can be re-pointed label-for-label.
+# Where the reference splits finer than our loop (its scale-down is three
+# sequential host passes; ours is one fused device sweep + a confirm pass),
+# two labels legitimately land on the same span — documented inline. The
+# per-phase decomposition UNDER each function label is ours alone:
+# planner_phase_seconds{phase=...} and the flight-recorder trace spans
+# (metrics/trace.py) carry what the reference's one histogram cannot.
+FUNCTION_DURATION = {
+    "main": "main",
+    "cloudProviderRefresh": "cloud_provider_refresh",
+    "updateClusterState": "snapshot_build",
+    "filterOutSchedulable": "filter_out_schedulable",
+    "scaleUp": "scale_up",
+    # the device drain sweep IS find-unneeded and find-nodes-to-remove in
+    # one program; both reference labels map onto its span
+    "findUnneeded": "scale_down_update",
+    "scaleDown:findNodesToRemove": "scale_down_update",
+    "scaleDown": "scale_down_confirm",
+    "scaleDown:nodeDeletion": "scale_down_actuate",
+    "scaleDown:softTaintUnneeded": "soft_taint_unneeded",
+}
+
+FUNCTION_DURATION_NA = {
+    "scaleDown:miscOperations": "bookkeeping the reference batches between passes is inline host policy here, nanoseconds not a stage",
+    "poll": "loop scheduling lives in core/loop.py run_loop, outside RunOnce; scan_interval pacing has no duration to observe",
+    "reconfigure": "no in-process config reload: options are immutable per process (flag parity doc)",
+    "autoscaling": "the reference's autoscaling = RunOnce minus poll; identical to our 'main' measurement, not re-observed",
+    "loopWait": "loop pacing sleep, observable as scan_interval minus main; not a function of the loop body",
+}
+
 # The COMPLETE series list of metrics/metrics.go (every `Name:` field,
 # :202-443) — the meta-test (tests/test_metrics_parity.py) asserts
 # EMITTED ∪ NA covers it exactly, mirroring the flag registry's honesty

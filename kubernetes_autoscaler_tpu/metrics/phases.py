@@ -18,10 +18,19 @@ attached, so the breakdown rides the normal exposition path. bench.py prints
 `snapshot()` next to the headline p50 so the metric ships with its per-phase
 decomposition.
 
+Every phase additionally opens a span in the thread's ACTIVE trace
+(metrics/trace.current_tracer — set per RunOnce by StaticAutoscaler's flight
+recorder, or by bench.py --trace), tagged with the owner as its category, so
+planner and orchestrator phases appear on the loop timeline for free. With no
+active tracer the extra cost is a single thread-local read.
+
 Phases may nest (a mirror miss inside `marshal` opens a `fetch` span);
 totals then overlap — they are per-domain costs, not a partition of wall
 clock. `events` is a free-form counter side-channel for cache hit/miss
-accounting (the marshal cache, the elig-plane cache, oracle-call counts).
+accounting (the marshal cache, the elig-plane cache, oracle-call counts);
+each bump mirrors into the trace's counters and, when a registry is
+attached, the `phase_events_total{owner=,event=}` counter — so cache and
+transfer accounting are first-class registry metrics, not bench-JSON-only.
 """
 
 from __future__ import annotations
@@ -30,7 +39,20 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from kubernetes_autoscaler_tpu.metrics import trace
+
 PHASES = ("encode", "dispatch", "fetch", "marshal", "confirm")
+
+# steady-state encode/fetch spans sit well under 1 ms (the whole host share
+# of a loop is tens of ms at 5k nodes) — the registry's default buckets
+# start at 5 ms and would flatten the entire distribution into one bucket
+PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+_PHASE_HELP = ("Per-phase host-path wall clock of the scale-down planner and "
+               "scale-up orchestrator (encode/dispatch/fetch/marshal/confirm "
+               "spans, seconds; sub-ms buckets)")
+_EVENTS_HELP = ("Free-form phase event counters (cache hits/misses, batched "
+                "device transfers, re-estimate dispatches) keyed by owner")
 
 
 @dataclass
@@ -39,9 +61,13 @@ class PhaseStats:
     counts: dict[str, int] = field(default_factory=dict)
     events: dict[str, int] = field(default_factory=dict)
     registry: object | None = None      # optional metrics.Registry
+    owner: str = ""                     # span category: planner | scaleup | …
 
     @contextmanager
-    def phase(self, name: str):
+    def phase(self, name: str, **attrs):
+        tracer = trace.current_tracer()
+        span = (tracer.begin(name, cat=self.owner or "phase", **attrs)
+                if tracer is not None else None)
         t0 = time.perf_counter()
         try:
             yield
@@ -49,12 +75,21 @@ class PhaseStats:
             dt = time.perf_counter() - t0
             self.totals_s[name] = self.totals_s.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
+            if tracer is not None:
+                tracer.end(span)
             if self.registry is not None:
-                self.registry.histogram("planner_phase_seconds").observe(
-                    dt, phase=name)
+                self.registry.histogram(
+                    "planner_phase_seconds", help=_PHASE_HELP,
+                    buckets=PHASE_BUCKETS).observe(dt, phase=name)
 
     def bump(self, event: str, n: int = 1) -> None:
         self.events[event] = self.events.get(event, 0) + n
+        tracer = trace.current_tracer()
+        if tracer is not None:
+            tracer.bump(event, n)
+        if self.registry is not None:
+            self.registry.counter("phase_events_total", help=_EVENTS_HELP).inc(
+                n, owner=self.owner or "phase", event=event)
 
     def snapshot(self) -> dict:
         """One JSON-friendly view: per-phase totals (ms) + spans + events."""

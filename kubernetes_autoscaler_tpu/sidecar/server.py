@@ -15,13 +15,17 @@ from __future__ import annotations
 
 import json
 import threading
+import time as _time
 from dataclasses import dataclass
 
 import numpy as np
 
+from kubernetes_autoscaler_tpu.metrics import trace
+from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+from kubernetes_autoscaler_tpu.metrics.phases import PHASE_BUCKETS
 from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS, Dims
 from kubernetes_autoscaler_tpu.sidecar.native_api import NativeSnapshotState
-from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+from kubernetes_autoscaler_tpu.sidecar.wire import TRACE_ID_HEADER, DeltaWriter
 
 _SERVICE = "katpu.simulator.v1.TpuSimulator"
 
@@ -46,6 +50,9 @@ class SimulatorService:
         self._lock = threading.Lock()
         # KAUX constraint side-channel store (uid -> wire record)
         self._aux: dict[str, dict] = {}
+        # per-RPC metrics, exposed in prometheus text by the Metricz rpc
+        # (the sidecar's /metricz analog — it has no HTTP mux of its own)
+        self.registry = Registry(prefix="katpu_sidecar")
 
     # ---- rpc: ApplyDelta ----
 
@@ -160,6 +167,53 @@ class SimulatorService:
     def health(self) -> dict:
         return {"version": self.state.version, "error": ""}
 
+    # ---- rpc: Metricz ----
+
+    def metricz(self) -> str:
+        """The sidecar's /metricz analog: its Registry (per-RPC counters and
+        duration histograms) in prometheus exposition text. Plain text on
+        the wire, not JSON — scrapeable as-is."""
+        return self.registry.expose_text()
+
+
+def traced_call(service: SimulatorService, method: str, fn,
+                trace_id: str | None = None):
+    """Run one RPC body under the sidecar's observability contract: RPC
+    count/duration always land in `service.registry`; when the caller
+    stamped a trace id into the request metadata, the body runs under a
+    child Tracer with the SAME id and the closed spans come back as the
+    `(result, trace_group)` second element — the shape
+    `metrics/trace.Tracer.add_remote_spans` merges client-side, so one
+    trace covers both processes."""
+    tracer = (trace.Tracer(trace_id=trace_id, process="sidecar")
+              if trace_id else None)
+    prev = trace.activate(tracer) if tracer is not None else None
+    t0 = _time.perf_counter()
+    try:
+        if tracer is not None:
+            idx = tracer.begin(f"sidecar/{method}", cat="sidecar")
+            try:
+                out = fn()
+            finally:
+                tracer.end(idx, version=service.state.version)
+        else:
+            out = fn()
+    finally:
+        if tracer is not None:
+            trace.activate(prev)
+        dt = _time.perf_counter() - t0
+        service.registry.counter(
+            "rpc_total", help="RPCs served, by method").inc(method=method)
+        service.registry.histogram(
+            "rpc_duration_seconds", help="Server-side RPC wall clock",
+            buckets=PHASE_BUCKETS).observe(dt, method=method)
+    group = None
+    if tracer is not None:
+        snap = tracer.snapshot()
+        group = {"trace_id": snap["trace_id"], "process": "sidecar",
+                 "spans": snap["spans"]}
+    return out, group
+
 
 def make_grpc_server(service: SimulatorService, port: int = 50151,
                      cert_file: str | None = None,
@@ -175,7 +229,16 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
     (mTLS). Without certs the server binds insecure on localhost."""
     import grpc
 
-    def _json_method(fn, parse_params: bool):
+    def _trace_id_of(context) -> str | None:
+        md = getattr(context, "invocation_metadata", None)
+        if md is None:
+            return None
+        for k, v in md() or ():
+            if k == TRACE_ID_HEADER:
+                return v
+        return None
+
+    def _json_method(name: str, fn, parse_params: bool):
         def handler(request: bytes, context):
             try:
                 if parse_params:
@@ -186,28 +249,41 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
                         threshold=raw.get("threshold", 0.5),
                         node_groups=raw.get("node_groups"),
                     )
-                    return json.dumps(fn(params)).encode()
-                return json.dumps(fn(request)).encode()
+                    body = lambda: fn(params)  # noqa: E731
+                else:
+                    body = lambda: fn(request)  # noqa: E731
+                resp, group = traced_call(service, name, body,
+                                          trace_id=_trace_id_of(context))
+                if group is not None and isinstance(resp, dict):
+                    resp["trace"] = group
+                return json.dumps(resp).encode()
             except Exception as e:  # fail-closed with the error on the wire
                 return json.dumps({"error": str(e)}).encode()
 
         return handler
 
+    def _metricz(request: bytes, context):
+        text, _ = traced_call(service, "Metricz", service.metricz,
+                              trace_id=_trace_id_of(context))
+        return text.encode()
+
     ident = lambda b: b
 
     method_handlers = {
         "ApplyDelta": grpc.unary_unary_rpc_method_handler(
-            _json_method(service.apply_delta, False),
+            _json_method("ApplyDelta", service.apply_delta, False),
             request_deserializer=ident, response_serializer=ident),
         "ScaleUpSim": grpc.unary_unary_rpc_method_handler(
-            _json_method(service.scale_up_sim, True),
+            _json_method("ScaleUpSim", service.scale_up_sim, True),
             request_deserializer=ident, response_serializer=ident),
         "ScaleDownSim": grpc.unary_unary_rpc_method_handler(
-            _json_method(service.scale_down_sim, True),
+            _json_method("ScaleDownSim", service.scale_down_sim, True),
             request_deserializer=ident, response_serializer=ident),
         "Health": grpc.unary_unary_rpc_method_handler(
-            _json_method(lambda _b: service.health(), False),
+            _json_method("Health", lambda _b: service.health(), False),
             request_deserializer=ident, response_serializer=ident),
+        "Metricz": grpc.unary_unary_rpc_method_handler(
+            _metricz, request_deserializer=ident, response_serializer=ident),
     }
     from concurrent.futures import ThreadPoolExecutor
 
@@ -273,19 +349,41 @@ class SimulatorClient:
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b,
         )
-        return rpc(payload)
+        # trace propagation: the ACTIVE tracer's id rides request metadata
+        # (never the payload bytes — the KAD1 wire contract stays trace-free)
+        # and the rpc itself is a client-side span on the same timeline
+        tracer = trace.current_tracer()
+        if tracer is None:
+            return rpc(payload)
+        with tracer.span(f"rpc/{method}", cat="rpc", bytes=len(payload)):
+            return rpc(payload,
+                       metadata=((TRACE_ID_HEADER, tracer.trace_id),))
+
+    def _call_json(self, method: str, payload: bytes) -> dict:
+        resp = json.loads(self._call(method, payload))
+        # the server reports its child spans back in the response; merge
+        # them so ONE trace covers both processes
+        tracer = trace.current_tracer()
+        group = resp.pop("trace", None) if isinstance(resp, dict) else None
+        if tracer is not None and group is not None:
+            tracer.add_remote_spans(group)
+        return resp
 
     def apply_delta(self, writer: DeltaWriter) -> dict:
-        return json.loads(self._call("ApplyDelta", writer.payload()))
+        return self._call_json("ApplyDelta", writer.payload())
 
     def scale_up_sim(self, **params) -> dict:
-        return json.loads(self._call("ScaleUpSim", json.dumps(params).encode()))
+        return self._call_json("ScaleUpSim", json.dumps(params).encode())
 
     def scale_down_sim(self, **params) -> dict:
-        return json.loads(self._call("ScaleDownSim", json.dumps(params).encode()))
+        return self._call_json("ScaleDownSim", json.dumps(params).encode())
 
     def health(self) -> dict:
-        return json.loads(self._call("Health", b""))
+        return self._call_json("Health", b"")
+
+    def metricz(self) -> str:
+        """Prometheus text of the sidecar's Registry (rpc counters etc.)."""
+        return self._call("Metricz", b"").decode()
 
 
 def main(argv=None):

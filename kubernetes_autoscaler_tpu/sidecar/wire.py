@@ -26,6 +26,13 @@ from kubernetes_autoscaler_tpu.models.encode import (
 
 MAGIC = b"KAD1"
 
+# Trace context rides gRPC request metadata under this key — NEVER the KAD1
+# body or KAUX trailer. The dense bytes stay trace-free so committed goldens
+# (tests/test_wire_conformance.py) and independent Go encoders are untouched
+# by whether the caller happens to be tracing; the server echoes its child
+# spans back in the RESPONSE json ("trace" field), also off-wire-format.
+TRACE_ID_HEADER = "katpu-trace-id"
+
 UPSERT_NODE, DELETE_NODE, UPSERT_POD, DELETE_POD = 1, 2, 3, 4
 
 _EFFECTS = {NO_SCHEDULE: 0, NO_EXECUTE: 1}

@@ -94,3 +94,44 @@ def test_reference_series_fully_classified():
     assert classified == parity.REFERENCE_SERIES, (
         f"unclassified: {parity.REFERENCE_SERIES - classified}; "
         f"phantom: {classified - parity.REFERENCE_SERIES}")
+
+
+def test_function_duration_family_mapped_and_exposed():
+    """The reference's function_duration_seconds{function=...} family
+    (metrics.go FunctionLabel) maps label-for-label onto our spans
+    (parity.FUNCTION_DURATION); after representative loops every mapped
+    label appears in the exposition, and the unmapped remainder carries a
+    documented reason — the same honesty contract as the series registry."""
+    _exercise()
+    text = default_registry.expose_text()
+    missing = [
+        (ref, ours) for ref, ours in parity.FUNCTION_DURATION.items()
+        if f'cluster_autoscaler_function_duration_seconds_count{{function="{ours}"}}'
+        not in text
+    ]
+    assert not missing, f"mapped function labels never observed: {missing}"
+    for ref, reason in parity.FUNCTION_DURATION_NA.items():
+        assert reason and len(reason) > 10, ref
+    assert not (set(parity.FUNCTION_DURATION) & set(parity.FUNCTION_DURATION_NA))
+
+
+def test_phase_histogram_has_subms_buckets_and_help():
+    """planner_phase_seconds must keep its sub-ms buckets + help string —
+    the default 5ms-floor buckets flatten steady-state encode/fetch spans
+    into one bucket (ISSUE 4 satellite)."""
+    from kubernetes_autoscaler_tpu.metrics.phases import PHASE_BUCKETS, PhaseStats
+
+    # self-seed so the test holds standalone too (the histogram is only
+    # ever created through PhaseStats.phase, which carries buckets + help)
+    ps = PhaseStats(owner="planner", registry=default_registry)
+    with ps.phase("encode"):
+        pass
+    ps.bump("marshal_cache_hit")
+    h = default_registry.histogram("planner_phase_seconds")
+    assert h.buckets == PHASE_BUCKETS
+    assert min(h.buckets) < 0.001 and h.help
+    text = default_registry.expose_text()
+    assert 'cluster_autoscaler_planner_phase_seconds_bucket' in text
+    # the event counters ride the same exposition (first-class, not
+    # bench-JSON-only): at least the planner's cache accounting is present
+    assert 'cluster_autoscaler_phase_events_total{' in text

@@ -150,3 +150,82 @@ def test_bad_payload_rejected():
         st.apply_delta(b"XXXX\x00\x00\x00\x00")
     with pytest.raises(ValueError):
         st.apply_delta(b"KAD1\x05\x00\x00\x00\x01")  # truncated
+
+
+def test_trace_id_round_trip_through_sidecar():
+    """The client stamps the ACTIVE tracer's id into gRPC metadata; the
+    server runs the RPC under a child span with the SAME id and reports it
+    back in the response, which the client merges — one trace, two
+    processes (ISSUE 4; docs/OBSERVABILITY.md)."""
+    pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.metrics import trace
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimulatorClient,
+        SimulatorService,
+        make_grpc_server,
+    )
+
+    service = SimulatorService(node_bucket=16, group_bucket=16)
+    server, port = make_grpc_server(service, port=0)
+    server.start()
+    try:
+        c = SimulatorClient(port)
+        nodes, pods = world()
+        w = DeltaWriter()
+        for nd in nodes:
+            w.upsert_node(nd)
+        for p in pods:
+            w.upsert_pod(p)
+        tracer = trace.Tracer()
+        with trace.active(tracer):
+            ack = c.apply_delta(w)
+            down = c.scale_down_sim(threshold=0.5)
+        assert ack["error"] == "" and "eligible" in down
+        # "trace" is popped before the caller sees the response
+        assert "trace" not in ack and "trace" not in down
+        snap = tracer.snapshot()
+        client_rpcs = [s["name"] for s in snap["spans"] if s["cat"] == "rpc"]
+        assert client_rpcs == ["rpc/ApplyDelta", "rpc/ScaleDownSim"]
+        assert len(snap["remote"]) == 2
+        for group in snap["remote"]:
+            assert group["process"] == "sidecar"
+            (span,) = group["spans"]
+            assert span["name"].startswith("sidecar/")
+            assert span["args"]["version"] == 1
+        # the merged export shows both processes under ONE trace id
+        events = trace.chrome_trace_events([snap])
+        pids = {e["pid"] for e in events if e.get("ph") == "X"}
+        assert pids == {1, 2}
+        assert all(e["args"]["trace_id"] == tracer.trace_id
+                   for e in events if e.get("ph") == "X")
+        # rpc metrics landed in the sidecar registry (Metricz exposition)
+        text = c.metricz()
+        assert 'katpu_sidecar_rpc_total{method="ApplyDelta"} 1.0' in text
+        assert "katpu_sidecar_rpc_duration_seconds_bucket" in text
+    finally:
+        server.stop(None)
+
+
+def test_untraced_calls_carry_no_trace_field():
+    """No active tracer → no metadata stamped, no server tracer built, no
+    "trace" key in responses (the pre-trace response shape is unchanged)."""
+    pytest.importorskip("grpc")
+    import json as _json
+
+    from kubernetes_autoscaler_tpu.metrics import trace
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimulatorClient,
+        SimulatorService,
+        make_grpc_server,
+    )
+
+    assert trace.current_tracer() is None
+    service = SimulatorService(node_bucket=16, group_bucket=16)
+    server, port = make_grpc_server(service, port=0)
+    server.start()
+    try:
+        c = SimulatorClient(port)
+        raw = _json.loads(c._call("Health", b""))
+        assert "trace" not in raw
+    finally:
+        server.stop(None)

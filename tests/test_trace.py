@@ -1,0 +1,294 @@
+"""Flight recorder + trace layer (metrics/trace.py, docs/OBSERVABILITY.md):
+span nesting and export, ring-buffer wraparound, SLO-breach auto-dump,
+flush-on-error for an armed /snapshotz, concurrent arming during breach
+dumps, and the tracer overhead bound (slow tier)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.debuggingsnapshot import DebuggingSnapshotter
+from kubernetes_autoscaler_tpu.metrics import trace
+from kubernetes_autoscaler_tpu.metrics.phases import PhaseStats
+from kubernetes_autoscaler_tpu.metrics.trace import FlightRecorder, Tracer
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+# ---- Tracer unit behavior ----
+
+
+def test_span_nesting_order_and_chrome_export():
+    t = Tracer()
+    with t.span("outer", cat="loop", k=1):
+        with t.span("inner", cat="planner"):
+            pass
+        with t.span("inner2", cat="scaleup"):
+            pass
+    t.bump("cache_hit", 3)
+    snap = t.snapshot()
+    assert [s["name"] for s in snap["spans"]] == ["outer", "inner", "inner2"]
+    assert [s["depth"] for s in snap["spans"]] == [0, 1, 1]
+    assert snap["counters"] == {"cache_hit": 3}
+    # spans are monotonically ordered and nested spans contained in parents
+    outer, inner, inner2 = snap["spans"]
+    assert outer["ts_us"] <= inner["ts_us"] <= inner2["ts_us"]
+    assert inner["ts_us"] + inner["dur_us"] <= outer["ts_us"] + outer["dur_us"] + 1
+    events = trace.chrome_trace_events([snap])
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 3 and all(e["args"]["trace_id"] == t.trace_id for e in xs)
+    assert xs[0]["args"]["counters"] == {"cache_hit": 3}
+    json.dumps(events)   # the export is JSON-serializable as-is
+
+
+def test_exception_closes_orphaned_children():
+    t = Tracer()
+    idx = t.begin("phase")
+    t.begin("child")          # left open (simulates a raise inside a phase)
+    t.end(idx)                # closing the parent closes the child too
+    snap = t.snapshot()
+    assert len(snap["spans"]) == 2
+    assert all(s["dur_us"] >= 0 for s in snap["spans"])
+
+
+def test_span_cap_drops_and_counts():
+    t = Tracer()
+    t.spans = [["x", "", 0, 0, 0, None]] * trace.MAX_SPANS_PER_TRACE
+    idx = t.begin("over")
+    assert idx == -2
+    t.end(idx)                # paired end is a no-op, not a stack corruption
+    assert t.dropped == 1 and not t._stack
+
+
+def test_phase_stats_emit_spans_only_when_tracer_active():
+    ps = PhaseStats(owner="planner")
+    with ps.phase("encode"):
+        pass                  # no active tracer: still accounted, no spans
+    assert ps.counts["encode"] == 1
+    t = Tracer()
+    with trace.active(t):
+        with ps.phase("encode", rows=4):
+            ps.bump("marshal_cache_hit")
+    snap = t.snapshot()
+    assert snap["spans"][0]["name"] == "encode"
+    assert snap["spans"][0]["cat"] == "planner"
+    assert snap["spans"][0]["args"]["rows"] == 4
+    assert snap["counters"] == {"marshal_cache_hit": 1}
+
+
+def test_ring_buffer_wraparound():
+    rec = FlightRecorder(capacity=4)
+    ids = []
+    for _ in range(10):
+        t = Tracer()
+        with t.span("RunOnce"):
+            pass
+        ids.append(t.trace_id)
+        rec.record(t)
+    got = [s["trace_id"] for s in rec.traces()]
+    assert got == ids[-4:]          # oldest evicted, newest kept, in order
+    assert rec.recorded == 10
+
+
+def test_capacity_zero_disables_recording():
+    rec = FlightRecorder(capacity=0)
+    t = Tracer()
+    with t.span("RunOnce"):
+        pass
+    assert rec.record(t, dump_reason="error") is None
+    assert rec.traces() == []
+
+
+# ---- StaticAutoscaler integration ----
+
+
+def _world(n_nodes=6, pending=3):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=20)
+    for i in range(n_nodes):
+        nd = build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192)
+        fake.add_existing_node("ng1", nd)
+        fake.add_pod(build_test_pod(f"r{i}", cpu_milli=400, mem_mib=256,
+                                    owner_name="rs", node_name=nd.name))
+    for i in range(pending):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=1000, mem_mib=512,
+                                    owner_name="prs"))
+    return fake
+
+
+def _opts(**kw):
+    kw.setdefault("node_shape_bucket", 16)
+    kw.setdefault("group_shape_bucket", 8)
+    kw.setdefault("max_new_nodes_static", 16)
+    kw.setdefault("scale_down_delay_after_add_s", 0.0)
+    kw.setdefault("scale_down_delay_after_failure_s", 0.0)
+    kw.setdefault("node_group_defaults", NodeGroupDefaults(
+        scale_down_unneeded_time_s=3600.0, scale_down_unready_time_s=3600.0))
+    return AutoscalingOptions(**kw)
+
+
+def test_runonce_records_trace_with_planner_spans():
+    fake = _world(pending=0)
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
+                         eviction_sink=fake)
+    a.run_once(now=1000.0)
+    a.run_once(now=1010.0)
+    traces = a.flight_recorder.traces()
+    assert len(traces) == 2
+    last = traces[-1]
+    names = [(s["name"], s["cat"]) for s in last["spans"]]
+    assert names[0] == ("RunOnce", "loop")
+    assert ("encode", "planner") in names      # snapshot build phase
+    assert ("dispatch", "planner") in names    # drain sweep
+    # the loop owns its tracer and deactivates it on exit
+    assert trace.current_tracer() is None
+    # loop_s annotated on the root span
+    assert last["spans"][0]["args"]["loop_s"] >= 0
+
+
+def test_slo_breach_auto_dumps_ring(tmp_path):
+    fake = _world(pending=0)
+    a = StaticAutoscaler(
+        fake.provider, fake,
+        options=_opts(loop_wallclock_budget_s=1e-9,
+                      flight_recorder_dir=str(tmp_path)),
+        eviction_sink=fake)
+    a.run_once(now=1000.0)     # every loop breaches a 1 ns budget
+    dumps = list(tmp_path.glob("flight-*.trace.json"))
+    assert len(dumps) == 1
+    doc = json.loads(dumps[0].read_text())
+    assert doc["otherData"]["dump_reasons"] == {
+        a.flight_recorder.traces()[0]["trace_id"]: "slo_breach"}
+    assert any(e.get("name") == "RunOnce" for e in doc["traceEvents"])
+    assert a.metrics.counter("loop_slo_breaches_total").value() == 1
+    assert a.metrics.counter(
+        "flight_recorder_dumps_total").value(reason="slo_breach") == 1
+
+
+def test_raise_mid_loop_flushes_armed_snapshotz_and_dumps(tmp_path):
+    fake = _world(pending=0)
+    dbg = DebuggingSnapshotter()
+    a = StaticAutoscaler(
+        fake.provider, fake,
+        options=_opts(flight_recorder_dir=str(tmp_path)),
+        eviction_sink=fake, debugging_snapshotter=dbg)
+    a.run_once(now=1000.0)
+
+    def boom(*_a, **_k):
+        raise RuntimeError("device fell over")
+
+    a.planner.update = boom          # raises AFTER node data was collected
+    handle = dbg.request_snapshot()
+    with pytest.raises(RuntimeError):
+        a.run_once(now=1010.0)
+    # the /snapshotz caller gets the PARTIAL payload + error, no hang …
+    payload = json.loads(handle.wait(timeout=5.0))
+    assert payload["error"].startswith("RuntimeError")
+    assert {n["name"] for n in payload["nodeList"]} >= {"n0"}
+    assert payload["traceId"] == a.flight_recorder.traces()[-1]["trace_id"]
+    assert "planner" in payload["phaseStats"]
+    # … the snapshotter is DISARMED (not stuck armed forever) …
+    assert not dbg.is_data_collection_allowed()
+    # … and the failing loop's trace was dumped with reason=error
+    doc = json.loads(
+        max(tmp_path.glob("flight-*.trace.json")).read_text())
+    assert "error" in set(doc["otherData"]["dump_reasons"].values())
+
+
+def test_armed_snapshotz_includes_trace_id_and_dumps(tmp_path):
+    fake = _world(pending=0)
+    dbg = DebuggingSnapshotter()
+    a = StaticAutoscaler(
+        fake.provider, fake,
+        options=_opts(flight_recorder_dir=str(tmp_path)),
+        eviction_sink=fake, debugging_snapshotter=dbg)
+    a.run_once(now=1000.0)
+    handle = dbg.request_snapshot()
+    a.run_once(now=1010.0)
+    payload = json.loads(handle.wait(timeout=5.0))
+    assert payload["traceId"] == a.flight_recorder.traces()[-1]["trace_id"]
+    assert payload["phaseStats"]["planner"]["spans"]
+    assert "error" not in payload
+    dumps = list(tmp_path.glob("flight-*.trace.json"))
+    assert len(dumps) == 1           # the armed loop persisted the ring
+
+
+def test_concurrent_snapshotz_arm_during_breach_dumps(tmp_path):
+    """Arming /snapshotz from another thread while breaching loops dump the
+    recorder must neither deadlock nor leave a handle unresolved."""
+    fake = _world(pending=0)
+    dbg = DebuggingSnapshotter()
+    a = StaticAutoscaler(
+        fake.provider, fake,
+        options=_opts(loop_wallclock_budget_s=1e-9,
+                      flight_recorder_dir=str(tmp_path)),
+        eviction_sink=fake, debugging_snapshotter=dbg)
+    a.run_once(now=1000.0)
+    handles, stop = [], threading.Event()
+
+    def arm_loop():
+        while not stop.is_set():
+            handles.append(dbg.request_snapshot())
+            time.sleep(0.001)
+
+    th = threading.Thread(target=arm_loop, daemon=True)
+    th.start()
+    try:
+        for k in range(8):
+            a.run_once(now=1010.0 + 10 * k)
+    finally:
+        stop.set()
+        th.join(timeout=5.0)
+    a.run_once(now=2000.0)           # flush any handle armed after the last loop
+    for h in handles:
+        assert h.wait(timeout=5.0), "a /snapshotz caller was left hanging"
+    assert len(a.flight_recorder.traces()) == a.flight_recorder.capacity
+    assert list(tmp_path.glob("flight-*.trace.json"))
+
+
+# ---- overhead bound (slow tier; ISSUE 4 acceptance) ----
+
+
+@pytest.mark.slow
+def test_tracer_overhead_bound_on_bench_loop():
+    """Marginal tracer cost (per-span on-vs-off delta × spans per loop) must
+    stay under 1% of a steady bench-shaped RunOnce; the tracer-off path must
+    be sub-microsecond per phase call (no measurable loop impact)."""
+    ps = PhaseStats(owner="planner")
+    N = 50_000
+
+    def per_call_s():
+        t0 = time.perf_counter()
+        for _ in range(N):
+            with ps.phase("x"):
+                pass
+        return (time.perf_counter() - t0) / N
+
+    off = min(per_call_s() for _ in range(3))
+    tr = Tracer()
+    with trace.active(tr):
+        on = min(per_call_s() for _ in range(3))
+    assert off < 15e-6, f"tracer-off phase cost {off * 1e6:.2f}µs"
+
+    # steady bench-shaped loop: time it, count its actual span volume
+    fake = _world(n_nodes=64, pending=8)
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
+                         eviction_sink=fake)
+    a.run_once(now=1000.0)           # cold
+    t0 = time.perf_counter()
+    a.run_once(now=1010.0)
+    loop_s = time.perf_counter() - t0
+    snap = a.flight_recorder.traces()[-1]
+    spans_per_loop = len(snap["spans"]) + sum(snap["counters"].values())
+    overhead = spans_per_loop * max(on - off, 0.0)
+    assert overhead < 0.01 * loop_s, (
+        f"{spans_per_loop} spans × {(on - off) * 1e6:.2f}µs = "
+        f"{overhead * 1e3:.3f}ms ≥ 1% of {loop_s * 1e3:.1f}ms loop")

@@ -92,3 +92,21 @@ def test_equivalence_fixture_groups_and_alloc():
     counts = g["groups.count"]
     assert 3 in counts.tolist()            # the three twins share one row
     assert (g["nodes.alloc"][:2] > 0).any()  # residents charged their hosts
+
+
+def test_payload_bytes_identical_under_active_tracer():
+    """Trace context rides gRPC metadata (wire.TRACE_ID_HEADER), NEVER the
+    KAD1 body or KAUX trailer: re-serializing every committed scenario under
+    an active tracer must reproduce the committed bytes exactly — a tracing
+    client and a non-tracing Go encoder speak the identical wire format."""
+    from kubernetes_autoscaler_tpu.metrics import trace
+
+    tracer = trace.Tracer()
+    with trace.active(tracer):
+        for name, writers, _desc in conformance.scenarios():
+            g = _golden(name)
+            for i, w in enumerate(writers):
+                assert w.payload() == g[f"payload_{i}"].tobytes(), (
+                    f"{name} delta {i}: payload bytes changed under tracing")
+    # and the payload walk itself must not have manufactured spans
+    assert tracer.snapshot()["spans"] == []
