@@ -65,6 +65,13 @@ class ClusterAutoscalerStatus:
     node_groups: list[NodeGroupStatus] = field(default_factory=list)
     last_probe_time: float = 0.0
     message: str = ""
+    # reason plane: per-reason verdict histograms for this loop — WHY pods
+    # stayed pending (ops/predicates reason taxonomy + no-node-in-group) and
+    # WHY nodes stayed unremovable (the reference unremovable enum strings,
+    # UnremovableNodes.reason_counts). Empty dicts when everything scheduled
+    # / every candidate drained.
+    unschedulable_reasons: dict[str, int] = field(default_factory=dict)
+    unremovable_reasons: dict[str, int] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         def ng(s: NodeGroupStatus) -> dict:
@@ -81,7 +88,7 @@ class ClusterAutoscalerStatus:
                 "scaleDown": {"status": s.scale_down},
             }
 
-        return {
+        doc = {
             "configMapName": self.config_map_name,
             "autoscalerStatus": self.autoscaler_status,
             "message": self.message,
@@ -89,6 +96,11 @@ class ClusterAutoscalerStatus:
             "clusterWide": ng(self.cluster_wide),
             "nodeGroups": [ng(s) for s in self.node_groups],
         }
+        doc["clusterWide"]["scaleUp"]["unschedulableReasons"] = dict(
+            self.unschedulable_reasons)
+        doc["clusterWide"]["scaleDown"]["unremovableReasons"] = dict(
+            self.unremovable_reasons)
+        return doc
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2)
@@ -96,12 +108,22 @@ class ClusterAutoscalerStatus:
 
 def build_status(registry: ClusterStateRegistry, now: float,
                  scale_down_candidates: list[str] | None = None,
-                 config_map_name: str | None = None) -> ClusterAutoscalerStatus:
+                 config_map_name: str | None = None,
+                 unschedulable_reasons: dict[str, int] | None = None,
+                 unremovable_reasons: dict[str, int] | None = None,
+                 ) -> ClusterAutoscalerStatus:
     """Assemble the status document from the registry's health model
-    (reference: clusterstate.GetStatus)."""
+    (reference: clusterstate.GetStatus). The optional reason histograms come
+    from the loop's reason plane — the orchestrator's NoScaleUp totals and
+    the planner's UnremovableNodes cache — so the status ConfigMap carries
+    the same per-reason verdicts the events and metrics do."""
     st = ClusterAutoscalerStatus(last_probe_time=now)
     if config_map_name:
         st.config_map_name = config_map_name
+    if unschedulable_reasons:
+        st.unschedulable_reasons = dict(unschedulable_reasons)
+    if unremovable_reasons:
+        st.unremovable_reasons = dict(unremovable_reasons)
     st.cluster_wide.node_counts = NodeCounts.from_readiness(
         registry.total_readiness
     )

@@ -102,6 +102,11 @@ class PlannerState:
     # predicates on — the planner contract is oracle_nodes <= survivors
     evictions_prefilter_survivors: int = 0
     evictions_oracle_nodes: int = 0
+    # reason plane: per-failed-candidate drain failure detail from the lazy
+    # ops/drain.failure_reasons pass (node name → human-readable attribution
+    # like "no destination has room for pod group 3 (req cpu=1500m …)");
+    # rides events, /snapshotz and the flight-recorder span attrs
+    drain_fail_detail: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -146,6 +151,9 @@ class Planner:
         self.state = PlannerState()
         self.pdb_tracker = pdb_tracker          # shared with the actuator
         self.latency_tracker = latency_tracker
+        # reason plane: NoScaleDown event sink (events.EventSink, wired by
+        # StaticAutoscaler) — every _mark() verdict is also an event
+        self.event_sink = None
         # per-phase host-path accounting (metrics/phases.py); the autoscaler
         # attaches its Registry so the breakdown rides /metrics too
         self.phases = PhaseStats(owner="planner")
@@ -318,6 +326,11 @@ class Planner:
         self.state.injected_pods = []
         self.state.evictions_prefilter_survivors = 0
         self.state.evictions_oracle_nodes = 0
+        self.state.drain_fail_detail = {}
+        # eager TTL sweep so the unremovable cache stays bounded by the live
+        # node set across loops (expired entries of vanished nodes would
+        # otherwise only fall out on a contains() probe that never comes)
+        self.unremovable.update(now)
         if inject_pods:
             self._inject_evicted(enc, nodes, inject_pods)
         n_real = len(nodes)
@@ -424,14 +437,58 @@ class Planner:
         with self.phases.phase("fetch"):
             removal = fetch_result(removal)
         drainable = np.asarray(removal.drainable)
+        # LAZY reason pass over the FAILED candidates only (ops/drain.
+        # failure_reasons): which pod shape found no destination, or shape
+        # overflow — zero extra dispatches when every candidate drains
+        failed_rows = [k for k in range(len(eligible_idx)) if not drainable[k]]
+        detail_by_row: dict[int, str] = {}
+        if failed_rows:
+            from kubernetes_autoscaler_tpu.ops import drain as drain_ops
+
+            with self.phases.phase("reason_extract", failed=len(failed_rows)):
+                self.phases.bump("reason_extraction_dispatches")
+                rr = drain_ops.failure_reasons(
+                    enc.nodes, enc.specs, enc.scheduled,
+                    jnp.asarray(cand[failed_rows]), jnp.asarray(dest_allowed),
+                    max_pods_per_node=self.options.max_pods_per_node,
+                    chunk=self.options.drain_chunk)
+                rr = fetch_pytree(rr)
+            greq = self._fetch_host(enc, {"specs.req": enc.specs.req})["specs.req"]
+            for j, k in enumerate(failed_rows):
+                code = int(rr.reason[j])
+                if code == drain_ops.DRAIN_NO_PLACE_FOR_GROUP:
+                    fg = int(rr.fail_group[j])
+                    req = greq[fg] if 0 <= fg < greq.shape[0] else None
+                    detail_by_row[k] = (
+                        f"no destination has room for pod group {fg}"
+                        + (f" (req cpu={int(req[0])}m mem={int(req[1])}Mi)"
+                           if req is not None else "")
+                        + f"; {int(rr.n_unplaced[j])} pods unplaced")
+                elif code == drain_ops.DRAIN_TOO_MANY_SHAPES:
+                    detail_by_row[k] = (
+                        "more distinct pod shapes than max_groups_per_node; "
+                        "conservatively unremovable")
+                elif code == drain_ops.DRAIN_OK:
+                    # the plain-capacity re-placement succeeds → the failure
+                    # came from topology constraints the explanatory pass
+                    # does not model
+                    detail_by_row[k] = "pods blocked by topology constraints"
         unneeded = []
         for k, i in enumerate(eligible_idx):
             if drainable[k]:
                 unneeded.append(nodes[i].name)
+                # a drainable node is not unremovable — clear any stale
+                # verdict (e.g. last loop's NotUnneededLongEnough) instead
+                # of letting it linger until TTL expiry; downstream passes
+                # re-mark if confirmation fails this loop
+                self.unremovable.drop(nodes[i].name)
             else:
                 reason = ("BlockedByPod" if bool(removal.has_blocker[k])
                           else "NoPlaceToMovePods")
-                self._mark(nodes[i].name, reason, now)
+                detail = detail_by_row.get(k, "")
+                if detail:
+                    self.state.drain_fail_detail[nodes[i].name] = detail
+                self._mark(nodes[i].name, reason, now, message=detail)
         self.unneeded_nodes.update(unneeded, now)
         if self.latency_tracker is not None:
             self.latency_tracker.observe_candidates(unneeded, now)
@@ -440,8 +497,16 @@ class Planner:
         self.state.candidate_indices = cand
         return self.state
 
-    def _mark(self, name: str, reason: str, now: float) -> None:
+    def _mark(self, name: str, reason: str, now: float,
+              message: str = "") -> None:
+        """One unremovable verdict onto every planner-owned surface: the TTL
+        cache (→ status histogram + unremovable_nodes_count{reason}) and a
+        deduped NoScaleDown event (reference: the scale-down event recorder
+        posts per-node skip reasons)."""
         self.unremovable.add(name, reason, now)
+        if self.event_sink is not None:
+            self.event_sink.emit("NoScaleDown", obj=name, reason=reason,
+                                 message=message, now=now)
 
     # ---- constrained-tier marshalling (cached across RunOnce loops) ----
 
@@ -760,6 +825,9 @@ class Planner:
             )
             if self.unneeded_nodes.removable_at(name, now, unneeded_time):
                 cand_rows.append((i, by_index[i]))
+            else:
+                # reference: simulator.UnremovableReason NotUnneededLongEnough
+                self._mark(name, "NotUnneededLongEnough", now)
         if not cand_rows:
             return []
 
@@ -855,6 +923,7 @@ class Planner:
                     self._mark(nd.name, r, now)
                 continue
             orig = [int(s) for s in slot_ids[slot_off[j]: slot_off[j + 1]]]
+            self.unremovable.drop(nd.name)   # accepted: verdict resolved
             out.append(NodeToRemove(
                 nd, not orig, pods_to_move=orig,
                 destinations={s: int(dest[s]) for s in orig if dest[s] >= 0},
@@ -1193,6 +1262,7 @@ class Planner:
                         else defaults.scale_down_unready_time_s)
                 )
                 if not self.unneeded_nodes.removable_at(name, now, unneeded_time):
+                    self._mark(name, "NotUnneededLongEnough", now)
                     continue
                 room = group_room.setdefault(g.id(), g.target_size() - g.min_size())
                 if room <= 0:
@@ -1434,4 +1504,5 @@ class Planner:
         for r in out:
             r.destinations = {s: final_dest[s] for s in r.pods_to_move
                               if s in final_dest}
+            self.unremovable.drop(r.node.name)   # accepted: verdict resolved
         return out

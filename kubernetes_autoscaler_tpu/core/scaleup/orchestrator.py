@@ -93,6 +93,14 @@ class ScaleUpOrchestrator:
         # optional device mesh threaded into the estimator (NG options over
         # PODS_AXIS; parallel/mesh.py) — None = single-device program
         self.mesh = None
+        # reason plane (events.EventSink wired by StaticAutoscaler): per-loop
+        # NoScaleUp verdicts — {reason: pod count} for the gauge family and
+        # the per-group detail list for status/snapshotz. Populated by the
+        # LAZY reason pass (_explain_refused): one masked dispatch over the
+        # refused groups only, zero dispatches when everything schedules.
+        self.event_sink = None
+        self.last_noscaleup: dict[str, int] = {}
+        self.last_noscaleup_groups: list[dict] = []
         self.node_group_list_processor = (
             node_group_list_processor or IdentityNodeGroupListProcessor()
         )
@@ -129,6 +137,8 @@ class ScaleUpOrchestrator:
     def scale_up(self, enc: EncodedCluster, nodes_count: int,
                  now: float | None = None) -> ScaleUpResult:
         now = time.time() if now is None else now
+        self.last_noscaleup = {}
+        self.last_noscaleup_groups = []
         pending_total = int(np.asarray(enc.specs.count).sum())
         if pending_total == 0:
             return ScaleUpResult(scaled_up=False)
@@ -139,12 +149,22 @@ class ScaleUpOrchestrator:
         groups = self.node_group_list_processor.process(
             self.provider, groups, enc.pending_pods
         )
+        upcoming_only = False
         if self.async_creator is not None:
             # a group whose creation is still in flight must not be
             # re-proposed (reference: AsyncNodeGroupStateChecker gating)
-            groups = [g for g in groups
+            before = groups
+            groups = [g for g in before
                       if not self.async_creator.is_upcoming(g.id())]
+            upcoming_only = bool(before) and not groups
         if not groups:
+            # no candidate group exists — every pending group gets the
+            # summary reason without any device dispatch. If candidates
+            # exist but are all still being created, "no node group can
+            # help" would be false — capacity for these pods is in flight —
+            # so no refusal verdict is recorded.
+            if not upcoming_only:
+                self._note_no_groups(enc, now)
             return ScaleUpResult(scaled_up=False, pods_remaining=pending_total)
 
         estimator = BinpackingEstimator(
@@ -189,6 +209,7 @@ class ScaleUpOrchestrator:
                 nodes_count
             )
         if not options:
+            self._explain_refused(enc, est, group_tensors, now)
             return ScaleUpResult(scaled_up=False, pods_remaining=pending_total,
                                  considered_options=[])
 
@@ -200,6 +221,7 @@ class ScaleUpOrchestrator:
                 set_ctx(nodes_count)
         best = self.expander.best_option(options)
         if best is None:
+            self._explain_refused(enc, est, group_tensors, now)
             return ScaleUpResult(scaled_up=False, pods_remaining=pending_total,
                                  considered_options=options)
 
@@ -227,7 +249,98 @@ class ScaleUpOrchestrator:
         result.best = best
         result.pods_helped = best.pod_count
         result.pods_remaining = max(pending_total - best.pod_count, 0)
+        if result.pods_remaining > 0:
+            # pods are left behind even after the winning option — attribute
+            # them (groups no template could host; the lazy reason pass)
+            self._explain_refused(enc, est, group_tensors, now)
         return result
+
+    # ---- the reason plane (lazy NoScaleUp extraction) ----
+
+    def _note_no_groups(self, enc: EncodedCluster, now: float) -> None:
+        """Every pending pod group is refused because no valid node group
+        exists at all — the summary reason needs no device dispatch."""
+        from kubernetes_autoscaler_tpu.ops.predicates import NO_NODE_IN_GROUP
+
+        counts = np.asarray(enc.specs.count)
+        valid = np.asarray(enc.specs.valid)
+        for gi in np.nonzero(valid & (counts > 0))[0]:
+            self._record_noscaleup(enc, int(gi), int(counts[gi]),
+                                   NO_NODE_IN_GROUP, {}, now)
+
+    def _explain_refused(self, enc: EncodedCluster, est, group_tensors,
+                         now: float) -> None:
+        """Lazy reason extraction for refused pod groups: one masked
+        `reason_mask_for_groups` dispatch over the TEMPLATE plane (uint16
+        bits per group × node group) + one batched fetch, only when at least
+        one pending group no expansion option could schedule. A loop where
+        every pod is helped performs ZERO extra dispatches — the
+        `reason_extraction_dispatches` event counter (mirrored into
+        `phase_events_total` and the trace) proves it, and CI asserts it on
+        the all-schedulable bench smoke world."""
+        from kubernetes_autoscaler_tpu.estimator.estimator import (
+            explain_refused_groups,
+        )
+        from kubernetes_autoscaler_tpu.ops import predicates as preds
+
+        counts = np.asarray(enc.specs.count)
+        valid = np.asarray(enc.specs.valid)
+        scheduled = np.asarray(est.scheduled)          # [NG, G]
+        helped = (scheduled.max(axis=0) if scheduled.size
+                  else np.zeros_like(counts))
+        refused = valid & (counts > 0) & (helped <= 0)
+        if not refused.any():
+            return
+        with self.phases.phase("reason_extract",
+                               refused_groups=int(refused.sum())):
+            self.phases.bump("reason_extraction_dispatches")
+            bits = explain_refused_groups(enc.specs, group_tensors, refused,
+                                          enc.dims)
+        gvalid = np.asarray(group_tensors.valid)
+        for gi in np.nonzero(refused)[0]:
+            headline, per = preds.summarize_reason_row(bits[gi], gvalid)
+            self._record_noscaleup(enc, int(gi), int(counts[gi]), headline,
+                                   per, now)
+
+    def _record_noscaleup(self, enc: EncodedCluster, gi: int, pods: int,
+                          reason: str, constraints: dict[str, int],
+                          now: float) -> None:
+        """One refused group's verdict onto every surface the orchestrator
+        owns: the per-reason totals (→ unschedulable_pods_count{reason}),
+        the per-group detail list (→ status document + /snapshotz), and a
+        deduped NoScaleUp event keyed by the group's exemplar pod (the
+        reference emits the same verdict per pod; equivalence rows make one
+        event per shape)."""
+        exemplar = ""
+        if gi < len(enc.group_pods) and enc.group_pods[gi]:
+            exemplar = enc.pending_pods[enc.group_pods[gi][0]].name
+        obj = exemplar or f"pod-group-{gi}"
+        self.last_noscaleup[reason] = self.last_noscaleup.get(reason, 0) + pods
+        self.last_noscaleup_groups.append({
+            "group": gi, "exemplarPod": obj, "pods": pods,
+            "reason": reason, "constraints": constraints,
+        })
+        if self.event_sink is not None:
+            from kubernetes_autoscaler_tpu.ops.predicates import (
+                CAPPED_BY_LIMITS,
+                NO_NODE_IN_GROUP,
+            )
+
+            detail = ", ".join(f"{k}×{v}" for k, v in constraints.items())
+            if reason == CAPPED_BY_LIMITS:
+                # the opposite of a constraint refusal: a template CAN host
+                # the group, the option was capped/crowded out
+                msg = (f"{pods} pending pods fit a node group template, but "
+                       f"option capping (max_new / limiter stack / crowded "
+                       f"bins) left them behind")
+            elif reason == NO_NODE_IN_GROUP:
+                msg = f"{pods} pending pods; no candidate node group exists"
+            else:
+                msg = (f"{pods} pending pods; no node group can host them"
+                       + (f" (refusing templates: {detail})" if detail
+                          else ""))
+            self.event_sink.emit("NoScaleUp", obj=obj, reason=reason,
+                                 message=msg, now=now)
 
     # ---- winner verification (the host-check tier) ----
 

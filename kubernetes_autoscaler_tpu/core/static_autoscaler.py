@@ -171,6 +171,17 @@ class StaticAutoscaler:
         # (both directions: scale-down planner and scale-up orchestrator)
         self.planner.phases.registry = self.metrics
         self.scale_up_orchestrator.phases.registry = self.metrics
+        # reason plane: one throttled/deduped event sink shared by both
+        # directions (NoScaleUp from the orchestrator, NoScaleDown from the
+        # planner); reason-labelled gauges track which labels were set last
+        # loop so stale reasons zero out instead of lingering
+        from kubernetes_autoscaler_tpu.events import EventSink
+
+        self.event_sink = EventSink(registry=self.metrics)
+        self.planner.event_sink = self.event_sink
+        self.scale_up_orchestrator.event_sink = self.event_sink
+        self._last_unsched_reasons: set[str] = set()
+        self._last_unremovable_reasons: set[str] = set()
         # always-on flight recorder: ring of the last N RunOnce traces,
         # persisted when a loop breaches its budget, raises, or served an
         # armed /snapshotz (metrics/trace.py; capacity 0 = tracing off)
@@ -300,6 +311,7 @@ class StaticAutoscaler:
 
     def _run_once_inner(self, now: float) -> RunOnceStatus:
         status = RunOnceStatus()
+        self.event_sink.begin_loop()
         with self.metrics.time_function("main"):
             # finished async deletions first: their bookkeeping (and any
             # failed-node taint rollback) must land before this loop reads
@@ -529,6 +541,11 @@ class StaticAutoscaler:
                 dbg.set_unscheduled_pods_can_be_scheduled(fitting)
             status.pending_pods = remaining
             self.metrics.gauge("unschedulable_pods_count").set(remaining)
+            if remaining == 0:
+                # no scale-up dispatch this loop → last loop's NoScaleUp
+                # verdicts are resolved; the reason surfaces must clear
+                self.scale_up_orchestrator.last_noscaleup = {}
+                self.scale_up_orchestrator.last_noscaleup_groups = []
             # Sync the post-placement view unconditionally: the planner must see
             # the capacity charged to simulated placements even when every pod
             # fit (the reference keeps placements in the snapshot for the same
@@ -632,6 +649,31 @@ class StaticAutoscaler:
             if self.options.node_autoprovisioning_enabled:
                 self.node_group_manager.remove_unneeded_node_groups(self.provider)
 
+            # reason plane → registry: per-reason gauge families. Labels set
+            # last loop but absent now are zeroed (a gauge that silently
+            # keeps a stale reason value would claim pods/nodes still refuse
+            # for a reason that no longer applies).
+            noscaleup = dict(self.scale_up_orchestrator.last_noscaleup)
+            unsched_gauge = self.metrics.gauge(
+                "unschedulable_pods_count",
+                help="Pending pods; with a reason label, pods no node group "
+                     "can help and the constraint that refused them")
+            for r in self._last_unsched_reasons - set(noscaleup):
+                unsched_gauge.set(0.0, reason=r)
+            for r, n in noscaleup.items():
+                unsched_gauge.set(float(n), reason=r)
+            self._last_unsched_reasons = set(noscaleup)
+            unremovable_reasons = self.planner.unremovable.reason_counts(now)
+            unrem_gauge = self.metrics.gauge(
+                "unremovable_nodes_count",
+                help="Nodes the scale-down planner refused to remove, by "
+                     "reason (reference unremovable enum)")
+            for r in self._last_unremovable_reasons - set(unremovable_reasons):
+                unrem_gauge.set(0.0, reason=r)
+            for r, n in unremovable_reasons.items():
+                unrem_gauge.set(float(n), reason=r)
+            self._last_unremovable_reasons = set(unremovable_reasons)
+
             # status document (reference: WriteStatusConfigMap every loop,
             # static_autoscaler.go:418-421; gated by --write-status-configmap)
             from kubernetes_autoscaler_tpu.clusterstate.api import build_status
@@ -640,6 +682,8 @@ class StaticAutoscaler:
                 self.cluster_state, now,
                 scale_down_candidates=status.unneeded_nodes,
                 config_map_name=self.options.status_config_map_name,
+                unschedulable_reasons=noscaleup,
+                unremovable_reasons=unremovable_reasons,
             )
             if self.status_sink is not None and self.options.write_status_configmap:
                 try:
@@ -671,14 +715,26 @@ class StaticAutoscaler:
                 0.0 if self._scale_down_allowed(now) else 1.0)
 
             self.health.mark_active(now)
+            self.event_sink.end_loop()
         return status
 
     def _feed_snapshot_observability(self, dbg, tracer) -> None:
-        """Attach the loop's phase breakdown + trace id to an armed
-        /snapshotz payload so the JSON links to the Perfetto timeline."""
+        """Attach the loop's phase breakdown + trace id + reason plane to an
+        armed /snapshotz payload so the JSON links to the Perfetto timeline
+        AND says which constraint refused which pods / what blocked each
+        unremovable node."""
         dbg.set_phase_stats({
             "planner": self.planner.phases.snapshot(),
             "scale_up": self.scale_up_orchestrator.phases.snapshot(),
+        })
+        dbg.set_reason_plane({
+            "noScaleUp": list(self.scale_up_orchestrator.last_noscaleup_groups),
+            "unremovableNodes": {
+                n: {"reason": e[1]} for n, e in
+                self.planner.unremovable.entries.items()
+            },
+            "drainFailDetail": dict(self.planner.state.drain_fail_detail),
+            "events": self.event_sink.snapshot(),
         })
         if tracer is not None:
             dbg.set_trace_id(tracer.trace_id)

@@ -128,6 +128,16 @@ class DebuggingSnapshotter:
                 return
             self._data["traceId"] = trace_id
 
+    def set_reason_plane(self, payload: dict[str, Any]) -> None:
+        """The loop's explainable verdicts: refused pod groups with their
+        constraint bits, unremovable nodes with reasons + drain-failure
+        detail, and the event-sink ring — so a /snapshotz dump of a breached
+        loop says WHICH constraint refused WHICH pods."""
+        with self._lock:
+            if self._armed is None:
+                return
+            self._data["reasonPlane"] = payload
+
     def flush(self, now: float | None = None, error: str | None = None) -> None:
         """End of RunOnce: resolve the armed handle (reference: Flush).
         `error` is the flush-on-error path — the loop raised, so the caller

@@ -168,6 +168,29 @@ class BinpackingEstimator:
                             mesh=self.mesh)
 
 
+def explain_refused_groups(
+    specs: PodGroupTensors,
+    group_tensors: NodeGroupTensors,
+    refused: np.ndarray,         # bool[G] — groups no expansion option helped
+    dims: Dims,
+) -> np.ndarray:
+    """The estimator layer's reason pass: uint16[G, NG] refusal bits for the
+    refused pod groups against every node group's template (fresh empty
+    node — capacity vs template allocatable, predicates vs template
+    labels/taints). The reference reports this per pod from the estimator's
+    scheduling errors ("pod didn't fit on node group …"); here it is ONE
+    lazy masked dispatch + one batched fetch over refused groups only, so a
+    loop where every option helps performs zero extra dispatches
+    (`reason_extraction_dispatches` — the caller counts)."""
+    import jax.numpy as jnp
+
+    from kubernetes_autoscaler_tpu.ops import predicates as preds
+
+    tmpl_nodes = group_tensors.as_node_tensors(dims)
+    return np.asarray(preds.reason_mask_for_groups(
+        tmpl_nodes, specs, jnp.asarray(np.asarray(refused, bool))))
+
+
 def build_estimator(name: str, dims: Dims, **kw) -> BinpackingEstimator:
     """reference: estimator.NewEstimatorBuilder (estimator.go:75)."""
     if name != "binpacking":

@@ -85,19 +85,25 @@ class Registry:
         self._lock = threading.Lock()
 
     def counter(self, name: str, help: str = "") -> Counter:
-        return self._get(name, lambda: Counter(name, help))
+        return self._get(name, lambda: Counter(name, help), help)
 
     def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get(name, lambda: Gauge(name, help))
+        return self._get(name, lambda: Gauge(name, help), help)
 
     def histogram(self, name: str, help: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
-        return self._get(name, lambda: Histogram(name, help, buckets))
+        return self._get(name, lambda: Histogram(name, help, buckets), help)
 
-    def _get(self, name: str, make):
+    def _get(self, name: str, make, help: str = ""):
         with self._lock:
             if name not in self._metrics:
                 self._metrics[name] = make()
-            return self._metrics[name]
+            m = self._metrics[name]
+            # a later accessor may carry the family's help string while the
+            # first (hot-path) touch did not — upgrade so the exposition's
+            # `# HELP` line does not depend on call order
+            if help and not getattr(m, "help", ""):
+                m.help = help
+            return m
 
     @contextmanager
     def time_function(self, label: str):

@@ -89,6 +89,61 @@ FUNCTION_DURATION = {
     "scaleDown:softTaintUnneeded": "soft_taint_unneeded",
 }
 
+# ---- the REASON plane: reference reason-bearing families → ours ----
+#
+# The reference carries per-verdict reasons on three surfaces (ISSUE 5):
+# per-pod NoScaleUp / per-node NoScaleDown events (EventRecorder posts in
+# core/scaleup + core/scaledown, spam-bounded by kube event aggregation),
+# the ~20-value unremovable enum (simulator/cluster.go:63-103) feeding
+# `unremovable_nodes_count{reason}`, and `unschedulable_pods_count`. Each
+# maps onto a surface here; PARITY.md carries the same table.
+REASON_FAMILIES = {
+    # reference family / surface -> ours
+    "unschedulable_pods_count": (
+        "unschedulable_pods_count — unlabeled total (parity), plus a "
+        "{reason} label carrying the predicate-kernel reason taxonomy "
+        "(ops/predicates.REASON_BITS + no-node-in-group) for pods no node "
+        "group can help"),
+    "unremovable_nodes_count": (
+        "unremovable_nodes_count — unlabeled total (parity), plus a "
+        "{reason} label per unremovable enum value "
+        "(UnremovableNodes.reason_counts)"),
+    "NoScaleUp/NoScaleDown events": (
+        "events.EventSink — deduped by (kind, object, reason), throttled by "
+        "a klogx per-loop quota, exported into /snapshotz payloads; "
+        "scale_events_total{kind,reason} / scale_events_dropped_total "
+        "count the flow"),
+    "skipped_scale_events_count": (
+        "skipped_scale_events_count{direction,reason} — quota/limit skips, "
+        "emitted by the orchestrator's _apply_quota (unchanged family)"),
+}
+
+# The reference UnremovableReason enum values our planner actually produces,
+# value-for-value (simulator/cluster.go:63-103). A dashboard filtering the
+# reference's unremovable_nodes_count{reason=...} re-points unchanged.
+UNREMOVABLE_REASONS = {
+    "ScaleDownDisabledAnnotation": "ScaleDownDisabledAnnotation",
+    "ScaleDownUnreadyDisabled": "ScaleDownUnreadyDisabled",
+    "NotAutoscaled": "NotAutoscaled",
+    "NotUnneededLongEnough": "NotUnneededLongEnough",
+    "NodeGroupMinSizeReached": "NodeGroupMinSizeReached",
+    "MinimalResourceLimitExceeded": "MinimalResourceLimitExceeded",
+    "NoPlaceToMovePods": "NoPlaceToMovePods",
+    "BlockedByPod": "BlockedByPod",
+    "NotEnoughPdb": "NotEnoughPdb",
+    # atomic (ZeroOrMaxNodeScaling) groups: the reference filters these in
+    # its AtomicResizeFilteringProcessor with the same failure semantics
+    "AtomicScaleDownFailed": "AtomicScaleDownFailed",
+}
+
+UNREMOVABLE_REASONS_NA = {
+    "NoReason": "the TTL cache stores refusals only; an accepted candidate has no entry",
+    "CurrentlyBeingDeleted": "deletion in-flight state lives in the actuator's NodeDeletionTracker (pending_node_deletions gauge), not the unremovable cache",
+    "NotUnderutilized": "utilization screening re-evaluates every loop and is never cached — the recheck TTL applies only to simulation failures (planner.update comment)",
+    "NotUnreadyLongEnough": "unready candidates gate on --scale-down-unready-enabled + ScaleDownUnreadyTime inside removable_at; the shared NotUnneededLongEnough entry covers the immature clock in both readiness states",
+    "UnexpectedError": "the device sweep cannot partially fail per node; a raised loop surfaces via errors_total and the flight recorder instead",
+}
+
 FUNCTION_DURATION_NA = {
     "scaleDown:miscOperations": "bookkeeping the reference batches between passes is inline host policy here, nanoseconds not a stage",
     "poll": "loop scheduling lives in core/loop.py run_loop, outside RunOnce; scan_interval pacing has no duration to observe",

@@ -53,6 +53,32 @@ from kubernetes_autoscaler_tpu.ops.predicates import feasibility_mask
 from kubernetes_autoscaler_tpu.ops.schedule import resident_group_counts
 
 
+# ---- per-candidate drain failure reasons (the scale-down reason plane) ----
+#
+# Codes align with the reference unremovable enum (simulator/cluster.go:63-103)
+# where the device sim can attribute the failure; TooManyPodShapes is this
+# framework's own conservative K-overflow verdict (see simulate_removals).
+DRAIN_OK = 0
+DRAIN_BLOCKED_BY_POD = 1       # reference: BlockedByPod (drainability rules)
+DRAIN_NO_PLACE_FOR_GROUP = 2   # reference: NoPlaceToMovePods; fail_group says
+                               # WHICH pod shape found no destination
+DRAIN_TOO_MANY_SHAPES = 3      # > max_groups_per_node distinct shapes resident
+DRAIN_REASON_NAMES = {
+    DRAIN_OK: "",
+    DRAIN_BLOCKED_BY_POD: "BlockedByPod",
+    DRAIN_NO_PLACE_FOR_GROUP: "NoPlaceToMovePods",
+    DRAIN_TOO_MANY_SHAPES: "TooManyPodShapes",
+}
+
+
+class RemovalReasons(struct.PyTreeNode):
+    """Explanation record per failed candidate (lazy second dispatch)."""
+
+    reason: jax.Array      # i32[C] DRAIN_* code
+    fail_group: jax.Array  # i32[C] first equivalence row with unplaced pods (-1)
+    n_unplaced: jax.Array  # i32[C] movable pods with no destination
+
+
 class RemovalResult(struct.PyTreeNode):
     drainable: jax.Array   # bool[C] all movable pods re-placed & no blockers
     has_blocker: jax.Array # bool[C] a pod forbids draining (drainability rules)
@@ -335,4 +361,136 @@ def _simulate_removals_jit(
         pod_slot=pod_slot,
         feas=feas_gn,
     )
+
+
+def failure_reasons(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors,
+    candidates: jnp.ndarray,        # i32[C] FAILED candidate node indices
+    dest_allowed: jnp.ndarray,
+    max_pods_per_node: int = 128,
+    chunk: int = 256,
+    max_groups_per_node: int = 16,
+) -> RemovalReasons:
+    """The lazy drain reason pass: re-run the per-candidate group compaction +
+    first-fit for the candidates the main sweep reported undrainable, and say
+    WHY — blocked-by-pod, no-place-for-pod-group-k, or shape overflow.
+
+    Off the hot path by contract: the planner dispatches this only when some
+    candidate failed (counted under `reason_extraction_dispatches`; a loop
+    where every candidate drains performs zero extra dispatches), and only
+    over the failed subset (padded to a chunk multiple so the executable is
+    reused as the failure count drifts). The pass is EXPLANATORY, not a
+    verdict: it runs the plain-capacity re-placement, so a candidate that
+    failed only on topology constraints (with_constraints sims) comes back
+    DRAIN_OK and the caller keeps the generic NoPlaceToMovePods reason —
+    drainability truth always stays with `simulate_removals`."""
+    c_total = int(candidates.shape[0])
+    pad_c = max(((c_total + chunk - 1) // chunk) * chunk, chunk)
+    cand_pad = jnp.concatenate([
+        jnp.asarray(candidates, jnp.int32),
+        jnp.zeros((pad_c - c_total,), jnp.int32),
+    ])
+    res = _failure_reasons_jit(
+        nodes, specs, scheduled, cand_pad, jnp.asarray(dest_allowed),
+        max_pods_per_node, chunk, max_groups_per_node)
+    return RemovalReasons(
+        reason=res.reason[:c_total],
+        fail_group=res.fail_group[:c_total],
+        n_unplaced=res.n_unplaced[:c_total],
+    )
+
+
+@partial(jax.jit, static_argnames=("max_pods_per_node", "chunk",
+                                   "max_groups_per_node"))
+def _failure_reasons_jit(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors,
+    candidates: jnp.ndarray,
+    dest_allowed: jnp.ndarray,
+    max_pods_per_node: int = 128,
+    chunk: int = 256,
+    max_groups_per_node: int = 16,
+) -> RemovalReasons:
+    """Trimmed sibling of `_simulate_removals_jit`: same window gather, group
+    compaction and K-step first-fit, but no per-pod destination
+    reconstruction (the MPN-quadratic part) — only the failure attribution."""
+    n = nodes.n
+    g_total = specs.g
+    mpn = max_pods_per_node
+    kk = max_groups_per_node
+
+    feas_gn = feasibility_mask(nodes, specs, check_resources=False)
+    resident = resident_group_counts(scheduled, g_total, n)
+    feas_gn = feas_gn & ~(specs.anti_affinity_self[:, None] & (resident > 0))
+    limit_g = specs.one_per_node()
+    free0 = nodes.free()
+
+    sort_key = jnp.where(scheduled.valid, scheduled.node_idx, n + 1)
+    pod_order = jnp.argsort(sort_key).astype(jnp.int32)
+    sorted_nodes = sort_key[pod_order]
+    starts = jnp.searchsorted(sorted_nodes, jnp.arange(n)).astype(jnp.int32)
+    pad_order = jnp.concatenate([pod_order, jnp.full((mpn,), -1, jnp.int32)])
+
+    def one_candidate(c):
+        start = starts[c]
+        slots = jax.lax.dynamic_slice(pad_order, (start,), (mpn,))
+        safe = jnp.maximum(slots, 0)
+        on_c = (slots >= 0) & (scheduled.node_idx[safe] == c) & scheduled.valid[safe]
+        movable = on_c & scheduled.movable[safe]
+        blocker = (on_c & scheduled.blocks[safe]).any()
+
+        gref = jnp.where(movable, scheduled.group_ref[safe], g_total)
+        counts = jnp.zeros((g_total + 1,), jnp.int32).at[gref].add(
+            movable.astype(jnp.int32))
+        nz = counts[:g_total] > 0
+        rank = jnp.cumsum(nz) - 1
+        compact_of_g = jnp.where(nz & (rank < kk), rank, kk)
+        gidx = (jnp.zeros((kk + 1,), jnp.int32)
+                .at[compact_of_g].set(jnp.arange(g_total, dtype=jnp.int32))[:kk])
+        filled = jnp.arange(kk) < jnp.minimum(nz.sum(), kk)
+        cnt_k = jnp.where(filled, counts[:g_total][gidx], 0)
+        overflow = nz.sum() > kk
+
+        dest = dest_allowed & nodes.valid & nodes.ready & nodes.schedulable
+        dest = dest & (jnp.arange(n) != c)
+
+        def step(free_c, j):
+            gi = gidx[j]
+            want = cnt_k[j]
+            fit = fit_count(free_c, specs.req[gi])
+            fit = jnp.where(feas_gn[gi] & dest, fit, 0)
+            fit = jnp.where(limit_g[gi], jnp.minimum(fit, 1), fit)
+            fit = jnp.minimum(fit, want)
+            cum = jnp.cumsum(fit)
+            place = jnp.clip(want - (cum - fit), 0, fit)
+            free_c = free_c - place[:, None] * specs.req[gi][None, :]
+            return free_c, place.sum()
+
+        _, placed_k = jax.lax.scan(step, free0,
+                                   jnp.arange(kk, dtype=jnp.int32))
+        unplaced_k = cnt_k - placed_k
+        scan_fail = (unplaced_k > 0).any()
+        first_j = jnp.argmax(unplaced_k > 0)
+        fail_group = jnp.where(scan_fail, gidx[first_j], -1)
+        n_unplaced = (movable.sum() - placed_k.sum()).astype(jnp.int32)
+        reason = jnp.where(
+            blocker, DRAIN_BLOCKED_BY_POD,
+            jnp.where(scan_fail, DRAIN_NO_PLACE_FOR_GROUP,
+                      jnp.where(overflow, DRAIN_TOO_MANY_SHAPES, DRAIN_OK)))
+        return (reason.astype(jnp.int32), fail_group.astype(jnp.int32),
+                n_unplaced)
+
+    c_total = candidates.shape[0]
+    pad_c = ((c_total + chunk - 1) // chunk) * chunk
+    cand_pad = jnp.concatenate(
+        [candidates, jnp.zeros((pad_c - c_total,), jnp.int32)]
+    ).reshape(-1, chunk)
+    outs = jax.lax.map(jax.vmap(one_candidate), cand_pad)
+    reason, fail_group, n_unplaced = jax.tree_util.tree_map(
+        lambda x: x.reshape((pad_c,) + x.shape[2:])[:c_total], outs)
+    return RemovalReasons(reason=reason, fail_group=fail_group,
+                          n_unplaced=n_unplaced)
 

@@ -26,10 +26,64 @@ fused XLA graph); no data-dependent Python control flow.
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_autoscaler_tpu.models.cluster_state import NodeTensors, PodGroupTensors
+from kubernetes_autoscaler_tpu.models.resources import (
+    CPU,
+    EPHEMERAL,
+    MEMORY,
+    NUM_STANDARD,
+    PODS,
+)
+
+# ---- the REASON plane: packed per-(pod-group × node) refusal bits ----
+#
+# Each bit names the Filter that refused the pair; 0 ⇔ feasible (the
+# invariant `feasibility_mask == (reason_mask == 0)` is property-tested in
+# tests/test_predicate_fuzz.py). uint16 keeps the whole G×N plane one quarter
+# the size of the int32 predicate inputs. The taxonomy follows the reference's
+# NoScaleUp/event reasons (estimator skip reasons + the per-filter verdicts
+# its scheduler framework reports) — see docs/OBSERVABILITY.md for the table.
+REASON_CPU = 1 << 0           # NodeResourcesFit: cpu request > free
+REASON_MEMORY = 1 << 1        # NodeResourcesFit: memory
+REASON_EPHEMERAL = 1 << 2     # NodeResourcesFit: ephemeral-storage
+REASON_PODS = 1 << 3          # NodeResourcesFit: pod-capacity slot
+REASON_EXTENDED = 1 << 4      # NodeResourcesFit: any extended resource (GPU…)
+REASON_SELECTOR = 1 << 5      # NodeAffinity / nodeSelector mismatch
+REASON_TAINT = 1 << 6         # TaintToleration: uncovered NoSchedule/NoExecute
+REASON_PORTS = 1 << 7         # NodePorts: hostPort collision
+REASON_NODE_UNAVAILABLE = 1 << 8  # invalid / unready / unschedulable node row
+REASON_GROUP_INVALID = 1 << 9     # padding pod-group row (specs.valid False)
+
+# ordered: the first set bit in this order is the headline reason
+REASON_BITS = (
+    (REASON_CPU, "cpu"),
+    (REASON_MEMORY, "memory"),
+    (REASON_EPHEMERAL, "ephemeral-storage"),
+    (REASON_PODS, "pod-capacity"),
+    (REASON_EXTENDED, "extended-resource"),
+    (REASON_SELECTOR, "selector"),
+    (REASON_TAINT, "taint"),
+    (REASON_PORTS, "ports"),
+    (REASON_NODE_UNAVAILABLE, "node-unavailable"),
+    (REASON_GROUP_INVALID, "invalid-group"),
+)
+REASON_NAMES = {bit: name for bit, name in REASON_BITS}
+
+# host-level summary reasons (not kernel bits):
+# - a refused group with no valid node/template column at all (reference:
+#   the NoScaleUp "no node group can help" event)
+NO_NODE_IN_GROUP = "no-node-in-group"
+# - a refused group with at least one fully-feasible column: the constraint
+#   planes admit it somewhere, so the refusal came from option capping
+#   (max_new / limiter stack / bins crowded out by earlier FFD groups) —
+#   the reference's "max node group size reached"-family skip reasons
+CAPPED_BY_LIMITS = "capped-by-limits"
 
 
 def _any_eq(table: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
@@ -155,3 +209,91 @@ def feasibility_mask(
     gate = nodes.valid & nodes.ready & nodes.schedulable
     mask = mask & gate[None, :]
     return mask & specs.valid[:, None]
+
+
+def _bit(fail: jnp.ndarray, b: int) -> jnp.ndarray:
+    return jnp.where(fail, jnp.uint16(b), jnp.uint16(0))
+
+
+def reason_mask(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    check_resources: bool = True,
+) -> jnp.ndarray:
+    """The reason variant of `feasibility_mask`: uint16[G, N] packed refusal
+    bits, one per (pod-equivalence-group, node). 0 ⇔ the pair is feasible —
+    bit-for-bit `feasibility_mask(...) == (reason_mask(...) == 0)` for the
+    same `check_resources` (the property tests pin this).
+
+    Same trace-time cost shape as the boolean plane (each constraint plane is
+    evaluated once and mapped to its bit), but it is NOT on the hot path: the
+    normal pack/sim runs the boolean plane unchanged, and callers dispatch
+    this only over already-refused groups / failed candidates (the lazy
+    second-dispatch contract — `reason_mask_for_groups` below)."""
+    bits = _bit(~selector_match(nodes.label_hash, specs), REASON_SELECTOR)
+    bits |= _bit(~taints_tolerated(nodes.taint_exact, nodes.taint_key, specs),
+                 REASON_TAINT)
+    bits |= _bit(~ports_free(nodes.used_ports, specs), REASON_PORTS)
+    if check_resources:
+        free = nodes.free()
+        lack = specs.req[:, None, :] > free[None, :, :]     # bool[G, N, R]
+        bits |= _bit(lack[..., CPU], REASON_CPU)
+        bits |= _bit(lack[..., MEMORY], REASON_MEMORY)
+        bits |= _bit(lack[..., EPHEMERAL], REASON_EPHEMERAL)
+        bits |= _bit(lack[..., PODS], REASON_PODS)
+        bits |= _bit(lack[..., NUM_STANDARD:].any(axis=-1), REASON_EXTENDED)
+    gate = nodes.valid & nodes.ready & nodes.schedulable
+    bits |= _bit(~gate, REASON_NODE_UNAVAILABLE)[None, :]
+    bits |= _bit(~specs.valid, REASON_GROUP_INVALID)[:, None]
+    return bits
+
+
+@partial(jax.jit, static_argnames=("check_resources",))
+def reason_mask_for_groups(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    group_mask: jnp.ndarray,       # bool[G] — only these rows carry bits
+    check_resources: bool = True,
+) -> jnp.ndarray:
+    """The lazy masked dispatch: reason bits for the refused groups only
+    (other rows zeroed so host consumers can't misread padding). One device
+    program + one batched fetch per *refused* loop; a fully-schedulable loop
+    never dispatches it — callers count dispatches under
+    `reason_extraction_dispatches`."""
+    bits = reason_mask(nodes, specs, check_resources=check_resources)
+    return jnp.where(group_mask[:, None], bits, jnp.uint16(0))
+
+
+def reason_bit_names(bits: int) -> list[str]:
+    """Decode one packed value into its ordered reason names."""
+    return [name for bit, name in REASON_BITS if bits & bit]
+
+
+def summarize_reason_row(row: np.ndarray, col_valid: np.ndarray
+                         ) -> tuple[str, dict[str, int]]:
+    """Host-side summary of ONE refused group's reason row: the headline
+    reason plus per-constraint refused-column counts.
+
+    `col_valid` masks real columns (live nodes, or `groups.valid` when the
+    row came from the template plane). Headline selection: no valid column
+    at all means nothing could ever host the group ("no-node-in-group"); a
+    fully-feasible column (bits == 0) means the constraint planes admit the
+    group somewhere and the refusal came from option capping
+    ("capped-by-limits"); a constraint refusing on EVERY valid column
+    (bitwise AND) is the single blocking reason; otherwise no one constraint
+    explains the refusal alone — "multiple-constraints"."""
+    cols = np.asarray(row)[np.asarray(col_valid, bool)]
+    if cols.size == 0:
+        return NO_NODE_IN_GROUP, {}
+    counts = {
+        name: int(n)
+        for bit, name in REASON_BITS
+        if (n := int((cols & bit != 0).sum()))
+    }
+    if (cols == 0).any():
+        return CAPPED_BY_LIMITS, counts
+    common = int(np.bitwise_and.reduce(cols))
+    for bit, name in REASON_BITS:
+        if common & bit:
+            return name, counts
+    return "multiple-constraints", counts

