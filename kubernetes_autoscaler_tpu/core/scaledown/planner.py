@@ -43,7 +43,10 @@ from kubernetes_autoscaler_tpu.ops.drain import (
     fetch_result,
     simulate_removals,
 )
-from kubernetes_autoscaler_tpu.ops.hostfetch import fetch_pytree
+from kubernetes_autoscaler_tpu.ops.hostfetch import (
+    fetch_pytree,
+    fetch_pytree_async,
+)
 from kubernetes_autoscaler_tpu.resourcequotas.tracker import QuotaTracker
 
 
@@ -74,6 +77,30 @@ def _hostarr(enc: "EncodedCluster", key: str, dev) -> np.ndarray:
     if _mirror_hit(enc, key, dev):
         return np.asarray(enc.host_arrays[key])
     return np.asarray(dev)
+
+
+class _HostFetchHandle:
+    """Resolved mirror hits + an optional in-flight AsyncFetch for the
+    misses; `.get()` merges both (idempotent, closes the async span). The
+    blocking remainder of the harvest is timed into the owner's `fetch`
+    phase totals via PhaseStats.observe — the async span on the trace
+    already covers the full issue→harvest window, so no new span opens."""
+
+    __slots__ = ("_hits", "_async", "_phases")
+
+    def __init__(self, hits: dict, async_fetch, phases=None):
+        self._hits = hits
+        self._async = async_fetch
+        self._phases = phases
+
+    def get(self) -> dict:
+        if self._async is not None:
+            t0 = time.perf_counter()
+            self._hits.update(self._async.get())
+            if self._phases is not None:
+                self._phases.observe("fetch", time.perf_counter() - t0)
+            self._async = None
+        return dict(self._hits)
 
 
 @dataclass
@@ -167,6 +194,24 @@ class Planner:
         self.marshal_cache_misses = 0
         self.elig_cache_hits = 0
         self.elig_cache_misses = 0
+        # occupancy-plane prefetch heuristic: start optimistic, then track
+        # whether the previous loop actually produced eligible candidates
+        self._prefetch_occupancy = True
+
+    @staticmethod
+    def _split_mirror_hits(enc: EncodedCluster, items: dict
+                           ) -> tuple[dict, dict]:
+        """Partition `items` into (mirror hits as host arrays, misses) —
+        the ONE definition of which reads are free; both the sync and async
+        batched-fetch paths dispatch on it."""
+        hits: dict[str, np.ndarray] = {}
+        miss: dict[str, object] = {}
+        for key, dev in items.items():
+            if _mirror_hit(enc, key, dev):
+                hits[key] = np.asarray(enc.host_arrays[key])
+            else:
+                miss[key] = dev
+        return hits, miss
 
     def _fetch_host(self, enc: EncodedCluster, items: dict) -> dict:
         """Batched `_hostarr`: mirror hits are free; ALL misses ride one
@@ -174,21 +219,36 @@ class Planner:
         (~70 ms per transfer over the TPU tunnel). `items` maps mirror key →
         the device array to fall back to; `nodes.alloc`/`specs.count` are
         always fetched (post-placement state — see the `_hostarr` contract)."""
-        out: dict[str, np.ndarray] = {}
-        miss: dict[str, object] = {}
-        for key, dev in items.items():
-            if _mirror_hit(enc, key, dev):
-                out[key] = np.asarray(enc.host_arrays[key])
-            else:
-                miss[key] = dev
+        out, miss = self._split_mirror_hits(enc, items)
         if miss:
             # one batched device→host transfer for every miss; the counter
             # makes transfer traffic visible on the trace and in the
-            # phase_events_total registry series
+            # phase_events_total registry series (fetch_pytree additionally
+            # bumps the moved/logical byte counters — bool planes ride
+            # bit-packed, ops/bitplane)
             self.phases.bump("batched_fetch_transfers")
             with self.phases.phase("fetch", leaves=len(miss)):
-                out.update(fetch_pytree(miss))
+                out.update(fetch_pytree(miss, phases=self.phases))
         return out
+
+    def _fetch_host_async(self, enc: EncodedCluster, items: dict):
+        """Double-buffered `_fetch_host`: mirror hits resolve immediately,
+        ALL misses ride one `fetch_pytree_async` transfer issued NOW and
+        harvested via the returned handle's `.get()` — the device→host copy
+        overlaps whatever host work runs in between (update() issues this
+        before the eligibility screen and harvests after it, so the transfer
+        hides under the Python policy loop). The in-flight window is a
+        `fetch` span (async=true) on the loop trace. Tradeoff vs the lazy
+        conditional fetch: the transfer is issued even when the consumer
+        branch ends up not needing it — callers should only prefetch items
+        they need on the COMMON path."""
+        hits, miss = self._split_mirror_hits(enc, items)
+        handle = None
+        if miss:
+            self.phases.bump("batched_fetch_transfers")
+            self.phases.bump("batched_fetch_async")
+            handle = fetch_pytree_async(miss, phases=self.phases)
+        return _HostFetchHandle(hits, handle, phases=self.phases)
 
     # ---- evicted-pod anticipation (reference: injectRecentlyEvictedPods,
     # planner.go:230-260) ----
@@ -337,6 +397,22 @@ class Planner:
         util = self._utilization(enc, nodes)
         defaults = _ng_defaults(self.options)
 
+        # Double buffer: the candidate-pool sort below needs the scheduled-pod
+        # occupancy planes; issue their batched fetch NOW so the device→host
+        # copy rides under the Python eligibility screen instead of stalling
+        # after it (mirror hits make this free; the span on the loop trace
+        # shows the overlap window). Gated on last loop's outcome so an IDLE
+        # cluster (zero eligible nodes loop after loop) does not pay a
+        # speculative transfer for data the branch below never reads — it
+        # falls back to the old lazy sync fetch on the loop that first finds
+        # candidates, and prefetches again from the next loop on.
+        sv_handle = None
+        if self._prefetch_occupancy:
+            sv_handle = self._fetch_host_async(enc, {
+                "scheduled.valid": enc.scheduled.valid,
+                "scheduled.node_idx": enc.scheduled.node_idx,
+            })
+
         eligible_idx: list[int] = []
         group_deletable: dict[str, int] = {}
         for i, nd in enumerate(nodes):
@@ -372,11 +448,17 @@ class Planner:
         # running, then empty nodes so cheap deletions come first, pool
         # capped at max(ratio x cluster, min) via
         # --scale-down-candidates-pool-ratio, FAQ.md:1117).
+        # harvest (overlapped with the screen above) even when nothing is
+        # eligible — an issued AsyncFetch owns an open trace span; lazy sync
+        # fetch when the idle heuristic skipped the prefetch
+        sv = sv_handle.get() if sv_handle is not None else None
+        self._prefetch_occupancy = bool(eligible_idx)
         if eligible_idx:
-            sv = self._fetch_host(enc, {
-                "scheduled.valid": enc.scheduled.valid,
-                "scheduled.node_idx": enc.scheduled.node_idx,
-            })
+            if sv is None:
+                sv = self._fetch_host(enc, {
+                    "scheduled.valid": enc.scheduled.valid,
+                    "scheduled.node_idx": enc.scheduled.node_idx,
+                })
             occupied = {
                 int(x) for x in sv["scheduled.node_idx"][sv["scheduled.valid"]]
             }
@@ -435,7 +517,7 @@ class Planner:
         # device_get costs one tunnel round trip EACH — 7 leaves ≈ 0.5 s
         # per loop over the TPU tunnel)
         with self.phases.phase("fetch"):
-            removal = fetch_result(removal)
+            removal = fetch_result(removal, phases=self.phases)
         drainable = np.asarray(removal.drainable)
         # LAZY reason pass over the FAILED candidates only (ops/drain.
         # failure_reasons): which pod shape found no destination, or shape
@@ -452,7 +534,7 @@ class Planner:
                     jnp.asarray(cand[failed_rows]), jnp.asarray(dest_allowed),
                     max_pods_per_node=self.options.max_pods_per_node,
                     chunk=self.options.drain_chunk)
-                rr = fetch_pytree(rr)
+                rr = fetch_pytree(rr, phases=self.phases)
             greq = self._fetch_host(enc, {"specs.req": enc.specs.req})["specs.req"]
             for j, k in enumerate(failed_rows):
                 code = int(rr.reason[j])

@@ -202,7 +202,8 @@ class ScaleUpOrchestrator:
         gpu_slot = enc.registry.slots.get(self.provider.gpu_resource_name())
         with self.phases.phase("fetch"):
             options = options_from_scores(scores, [g.id() for g in groups],
-                                          groups=groups, gpu_slot=gpu_slot)
+                                          groups=groups, gpu_slot=gpu_slot,
+                                          phases=self.phases)
         with self.phases.phase("confirm"):
             options = self._verify_lossy_winners(
                 options, est, enc, groups, estimator, group_tensors,

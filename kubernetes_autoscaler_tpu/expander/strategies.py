@@ -45,10 +45,11 @@ class Option:
 
 def options_from_scores(scores: OptionScores, group_ids: list[str],
                         groups: list | None = None,
-                        gpu_slot: int | None = None) -> list[Option]:
-    # one bulk device→host fetch; the per-element int()/float() reads
-    # below would otherwise each pay a tunnel round trip
-    scores = fetch_scores(scores)
+                        gpu_slot: int | None = None,
+                        phases=None) -> list[Option]:
+    # one bulk device→host fetch (bool leaves bit-packed); the per-element
+    # int()/float() reads below would otherwise each pay a tunnel round trip
+    scores = fetch_scores(scores, phases=phases)
     valid = np.asarray(scores.valid)
     helped = (np.asarray(scores.helped_req)
               if scores.helped_req is not None else None)

@@ -82,6 +82,21 @@ class PhaseStats:
                     "planner_phase_seconds", help=_PHASE_HELP,
                     buckets=PHASE_BUCKETS).observe(dt, phase=name)
 
+    def observe(self, name: str, seconds: float) -> None:
+        """Record an externally-timed interval into a phase's totals and the
+        registry histogram WITHOUT opening a span — for intervals whose span
+        already exists elsewhere on the trace (the async batched fetch opens
+        its own `fetch` span at issue time; the blocking remainder measured
+        at harvest must still count toward planner_phase_seconds{fetch}, and
+        a nested span here would end the still-open async span out of LIFO
+        order)."""
+        self.totals_s[name] = self.totals_s.get(name, 0.0) + seconds
+        self.counts[name] = self.counts.get(name, 0) + 1
+        if self.registry is not None:
+            self.registry.histogram(
+                "planner_phase_seconds", help=_PHASE_HELP,
+                buckets=PHASE_BUCKETS).observe(seconds, phase=name)
+
     def bump(self, event: str, n: int = 1) -> None:
         self.events[event] = self.events.get(event, 0) + n
         tracer = trace.current_tracer()

@@ -80,6 +80,28 @@ def _pack_option(cap_row, max_new, feas_col, max_new_nodes,
     return node_cnt, res.scheduled, pods_per_node, res.free_after
 
 
+def _pack_options_pallas(cap, max_new, feas_gt, max_new_nodes,
+                         req, count, order, limit_one):
+    """All (local) expansion options as ONE fused Pallas launch: batch row =
+    option, bins = `max_new_nodes` empty template nodes. The single pallas
+    body both the single-device branch and the shard_map estimator shards
+    dispatch — the kernel is collective-free, so running it per shard is
+    exactly the single-device program on the shard's option slice."""
+    from kubernetes_autoscaler_tpu.ops.pallas.pack_kernel import (
+        pack_groups_batched,
+    )
+
+    ng, r = cap.shape
+    free3 = jnp.broadcast_to(cap[:, None, :], (ng, max_new_nodes, r))
+    bin_open = (jnp.arange(max_new_nodes, dtype=jnp.int32)[None, :]
+                < max_new[:, None])
+    mask3 = feas_gt.T[:, :, None] & bin_open[:, None, :]
+    res = pack_groups_batched(free3, mask3, req, count, order, limit_one)
+    pods_per_node = res.placed.sum(axis=1)
+    node_count = (pods_per_node > 0).sum(axis=-1).astype(jnp.int32)
+    return node_count, res.scheduled, pods_per_node, res.free_after
+
+
 def estimate_all(
     specs: PodGroupTensors,
     groups: NodeGroupTensors,
@@ -103,12 +125,12 @@ def estimate_all(
     independent pack — no collectives), so a multi-chip mesh computes NG/P
     options per chip instead of replicating all of them; bit-identical to the
     unsharded path. Falls back silently when NG does not divide the axis or
-    the constrained tier is active (its planes are node-indexed). NOTE: the
-    sharded path packs with the lax.scan kernel on every shard even where
-    pack_backend() would pick 'pallas' — mesh parallelism currently trades
-    the fused Mosaic kernel for cross-chip scaling (a pallas-inside-shard_map
-    variant is future work); benchmark both on your shape before enabling a
-    mesh on TPU."""
+    the constrained tier is active (its planes are node-indexed). The shard
+    body honors pack_backend() exactly like the single-device path: with
+    'pallas' each shard runs the fused Mosaic kernel over its option slice
+    (pack_groups_batched is collective-free, so pallas-inside-shard_map is
+    the same program per shard) — the scan-per-shard fallback that used to
+    ignore KA_TPU_PACK on the mesh path is gone."""
     tmpl_nodes = groups.as_node_tensors(dims)
     # bool[G, NG]: placement-independent predicates vs each template
     # (capacity is enforced by the packer against the empty bins).
@@ -129,25 +151,15 @@ def estimate_all(
                 specs, groups, max_new_nodes, mask_gt, order, count, mesh)
 
     if pack_backend() == "pallas":
-        from kubernetes_autoscaler_tpu.ops.pallas.pack_kernel import (
-            pack_groups_batched,
-        )
-
-        ng, r = groups.cap.shape
-        free3 = jnp.broadcast_to(groups.cap[:, None, :], (ng, max_new_nodes, r))
-        bin_open = jnp.arange(max_new_nodes, dtype=jnp.int32)[None, :] < groups.max_new[:, None]
-        mask3 = mask_gt.T[:, :, None] & bin_open[:, None, :]
-        res = pack_groups_batched(
-            free3, mask3, specs.req, count, order, specs.one_per_node()
-        )
-        pods_per_node = res.placed.sum(axis=1)
-        node_count = (pods_per_node > 0).sum(axis=-1).astype(jnp.int32)
+        node_count, scheduled, pods_per_node, free_after = _pack_options_pallas(
+            groups.cap, groups.max_new, mask_gt, max_new_nodes,
+            specs.req, count, order, specs.one_per_node())
         node_count = jnp.where(groups.valid, node_count, 0)
         return EstimateResult(
             node_count=node_count,
-            scheduled=res.scheduled * groups.valid[:, None],
+            scheduled=scheduled * groups.valid[:, None],
             pods_per_node=pods_per_node,
-            free_after=res.free_after,
+            free_after=free_after,
             template_fits=mask_gt.T,
         )
 
@@ -184,7 +196,13 @@ def _estimate_all_sharded(
     pending set — the distributed form of the reference's per-nodegroup
     estimator goroutines (orchestrator.go:379), mapped onto the mesh axis the
     way Tesserae shards its machine axis. The NODES_AXIS of the mesh is left
-    replicated here: template bins are per-option scratch, not cluster nodes."""
+    replicated here: template bins are per-option scratch, not cluster nodes.
+
+    The shard body honors pack_backend(): 'pallas' runs the fused Mosaic
+    kernel on each shard's option slice (options are independent, the kernel
+    has no collectives — per shard it IS the single-device program), 'xla'
+    keeps the lax.scan formulation. Both are byte-identical to the unsharded
+    estimate (tests/test_sharded_estimator.py runs the suite under each)."""
     from functools import partial
 
     from jax.sharding import PartitionSpec as P
@@ -192,6 +210,7 @@ def _estimate_all_sharded(
     from kubernetes_autoscaler_tpu.parallel.mesh import PODS_AXIS
 
     limit_one = specs.one_per_node()
+    use_pallas = pack_backend() == "pallas"
 
     @partial(
         _shard_map,
@@ -203,6 +222,11 @@ def _estimate_all_sharded(
         **_SHARD_MAP_KW,
     )
     def run(cap_l, max_new_l, feas_l, req_r, count_r, order_r, limone_r):
+        if use_pallas:
+            return _pack_options_pallas(
+                cap_l, max_new_l, feas_l.T, max_new_nodes,
+                req_r, count_r, order_r, limone_r)
+
         def one_group(cap_row, max_new, feas_col):
             return _pack_option(cap_row, max_new, feas_col, max_new_nodes,
                                 req_r, count_r, order_r, limone_r)

@@ -1,0 +1,130 @@
+"""Bit-packed boolean planes: 32 predicate verdicts per int32 lane word.
+
+The simulator's boolean planes — the (pod-group × node) feasibility mask,
+selector/taint match planes, wavefront plan masks — are semantically one bit
+per pair but have been carried as bool (1 byte on the wire, 4 bytes as the
+int32 mask blocks the Pallas kernels stage into VMEM). At bench shape
+(64 groups × 5k nodes padded) that is megabytes of plane traffic per control
+loop for kilobytes of information. This module is the single home for the
+packed layout the PR 4 uint16 reason plane hinted at, taken to 1 bit:
+
+  * `pack_group_bits` / `unpack_group_bits` — pack along the GROUP axis
+    (axis -2): `bool[..., G, N] → int32[..., ceil(G/32), N]`. This is the
+    layout the pack kernels consume: lane l of word row w carries groups
+    `32w..32w+31` for node l, so a kernel resolving group g reads word row
+    `g // 32` and shifts by `g % 32` — a dynamic-uniform scalar shift, no
+    gather. VMEM mask footprint drops 32× vs the int32 staging blocks.
+  * `pack_flat_bits` / `unpack_flat_bits_np` — pack a flat bool stream into
+    int32 words (device) and unpack on the host (numpy). ops/hostfetch uses
+    this pair so every bool leaf of a batched device→host fetch moves 1 bit
+    per element instead of 1 byte (~8× fewer tunnel bytes).
+  * numpy mirrors (`*_np`) for host-side consumers (wavefront planning,
+    cache fingerprints, tests).
+
+Contract: packing is little-endian within a word (bit j of word w is element
+`32w + j`) on both device and host, and every pair round-trips bit-for-bit —
+property-tested in tests/test_bitplane.py together with the
+`feasible ⇔ reason_bits == 0` invariant on packed planes.
+
+Words are int32, not uint32: the Pallas TPU toolchain and the existing
+int32 fetch buffer class both prefer i32, and all bit arithmetic here uses
+logical shifts, so the sign bit is just bit 31.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WORD_BITS = 32
+
+
+def words_for(n: int) -> int:
+    """How many int32 words hold `n` bits."""
+    return (n + WORD_BITS - 1) // WORD_BITS
+
+
+def pack_group_bits(mask: jnp.ndarray) -> jnp.ndarray:
+    """bool[..., G, N] → int32[..., ceil(G/32), N], packed along axis -2.
+
+    Bit `g % 32` of word row `g // 32` is group g's verdict for each node
+    lane. Padding rows are zero (infeasible), which is exactly what the
+    pack kernels want for nonexistent groups."""
+    m = jnp.asarray(mask).astype(bool)
+    g = m.shape[-2]
+    gw = words_for(g)
+    pad = gw * WORD_BITS - g
+    if pad:
+        widths = [(0, 0)] * (m.ndim - 2) + [(0, pad), (0, 0)]
+        m = jnp.pad(m, widths)
+    m = m.reshape(*m.shape[:-2], gw, WORD_BITS, m.shape[-1]).astype(jnp.int32)
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.int32).reshape(
+        (1,) * (m.ndim - 3) + (1, WORD_BITS, 1))
+    words = jax.lax.shift_left(m, jnp.broadcast_to(shifts, m.shape))
+    # sum ≡ or here: element j contributes only bit j, so there are no
+    # carries — and sum-reductions lower everywhere (CPU XLA rejects an
+    # s32 or-reduction inside spmd-partitioned programs)
+    return jnp.sum(words, axis=m.ndim - 2, dtype=jnp.int32)
+
+
+def unpack_group_bits(words: jnp.ndarray, g: int) -> jnp.ndarray:
+    """Inverse of pack_group_bits: int32[..., Gw, N] → bool[..., G, N]."""
+    w = jnp.asarray(words)
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.int32).reshape(
+        (1,) * (w.ndim - 2) + (WORD_BITS, 1))
+    bits = jax.lax.shift_right_logical(
+        w[..., :, None, :], jnp.broadcast_to(shifts, (*w.shape[:-1],
+                                                      WORD_BITS, w.shape[-1]))
+    ) & 1
+    full = bits.reshape(*w.shape[:-2], w.shape[-2] * WORD_BITS, w.shape[-1])
+    return full[..., :g, :].astype(bool)
+
+
+def pack_flat_bits(flat: jnp.ndarray) -> jnp.ndarray:
+    """bool[n] → int32[ceil(n/32)] little-endian bit stream (device)."""
+    m = jnp.asarray(flat).astype(bool).ravel()
+    n = m.shape[0]
+    nw = words_for(max(n, 1))
+    pad = nw * WORD_BITS - n
+    if pad:
+        m = jnp.pad(m, (0, pad))
+    m = m.reshape(nw, WORD_BITS).astype(jnp.int32)
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.int32)[None, :]
+    words = jax.lax.shift_left(m, jnp.broadcast_to(shifts, m.shape))
+    # sum ≡ or over disjoint bit positions (see pack_group_bits)
+    return jnp.sum(words, axis=1, dtype=jnp.int32)
+
+
+def unpack_flat_bits_np(words: np.ndarray, n: int) -> np.ndarray:
+    """Host inverse of pack_flat_bits: int32 words → bool[n]."""
+    w = np.asarray(words).astype(np.uint32)
+    if n == 0:
+        return np.zeros((0,), bool)
+    bits = (w[:, None] >> np.arange(WORD_BITS, dtype=np.uint32)[None, :]) & 1
+    return bits.reshape(-1)[:n].astype(bool)
+
+
+def pack_group_bits_np(mask: np.ndarray) -> np.ndarray:
+    """Host mirror of pack_group_bits (numpy, for plans/fingerprints)."""
+    m = np.asarray(mask, bool)
+    g = m.shape[-2]
+    gw = words_for(g)
+    pad = gw * WORD_BITS - g
+    if pad:
+        widths = [(0, 0)] * (m.ndim - 2) + [(0, pad), (0, 0)]
+        m = np.pad(m, widths)
+    m = m.reshape(*m.shape[:-2], gw, WORD_BITS, m.shape[-1]).astype(np.uint32)
+    words = (m << np.arange(WORD_BITS, dtype=np.uint32)
+             .reshape((1,) * (m.ndim - 3) + (1, WORD_BITS, 1)))
+    return np.bitwise_or.reduce(words, axis=-2).astype(np.uint32).view(np.int32)
+
+
+def unpack_group_bits_np(words: np.ndarray, g: int) -> np.ndarray:
+    """Host inverse of pack_group_bits_np."""
+    w = np.asarray(words).view(np.uint32)
+    bits = (w[..., :, None, :]
+            >> np.arange(WORD_BITS, dtype=np.uint32)
+            .reshape((1,) * (w.ndim - 1) + (WORD_BITS, 1))) & 1
+    full = bits.reshape(*w.shape[:-2], w.shape[-2] * WORD_BITS, w.shape[-1])
+    return full[..., :g, :].astype(bool)

@@ -91,13 +91,14 @@ class RemovalResult(struct.PyTreeNode):
                            # re-pick destinations without re-running predicates
 
 
-def fetch_result(r: "RemovalResult") -> "RemovalResult":
+def fetch_result(r: "RemovalResult", phases=None) -> "RemovalResult":
     """Device→host with at most three transfers (ops/hostfetch) instead of
     one per leaf — each leaf transfer is a ~70 ms round trip over the TPU
-    tunnel."""
+    tunnel. The bool `feas` plane rides bit-packed (1 bit/verdict); `phases`
+    turns on the moved/logical byte counters."""
     from kubernetes_autoscaler_tpu.ops.hostfetch import fetch_pytree
 
-    return fetch_pytree(r)
+    return fetch_pytree(r, phases=phases)
 
 
 def simulate_removals(

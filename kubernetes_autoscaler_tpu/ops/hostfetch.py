@@ -1,14 +1,35 @@
-"""Single-shot device→host fetch for result pytrees.
+"""Single-shot device→host fetch for result pytrees, with bit-packed bools
+and an async double-buffered variant.
 
 jax.device_get walks pytree leaves one transfer each; over the TPU tunnel
 every transfer is a ~70 ms round trip, so a 7-leaf result costs ~0.5 s per
 control loop. `fetch_pytree` concatenates the leaves into at most three
-dtype-class buffers ON DEVICE (bool→uint8 so the big feasibility planes are
-not widened 4x, integers→int32, floats→float32) and reconstructs the exact
-original structure, shapes and dtypes on the host — three transfers worst
-case, independent of leaf count. The packer is one jitted function whose
-cache keys on the pytree structure+shapes, so there is nothing to keep in
-sync when a result struct gains or reorders fields.
+dtype-class buffers ON DEVICE and reconstructs the exact original structure,
+shapes and dtypes on the host — three transfers worst case, independent of
+leaf count.
+
+Boolean leaves — the big predicate planes — are BIT-PACKED into int32 words
+(ops/bitplane.pack_flat_bits) instead of widened to uint8: one bit per
+verdict on the wire, ~8× fewer tunnel bytes for a pure-bool fetch. The
+packer is one jitted function whose cache keys on the pytree
+structure+shapes, so there is nothing to keep in sync when a result struct
+gains or reorders fields.
+
+Transfer accounting: pass `phases` (a metrics/phases.PhaseStats) and every
+fetch bumps `batched_fetch_bytes_moved` (actual buffer bytes shipped) and
+`batched_fetch_bytes_logical` (what the pre-bit-packing layout — bool→uint8,
+int→int32, float→float32 — would have shipped). The ratio is the measured
+plane-compression win; bench.py asserts ≥4× on the wavefront-plan fetch.
+
+`fetch_pytree_async` is the double-buffering half: it launches the pack
+program, starts the device→host copies (`copy_to_host_async`), and returns
+immediately with an `AsyncFetch` handle — the caller overlaps the next
+loop's encode upload / dispatch with the in-flight fetch and harvests with
+`.get()`. The handle opens a `fetch` span (attr `async=true`) on the active
+tracer at issue time and closes it at harvest, so the overlap is VISIBLE on
+the flight-recorder timeline: encode/dispatch spans of the next loop nest
+inside the still-open fetch span of the previous one. Harvest the handle
+before issuing the next one — the Tracer's span stack is LIFO.
 """
 
 from __future__ import annotations
@@ -17,6 +38,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_autoscaler_tpu.metrics import trace as _trace
+from kubernetes_autoscaler_tpu.ops.bitplane import (
+    pack_flat_bits,
+    unpack_flat_bits_np,
+)
 
 _SUPPORTED = ("bool", "int8", "int16", "int32", "uint8", "uint16",
               "float32")
@@ -33,30 +59,46 @@ def _packed(tree):
             f"fetch_pytree cannot pack dtype {leaf.dtype}; widen _SUPPORTED "
             f"and the buffer classes first")
         if leaf.dtype == jnp.bool_:
-            bools.append(leaf.ravel().astype(jnp.uint8))
+            bools.append(leaf.ravel())
         elif jnp.issubdtype(leaf.dtype, jnp.floating):
             floats.append(leaf.ravel().astype(jnp.float32))
         else:
             ints.append(leaf.ravel().astype(jnp.int32))
     empty = lambda dt: jnp.zeros((0,), dt)  # noqa: E731
     return (
-        jnp.concatenate(bools) if bools else empty(jnp.uint8),
+        # one bit per bool on the wire: the whole bool stream packs into
+        # int32 words (little-endian bit order, ops/bitplane contract)
+        pack_flat_bits(jnp.concatenate(bools)) if bools else empty(jnp.int32),
         jnp.concatenate(ints) if ints else empty(jnp.int32),
         jnp.concatenate(floats) if floats else empty(jnp.float32),
     )
 
 
-def fetch_pytree(tree):
-    """Return the same pytree with every leaf as a host numpy array of the
-    ORIGINAL shape and dtype, using at most three device→host transfers."""
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    if len(leaves) <= 1:
-        # one leaf is one transfer either way — skip the pack program (and
-        # its per-structure jit cache entry; the planner's batched host
-        # views hand in many distinct small dict shapes)
-        return jax.tree_util.tree_unflatten(
-            treedef, [np.asarray(jax.device_get(x)) for x in leaves])
-    b, i, f = jax.device_get(_packed(tree))
+def _logical_nbytes(leaves) -> int:
+    """Bytes the pre-bit-packing buffer classes would have moved
+    (bool→uint8, integer→int32, float→float32) — the denominator of the
+    transfer-compression counters."""
+    total = 0
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.ndim else 1
+        total += n * (1 if np.dtype(leaf.dtype) == np.bool_ else 4)
+    return total
+
+
+def _account(phases, bufs, leaves) -> None:
+    if phases is None:
+        return
+    moved = sum(int(np.prod(b.shape)) * np.dtype(b.dtype).itemsize
+                for b in bufs)
+    phases.bump("batched_fetch_bytes_moved", moved)
+    phases.bump("batched_fetch_bytes_logical", _logical_nbytes(leaves))
+
+
+def _unflatten(leaves, treedef, b_words, i, f):
+    """Slice the three host buffers back into the original leaves."""
+    n_bool = sum(int(np.prod(leaf.shape)) if leaf.ndim else 1
+                 for leaf in leaves if np.dtype(leaf.dtype) == np.bool_)
+    b = unpack_flat_bits_np(b_words, n_bool)
     offs = {"b": 0, "i": 0, "f": 0}
     out = []
     for leaf in leaves:
@@ -72,3 +114,67 @@ def fetch_pytree(tree):
                    .reshape(leaf.shape).astype(dt))
         offs[key] += n
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def fetch_pytree(tree, phases=None):
+    """Return the same pytree with every leaf as a host numpy array of the
+    ORIGINAL shape and dtype, using at most three device→host transfers
+    (bool leaves ride bit-packed). `phases` enables byte accounting."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if len(leaves) <= 1:
+        # one leaf is one transfer either way — skip the pack program (and
+        # its per-structure jit cache entry; the planner's batched host
+        # views hand in many distinct small dict shapes)
+        return jax.tree_util.tree_unflatten(
+            treedef, [np.asarray(jax.device_get(x)) for x in leaves])
+    bufs = _packed(tree)
+    _account(phases, bufs, leaves)
+    b, i, f = jax.device_get(bufs)
+    return _unflatten(leaves, treedef, b, i, f)
+
+
+class AsyncFetch:
+    """In-flight batched fetch: issued now, harvested with `.get()`.
+
+    Between issue and harvest the caller runs the NEXT loop's encode/dispatch
+    — that is the double buffer. The handle owns a `fetch` span (async=true)
+    on the tracer that was active at issue time; `.get()` closes it, so
+    whatever ran in between shows up nested inside the fetch span on the
+    timeline. `.get()` is idempotent."""
+
+    __slots__ = ("_leaves", "_treedef", "_bufs", "_result", "_done",
+                 "_tracer", "_span")
+
+    def __init__(self, tree, phases=None, span_name: str = "fetch"):
+        self._leaves, self._treedef = jax.tree_util.tree_flatten(tree)
+        self._bufs = _packed(tree)
+        _account(phases, self._bufs, self._leaves)
+        for buf in self._bufs:
+            start = getattr(buf, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        self._result = None
+        self._done = False
+        self._tracer = _trace.current_tracer()
+        self._span = (self._tracer.begin(span_name, cat="fetch",
+                                         **{"async": True})
+                      if self._tracer is not None else None)
+
+    def get(self):
+        """Block for the transfers (already overlapped with whatever the
+        caller did since issue) and rebuild the original pytree."""
+        if self._done:
+            return self._result
+        b, i, f = jax.device_get(self._bufs)
+        self._result = _unflatten(self._leaves, self._treedef, b, i, f)
+        self._done = True
+        self._bufs = None
+        if self._tracer is not None:
+            self._tracer.end(self._span)
+            self._tracer = None
+        return self._result
+
+
+def fetch_pytree_async(tree, phases=None) -> AsyncFetch:
+    """Issue a batched fetch without blocking; see AsyncFetch."""
+    return AsyncFetch(tree, phases=phases)

@@ -278,8 +278,10 @@ class WavefrontCache:
         mask = np.asarray(mask, bool)
         order = np.asarray(order)
         act = None if active is None else np.asarray(active, bool)
-        fp = (mask.shape, mask.tobytes(), order.tobytes(),
-              None if act is None else act.tobytes())
+        # bit-packed fingerprint: the retained key is G×N/8 bytes, not G×N
+        # (the ops/bitplane idea applied to the cache's memory footprint)
+        fp = (mask.shape, np.packbits(mask).tobytes(), order.tobytes(),
+              None if act is None else np.packbits(act).tobytes())
         if self._entry is not None and self._entry[0] == fp:
             self.hits += 1
             if phases is not None:

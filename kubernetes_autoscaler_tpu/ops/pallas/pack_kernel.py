@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for the first-fit-decreasing pack scan.
+"""Pallas TPU kernels for the first-fit-decreasing pack scan.
 
 This is the Pallas tier of the hot op named in SURVEY.md §7 ("the
 scatter-heavy incremental node_alloc update and the first-fit argmin with
@@ -14,7 +14,31 @@ Here the whole pass is ONE kernel launch:
   * per-group remaining pod counts persist across node tiles in SMEM
     scratch — the cross-tile spill carry of first-fit,
   * group metadata (requests, counts, FFD order, one-per-node flags) ride
-    the scalar-prefetch channel into SMEM.
+    the scalar-prefetch channel into SMEM,
+  * the feasibility mask is BIT-PACKED along the group axis
+    (ops/bitplane.pack_group_bits): the VMEM mask block is
+    int32[ceil(G/32), tile] instead of int32[G, tile] — 32× less mask
+    VMEM — and the kernel resolves group g with one dynamic-uniform
+    logical shift (word row g//32, bit g%32), no gather.
+
+Two kernels share that layout:
+
+  `pack_groups_batched`   the serial-order pack (group loop in FFD order),
+                          batched over independent free-capacity rows —
+                          the estimate_all expansion-option shape. Runs
+                          unchanged INSIDE shard_map (no collectives per
+                          batch row), which is how the mesh-sharded
+                          estimator keeps the fused kernel per shard.
+  `pack_groups_wavefront_pallas`
+                          the segmented per-wavefront pack: the Pallas
+                          analog of ops/pack.pack_groups_wavefront's
+                          segmented scan step. Each wavefront's slots are
+                          placed against the WAVE-START free capacity and
+                          applied as one fused carry update — legal
+                          because in-wave masks are pairwise disjoint
+                          (see compute_wavefronts), byte-identical to the
+                          serial pack by the same argument, and
+                          property-tested against both formulations.
 
 Semantics are bit-identical to ops/pack.pack_groups (property-tested in
 tests/test_pallas_pack.py): nodes fill in ascending index order, groups in
@@ -35,7 +59,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from kubernetes_autoscaler_tpu.ops.pack import PackResult
+from kubernetes_autoscaler_tpu.ops.bitplane import pack_group_bits, words_for
+from kubernetes_autoscaler_tpu.ops.pack import PackResult, WavefrontPlan
 
 _BIG = 1 << 30  # Python int: jnp scalars would be captured tracer constants
 
@@ -53,6 +78,34 @@ def _cumsum_lanes(x: jnp.ndarray, tile: int) -> jnp.ndarray:
     return x
 
 
+def _mask_row(mask_ref, g, lead=None):
+    """bool-ish i32[1, T] feasibility row for group g from the bit-packed
+    mask block: word row g//32, logical shift by g%32. Both index and shift
+    amount are SMEM scalars — a dynamic sublane slice plus a uniform
+    vector-scalar shift, the whole point of the group-axis packing."""
+    gw = g // 32
+    gb = g % 32
+    if lead is None:
+        word = mask_ref[pl.ds(gw, 1), :]
+    else:
+        word = mask_ref[lead, pl.ds(gw, 1), :]
+    return jax.lax.shift_right_logical(word, gb) & 1
+
+
+def _fit_row(freeout_ref, req_ref, g, n_res, tile, lead=None):
+    """i32[1, T]: how many group-g pods fit each node lane right now."""
+    fit = jnp.full((1, tile), _BIG, jnp.int32)
+    for r in range(n_res):
+        rv = req_ref[g, r]
+        if lead is None:
+            fr = jnp.maximum(freeout_ref[r: r + 1, :], 0)
+        else:
+            fr = jnp.maximum(freeout_ref[lead, r: r + 1, :], 0)
+        q = fr // jnp.maximum(rv, 1)
+        fit = jnp.minimum(fit, jnp.where(rv > 0, q, _BIG))
+    return fit
+
+
 def _pack_kernel(
     # scalar prefetch (SMEM)
     req_ref,      # i32[G, R]
@@ -61,7 +114,7 @@ def _pack_kernel(
     limone_ref,   # i32[G]
     # VMEM blocks
     free_ref,     # i32[1, R, T] this tile's starting free capacity
-    mask_ref,     # i32[1, G, T] feasibility (already includes bin_open/validity)
+    mask_ref,     # i32[1, Gw, T] BIT-PACKED feasibility (incl. bin_open/validity)
     placed_ref,   # i32[1, G, T] out
     freeout_ref,  # i32[1, R, T] out
     # scratch
@@ -87,14 +140,8 @@ def _pack_kernel(
         cnt = rem_ref[g]
         lim = limone_ref[g]
 
-        fit = jnp.full((1, tile), _BIG, jnp.int32)
-        for r in range(n_res):
-            rv = req_ref[g, r]
-            fr = jnp.maximum(freeout_ref[0, r : r + 1, :], 0)
-            q = fr // jnp.maximum(rv, 1)
-            fit = jnp.minimum(fit, jnp.where(rv > 0, q, _BIG))
-
-        m = mask_ref[0, pl.ds(g, 1), :]
+        fit = _fit_row(freeout_ref, req_ref, g, n_res, tile, lead=0)
+        m = _mask_row(mask_ref, g, lead=0)
         fit = jnp.where(m > 0, fit, 0)
         fit = jnp.where(lim > 0, jnp.minimum(fit, 1), fit)
         # Clamp to the remaining count: semantics-neutral, and keeps the
@@ -127,11 +174,16 @@ def pack_groups_batched(
 ) -> PackResult:
     """Batched FFD pack as one Pallas launch; batch rows are independent.
 
+    The bool mask is bit-packed along the group axis before the launch, so
+    the kernel's VMEM mask blocks are Gw = ceil(G/32) words deep. Safe to
+    call inside shard_map (no collectives; the grid is per-shard).
+
     Returns a PackResult with a leading batch axis on every field."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, n, r = free.shape
     g = req.shape[0]
+    gw = words_for(g)
     tile = min(tile, max(128, n))
     n_pad = ((n + tile - 1) // tile) * tile
     nt = n_pad // tile
@@ -139,7 +191,8 @@ def pack_groups_batched(
     free_t = jnp.swapaxes(free.astype(jnp.int32), 1, 2)          # [B, R, N]
     if n_pad != n:
         free_t = jnp.pad(free_t, ((0, 0), (0, 0), (0, n_pad - n)))
-    mask_i = jnp.pad(mask.astype(jnp.int32), ((0, 0), (0, 0), (0, n_pad - n)))
+    mask_bits = pack_group_bits(
+        jnp.pad(jnp.asarray(mask, bool), ((0, 0), (0, 0), (0, n_pad - n))))
 
     kernel = functools.partial(_pack_kernel, n_groups=g, n_res=r, tile=tile)
     placed, free_out = pl.pallas_call(
@@ -149,7 +202,7 @@ def pack_groups_batched(
             grid=(b, nt),
             in_specs=[
                 pl.BlockSpec((1, r, tile), lambda bi, t, *_: (bi, 0, t)),
-                pl.BlockSpec((1, g, tile), lambda bi, t, *_: (bi, 0, t)),
+                pl.BlockSpec((1, gw, tile), lambda bi, t, *_: (bi, 0, t)),
             ],
             out_specs=[
                 pl.BlockSpec((1, g, tile), lambda bi, t, *_: (bi, 0, t)),
@@ -168,7 +221,7 @@ def pack_groups_batched(
         order.astype(jnp.int32),
         limit_one.astype(jnp.int32),
         free_t,
-        mask_i,
+        mask_bits,
     )
 
     placed = placed[:, :, :n]
@@ -200,3 +253,158 @@ def pack_groups_pallas(
         placed=res.placed[0],
         scheduled=res.scheduled[0],
     )
+
+
+def _wavefront_kernel(
+    # scalar prefetch (SMEM)
+    req_ref,      # i32[G, R]
+    count_ref,    # i32[G]
+    limone_ref,   # i32[G]
+    waves_ref,    # i32[W, S] group ids per wavefront, -1 = padding slot
+    # VMEM blocks
+    free_ref,     # i32[R, T]
+    mask_ref,     # i32[Gw, T] bit-packed feasibility
+    placed_ref,   # i32[G, T] out
+    freeout_ref,  # i32[R, T] out
+    # scratch
+    rem_ref,      # SMEM i32[G] remaining pods (cross-tile carry)
+    delta_ref,    # VMEM i32[R, T] this wave's fused capacity update
+    *,
+    n_groups: int,
+    n_res: int,
+    n_waves: int,
+    n_slots: int,
+    tile: int,
+):
+    """Segmented per-wavefront placement: every slot of a wave reads the
+    WAVE-START free capacity (freeout_ref is only updated once per wave,
+    by the accumulated delta), mirroring the XLA wavefront scan step.
+    Disjoint in-wave masks make the fused update equal the serial
+    subtraction; the property tests pin byte-equality against BOTH
+    pack_groups and pack_groups_wavefront."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init_remaining():
+        def init(i, _):
+            rem_ref[i] = count_ref[i]
+            return 0
+        jax.lax.fori_loop(0, n_groups, init, 0)
+
+    freeout_ref[...] = free_ref[...]
+    placed_ref[...] = jnp.zeros_like(placed_ref)
+
+    def wave(w, _):
+        delta_ref[...] = jnp.zeros_like(delta_ref)
+        # slots unroll at trace time (S is static); the wave index stays
+        # dynamic — one fori iteration per wavefront, W total
+        for s in range(n_slots):
+            g = waves_ref[w, s]
+
+            @pl.when(g >= 0)
+            def _slot(g=g):
+                cnt = rem_ref[g]
+                lim = limone_ref[g]
+                fit = _fit_row(freeout_ref, req_ref, g, n_res, tile)
+                m = _mask_row(mask_ref, g)
+                fit = jnp.where(m > 0, fit, 0)
+                fit = jnp.where(lim > 0, jnp.minimum(fit, 1), fit)
+                fit = jnp.minimum(fit, cnt)
+                cum = _cumsum_lanes(fit, tile)
+                place = jnp.clip(cnt - (cum - fit), 0, fit)
+                for r in range(n_res):
+                    rv = req_ref[g, r]
+                    delta_ref[r : r + 1, :] = delta_ref[r : r + 1, :] + place * rv
+                placed_ref[pl.ds(g, 1), :] = place
+                rem_ref[g] = cnt - jnp.sum(place)
+
+        freeout_ref[...] = freeout_ref[...] - delta_ref[...]
+        return 0
+
+    jax.lax.fori_loop(0, n_waves, wave, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_waves", "n_slots", "tile", "interpret"))
+def _wavefront_call(free, mask, req, count, limit_one, waves,
+                    n_waves: int, n_slots: int,
+                    tile: int, interpret: bool) -> PackResult:
+    n, r = free.shape
+    g = req.shape[0]
+    gw = words_for(g)
+    tile = min(tile, max(128, n))
+    n_pad = ((n + tile - 1) // tile) * tile
+    nt = n_pad // tile
+
+    free_t = jnp.swapaxes(free.astype(jnp.int32), 0, 1)          # [R, N]
+    if n_pad != n:
+        free_t = jnp.pad(free_t, ((0, 0), (0, n_pad - n)))
+    mask_bits = pack_group_bits(
+        jnp.pad(jnp.asarray(mask, bool), ((0, 0), (0, n_pad - n))))
+
+    kernel = functools.partial(_wavefront_kernel, n_groups=g, n_res=r,
+                               n_waves=n_waves, n_slots=n_slots, tile=tile)
+    placed, free_out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(nt,),
+            in_specs=[
+                pl.BlockSpec((r, tile), lambda t, *_: (0, t)),
+                pl.BlockSpec((gw, tile), lambda t, *_: (0, t)),
+            ],
+            out_specs=[
+                pl.BlockSpec((g, tile), lambda t, *_: (0, t)),
+                pl.BlockSpec((r, tile), lambda t, *_: (0, t)),
+            ],
+            scratch_shapes=[
+                pltpu.SMEM((g,), jnp.int32),
+                pltpu.VMEM((r, tile), jnp.int32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((g, n_pad), jnp.int32),
+            jax.ShapeDtypeStruct((r, n_pad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        req.astype(jnp.int32),
+        count.astype(jnp.int32),
+        limit_one.astype(jnp.int32),
+        waves.astype(jnp.int32),
+        free_t,
+        mask_bits,
+    )
+
+    placed = placed[:, :n]
+    free_after = jnp.swapaxes(free_out, 0, 1)[:n, :]
+    return PackResult(
+        free_after=free_after,
+        placed=placed,
+        scheduled=placed.sum(axis=-1),
+    )
+
+
+def pack_groups_wavefront_pallas(
+    free: jnp.ndarray,       # i32[N, R]
+    mask: jnp.ndarray,       # bool[G, N]
+    req: jnp.ndarray,        # i32[G, R]
+    count: jnp.ndarray,      # i32[G]
+    limit_one: jnp.ndarray,  # bool[G]
+    plan: WavefrontPlan,
+    tile: int = 512,
+    interpret: bool | None = None,
+) -> PackResult:
+    """Drop-in Pallas replacement for ops/pack.pack_groups_wavefront.
+
+    Same superset-mask contract: a `plan` built from a SUPERSET of `mask`
+    in the same order stays byte-identical (conflicts only shrink). Safe
+    inside shard_map for batch-style axes; the node axis must be whole per
+    shard (the in-tile prefix sum is local, like the XLA wavefront)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    w, s = plan.waves.shape
+    return _wavefront_call(
+        jnp.asarray(free), jnp.asarray(mask), jnp.asarray(req),
+        jnp.asarray(count), jnp.asarray(limit_one), plan.waves,
+        n_waves=w, n_slots=s, tile=tile, interpret=interpret)

@@ -93,6 +93,18 @@ def schedule_pending_on_existing(
     if wavefront_plan is not None and wavefront_plan.worthwhile:
         # the plan mask is a SUPERSET of the runtime mask (it omits the
         # resident anti-affinity subtraction) — safe, see pack_groups_wavefront
+        from kubernetes_autoscaler_tpu.ops.binpack import pack_backend
+
+        if pack_backend() == "pallas":
+            # the segmented Mosaic kernel (same wave plan, same superset
+            # contract): one launch, bit-packed mask blocks in VMEM
+            from kubernetes_autoscaler_tpu.ops.pallas.pack_kernel import (
+                pack_groups_wavefront_pallas,
+            )
+
+            return pack_groups_wavefront_pallas(
+                nodes.free(), mask, specs.req, count, specs.one_per_node(),
+                wavefront_plan)
         return pack_groups_wavefront(
             nodes.free(), mask, specs.req, count, specs.one_per_node(),
             wavefront_plan)
@@ -106,8 +118,14 @@ def plan_wavefronts(nodes: NodeTensors, specs: PodGroupTensors,
     """Host-side wavefront planning for the existing-nodes pack.
 
     Evaluates the placement-independent feasibility mask (one small device
-    program), fetches it, and asks the cache for a coloring. Deliberately
-    SKIPS the resident self-anti-affinity subtraction the kernel applies at
+    program), fetches it, and asks the cache for a coloring. The mask comes
+    home through ops/hostfetch.fetch_pytree, which BIT-PACKS boolean leaves
+    (ops/bitplane): the predicate-plane fetch moves ~G×N/8 bytes instead of
+    G×N, counted under `batched_fetch_bytes_moved`/`_logical` on `phases` —
+    the counters bench.py's smoke mode asserts a ≥4× reduction on.
+
+    The plan deliberately SKIPS the resident self-anti-affinity subtraction
+    the kernel applies at
     runtime: the plan mask must be a superset of every runtime mask so that
     resident churn between control loops cannot invalidate the coloring —
     only composition changes (selectors/taints/labels) miss the cache. For
@@ -123,9 +141,11 @@ def plan_wavefronts(nodes: NodeTensors, specs: PodGroupTensors,
     plan-reshape recompile of the jitted sim."""
     import numpy as np
 
+    from kubernetes_autoscaler_tpu.ops.hostfetch import fetch_pytree
+
     mask = predicates.feasibility_mask(nodes, specs, check_resources=False)
     order = ffd_order(specs.req, specs.valid)
-    host = jax.device_get((mask, order, specs.valid))
+    host = fetch_pytree((mask, order, specs.valid), phases=phases)
     mask_h, order_h, active_h = (np.asarray(host[0]), np.asarray(host[1]),
                                  np.asarray(host[2]))
     return cache.plan(mask_h, order_h, active=active_h, phases=phases)

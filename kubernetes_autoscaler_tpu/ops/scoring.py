@@ -31,13 +31,13 @@ class OptionScores(struct.PyTreeNode):
                                          # price expander's pod-cost input
 
 
-def fetch_scores(sc: "OptionScores") -> "OptionScores":
+def fetch_scores(sc: "OptionScores", phases=None) -> "OptionScores":
     """Device→host with at most three transfers (ops/hostfetch) — the host
     consumes these values element-wise, and each lazy scalar read would be
-    its own round trip."""
+    its own round trip. `phases` turns on the moved/logical byte counters."""
     from kubernetes_autoscaler_tpu.ops.hostfetch import fetch_pytree
 
-    return fetch_pytree(sc)
+    return fetch_pytree(sc, phases=phases)
 
 
 def score_options(est: EstimateResult, groups: NodeGroupTensors,

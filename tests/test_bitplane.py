@@ -1,0 +1,263 @@
+"""Bit-packed predicate planes (ops/bitplane) and the bit-packed batched
+fetch path (ops/hostfetch): round-trips are bit-exact, the transfer-byte
+counters measure the ~8× compression, and the PR 4 reason-plane invariant
+`feasible ⇔ reason_bits == 0` survives a pack/unpack round trip bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_autoscaler_tpu.metrics.phases import PhaseStats
+from kubernetes_autoscaler_tpu.ops import bitplane
+from kubernetes_autoscaler_tpu.ops.hostfetch import (
+    fetch_pytree,
+    fetch_pytree_async,
+)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_group_bits_round_trip_device(seed):
+    rng = np.random.default_rng(seed)
+    g = int(rng.integers(1, 80))
+    n = int(rng.integers(1, 200))
+    mask = rng.random((g, n)) < rng.uniform(0.05, 0.95)
+    words = bitplane.pack_group_bits(jnp.asarray(mask))
+    assert words.shape == (bitplane.words_for(g), n)
+    assert words.dtype == jnp.int32
+    back = np.asarray(bitplane.unpack_group_bits(words, g))
+    np.testing.assert_array_equal(back, mask)
+
+
+def test_group_bits_device_and_numpy_agree():
+    rng = np.random.default_rng(7)
+    mask = rng.random((67, 33)) < 0.5           # G straddles a word boundary
+    dev = np.asarray(bitplane.pack_group_bits(jnp.asarray(mask)))
+    host = bitplane.pack_group_bits_np(mask)
+    np.testing.assert_array_equal(dev, host)
+    np.testing.assert_array_equal(
+        bitplane.unpack_group_bits_np(host, 67), mask)
+
+
+def test_group_bits_batched_axis():
+    rng = np.random.default_rng(9)
+    mask = rng.random((3, 40, 17)) < 0.4
+    words = bitplane.pack_group_bits(jnp.asarray(mask))
+    assert words.shape == (3, 2, 17)
+    np.testing.assert_array_equal(
+        np.asarray(bitplane.unpack_group_bits(words, 40)), mask)
+
+
+@pytest.mark.parametrize("n", [0, 1, 31, 32, 33, 257])
+def test_flat_bits_round_trip(n):
+    rng = np.random.default_rng(n)
+    flat = rng.random((n,)) < 0.5
+    words = np.asarray(bitplane.pack_flat_bits(jnp.asarray(flat)))
+    np.testing.assert_array_equal(
+        bitplane.unpack_flat_bits_np(words, n), flat)
+
+
+# ---- the bit-packed batched fetch ----
+
+
+def _world(n_nodes=10, n_pods=24):
+    from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192,
+                             labels={"disk": "ssd" if i % 2 else "hdd"})
+             for i in range(n_nodes)]
+    pods = [build_test_pod(f"p{i}", cpu_milli=250 + 250 * (i % 3),
+                           mem_mib=256, owner_name=f"rs{i % 4}",
+                           node_selector={"disk": "ssd"} if i % 5 == 0 else {})
+            for i in range(n_pods)]
+    return encode_cluster(nodes, pods, node_bucket=16, group_bucket=16)
+
+
+def test_fetch_pytree_bool_planes_bit_exact():
+    """Mixed pytree (bool planes + ints + floats) comes home byte-identical
+    to per-leaf device_get, with bools riding bit-packed."""
+    from kubernetes_autoscaler_tpu.ops import predicates
+
+    enc = _world()
+    mask = predicates.feasibility_mask(enc.nodes, enc.specs)
+    tree = {
+        "mask": mask,
+        "valid": enc.specs.valid,
+        "req": enc.specs.req,
+        "waste": jnp.linspace(0.0, 1.0, 7, dtype=jnp.float32),
+        "reason": predicates.reason_mask(enc.nodes, enc.specs),
+    }
+    got = fetch_pytree(tree)
+    for key, leaf in tree.items():
+        ref = np.asarray(jax.device_get(leaf))
+        assert got[key].dtype == ref.dtype, key
+        np.testing.assert_array_equal(got[key], ref, err_msg=key)
+
+
+def test_fetch_pytree_byte_counters_show_plane_compression():
+    """The moved/logical counters: a bool-dominated fetch moves ≥4× fewer
+    bytes than the unpacked layout (the acceptance criterion bench.py
+    asserts in smoke mode rides exactly these counters)."""
+    from kubernetes_autoscaler_tpu.ops import predicates
+
+    enc = _world()
+    mask = predicates.feasibility_mask(enc.nodes, enc.specs)
+    phases = PhaseStats(owner="test")
+    got = fetch_pytree({"mask": mask, "valid": enc.specs.valid}, phases=phases)
+    moved = phases.events["batched_fetch_bytes_moved"]
+    logical = phases.events["batched_fetch_bytes_logical"]
+    g, n = mask.shape
+    assert logical == g * n + g                  # 1 byte per bool, old layout
+    assert moved <= bitplane.words_for(g * n + g) * 4 + 4
+    assert logical / moved >= 4.0
+    np.testing.assert_array_equal(got["mask"],
+                                  np.asarray(jax.device_get(mask)))
+
+
+def test_fetch_pytree_async_round_trip_and_span():
+    """The double-buffer handle: correct data, idempotent get(), and a
+    `fetch` span (async=true) that stays OPEN until harvest so overlapped
+    work nests inside it on the timeline."""
+    from kubernetes_autoscaler_tpu.metrics import trace
+
+    enc = _world()
+    tracer = trace.Tracer()
+    with trace.active(tracer):
+        h = fetch_pytree_async({"req": enc.specs.req,
+                                "valid": enc.specs.valid})
+        with tracer.span("encode", cat="test"):
+            pass                                  # the overlapped work
+        out = h.get()
+        assert h.get() is out                     # idempotent
+    np.testing.assert_array_equal(out["req"],
+                                  np.asarray(jax.device_get(enc.specs.req)))
+    np.testing.assert_array_equal(out["valid"],
+                                  np.asarray(jax.device_get(enc.specs.valid)))
+    spans = {s[0]: s for s in tracer.spans}
+    fetch_span, encode_span = spans["fetch"], spans["encode"]
+    assert (fetch_span[5] or {}).get("async") is True
+    # the encode span ran INSIDE the open fetch window — interval containment
+    f0, f1 = fetch_span[2], fetch_span[2] + fetch_span[3]
+    e0, e1 = encode_span[2], encode_span[2] + encode_span[3]
+    assert f0 <= e0 and e1 <= f1
+
+
+def test_reason_invariant_survives_bit_packing():
+    """feasible ⇔ reason_bits == 0, bit-for-bit, THROUGH the packed plane:
+    pack(feasibility) → unpack must still equal (reason_mask == 0) on fuzzed
+    worlds (the PR 4 invariant with the PR 6 layout)."""
+    from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+    from kubernetes_autoscaler_tpu.ops import predicates
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    rng = np.random.default_rng(3)
+    for trial in range(3):
+        nodes = [
+            build_test_node(
+                f"n{i}", cpu_milli=int(rng.integers(500, 8000)),
+                mem_mib=int(rng.integers(256, 16384)),
+                pods=int(rng.integers(1, 20)),
+                labels={"disk": "ssd" if rng.random() < 0.5 else "hdd"},
+                gpus=int(rng.integers(0, 2)),
+            )
+            for i in range(int(rng.integers(2, 12)))
+        ]
+        pods = [
+            build_test_pod(
+                f"p{i}", cpu_milli=int(rng.integers(100, 6000)),
+                mem_mib=int(rng.integers(64, 8192)),
+                owner_name=f"rs{int(rng.integers(0, 5))}",
+                node_selector={"disk": "ssd"} if rng.random() < 0.3 else {},
+                gpus=int(rng.integers(0, 2)),
+            )
+            for i in range(int(rng.integers(3, 30)))
+        ]
+        enc = encode_cluster(nodes, pods, node_bucket=16, group_bucket=16)
+        feas = np.asarray(predicates.feasibility_mask(enc.nodes, enc.specs))
+        bits = np.asarray(predicates.reason_mask(enc.nodes, enc.specs))
+        packed = bitplane.pack_group_bits(jnp.asarray(feas))
+        unpacked = np.asarray(
+            bitplane.unpack_group_bits(packed, feas.shape[0]))
+        np.testing.assert_array_equal(unpacked, feas,
+                                      err_msg=f"round trip, trial {trial}")
+        np.testing.assert_array_equal(unpacked, bits == 0,
+                                      err_msg=f"invariant, trial {trial}")
+
+
+def test_planner_async_prefetch_overlaps_screen():
+    """Planner.update's candidate-pool prefetch: the sv planes arrive
+    through the async handle and the plan is unchanged vs a synchronous
+    fetch (the overlap is a latency property; correctness is equality)."""
+    from kubernetes_autoscaler_tpu.config.options import (
+        AutoscalingOptions,
+        NodeGroupDefaults,
+    )
+    from kubernetes_autoscaler_tpu.core.scaledown.planner import Planner
+    from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+    from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
+        apply_drainability,
+    )
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=16000, mem_mib=65536, pods=110)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=64)
+    nodes, pods = [], []
+    for i in range(12):
+        nd = build_test_node(f"n{i}", cpu_milli=16000, mem_mib=65536, pods=110)
+        fake.add_existing_node("ng1", nd)
+        nodes.append(nd)
+        for j in range(2):
+            p = build_test_pod(f"p{i}-{j}", cpu_milli=1600, mem_mib=512,
+                               owner_name=f"rs{i % 3}", node_name=nd.name)
+            fake.add_pod(p)
+            pods.append(p)
+    enc = encode_cluster(nodes, pods, node_bucket=16, group_bucket=16)
+    apply_drainability(enc)
+    opts = AutoscalingOptions(
+        node_shape_bucket=16, group_shape_bucket=16, max_pods_per_node=16,
+        drain_chunk=16,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=0.0, scale_down_unready_time_s=0.0))
+    planner = Planner(fake.provider, opts)
+    state = planner.update(enc, nodes, now=1000.0)
+    assert state.unneeded                        # low-util world drains
+
+    # the async path itself, on a guaranteed miss (nodes.alloc is in
+    # _ALWAYS_FETCH, never mirror-served): one async transfer counted, the
+    # harvested data byte-identical to a direct device read, and the
+    # blocking remainder recorded into the fetch phase totals
+    before_async = planner.phases.events.get("batched_fetch_async", 0)
+    before_fetch = planner.phases.counts.get("fetch", 0)
+    h = planner._fetch_host_async(enc, {"nodes.alloc": enc.nodes.alloc})
+    out = h.get()
+    assert h.get().keys() == out.keys()                     # idempotent
+    np.testing.assert_array_equal(
+        out["nodes.alloc"], np.asarray(jax.device_get(enc.nodes.alloc)))
+    assert planner.phases.events["batched_fetch_async"] == before_async + 1
+    assert planner.phases.counts["fetch"] == before_fetch + 1
+    # mirror hits stay free: a mirror-served key issues NO async transfer
+    from kubernetes_autoscaler_tpu.core.scaledown.planner import _mirror_hit
+
+    if _mirror_hit(enc, "nodes.valid", enc.nodes.valid):
+        n_async = planner.phases.events["batched_fetch_async"]
+        h2 = planner._fetch_host_async(enc, {"nodes.valid": enc.nodes.valid})
+        np.testing.assert_array_equal(
+            h2.get()["nodes.valid"],
+            np.asarray(jax.device_get(enc.nodes.valid)))
+        assert planner.phases.events["batched_fetch_async"] == n_async

@@ -169,3 +169,204 @@ def test_fit_count_sanity():
     free = jnp.asarray([[4, 4], [1, 8], [-2, 8]], jnp.int32)
     req = jnp.asarray([2, 1], jnp.int32)
     np.testing.assert_array_equal(np.asarray(fit_count(free, req)), [2, 0, 0])
+
+
+# ---- the segmented wavefront kernel (Pallas analog of
+# ops/pack.pack_groups_wavefront) ----
+
+
+def _wf_instance(rng, n, g, r=4, density=0.15, max_count=30):
+    """Sparse-mask instance + its wavefront plan (sparse masks give W<G on
+    luckier draws; the equality must hold for ANY W)."""
+    from kubernetes_autoscaler_tpu.ops.pack import build_wavefront_plan
+
+    free = jnp.asarray(rng.integers(0, 40, size=(n, r)), jnp.int32)
+    mask_np = rng.random((g, n)) < density
+    req = jnp.asarray(rng.integers(0, 6, size=(g, r)), jnp.int32)
+    count = jnp.asarray(rng.integers(0, max_count, size=(g,)), jnp.int32)
+    valid = np.ones((g,), bool)
+    order = np.asarray(ffd_order(req, jnp.asarray(valid)))
+    lim = jnp.asarray(rng.random((g,)) < 0.2)
+    plan = build_wavefront_plan(mask_np, order, active=valid)
+    return free, jnp.asarray(mask_np), req, count, jnp.asarray(order), lim, plan
+
+
+def _assert_wavefront_equal(free, mask, req, count, order, lim, plan,
+                            tile=128):
+    """The new kernel must agree with BOTH formulations: the serial scan
+    (ground truth) and the XLA segmented wavefront (same plan)."""
+    from kubernetes_autoscaler_tpu.ops.pack import pack_groups_wavefront
+    from kubernetes_autoscaler_tpu.ops.pallas.pack_kernel import (
+        pack_groups_wavefront_pallas,
+    )
+
+    ref = pack_groups(free, mask, req, count, order, lim)
+    xla_wf = pack_groups_wavefront(free, mask, req, count, lim, plan)
+    _assert_same(ref, xla_wf)
+    got = pack_groups_wavefront_pallas(free, mask, req, count, lim, plan,
+                                       tile=tile, interpret=True)
+    _assert_same(ref, got)
+
+
+# interpret-mode runs cost ~7s each on the tier-1 box; three fuzzed seeds
+# stay in tier-1, the rest ride the dedicated CI pallas job (no slow filter)
+@pytest.mark.parametrize(
+    "seed",
+    [0, 1, 2] + [pytest.param(s, marks=pytest.mark.slow) for s in (3, 4, 5)])
+def test_wavefront_pallas_matches_fuzzed(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(3, 300))
+    g = int(rng.integers(1, 40))
+    _assert_wavefront_equal(*_wf_instance(rng, n, g))
+
+
+# tier-1 keeps one-under / ragged-two-tile / ragged-three-tile; the exact
+# multiples run in the CI pallas job (no slow filter)
+@pytest.mark.parametrize(
+    "n",
+    [127, 129, 257] + [pytest.param(v, marks=pytest.mark.slow)
+                       for v in (128, 256)])
+def test_wavefront_pallas_tile_boundaries(n):
+    """Node counts straddling the tile edge: the SMEM remaining-count carry
+    must hand off across tiles exactly as the XLA scan spills."""
+    rng = np.random.default_rng(n)
+    free, mask, req, count, order, lim, plan = _wf_instance(
+        rng, n, 9, density=0.5, max_count=200)
+    _assert_wavefront_equal(free, mask, req, count, order, lim, plan)
+
+
+def test_wavefront_pallas_single_wave_all_disjoint():
+    """W==1 degenerate shape: pairwise-disjoint masks collapse the whole
+    pack into ONE wavefront — the fused carry update covers every group."""
+    from kubernetes_autoscaler_tpu.ops.pack import build_wavefront_plan
+
+    g, n, r = 6, 192, 4
+    rng = np.random.default_rng(0)
+    mask_np = np.zeros((g, n), bool)
+    for gi in range(g):                      # disjoint node stripes
+        mask_np[gi, gi * (n // g):(gi + 1) * (n // g)] = True
+    free = jnp.asarray(rng.integers(1, 20, size=(n, r)), jnp.int32)
+    req = jnp.asarray(rng.integers(1, 4, size=(g, r)), jnp.int32)
+    count = jnp.asarray(rng.integers(1, 60, size=(g,)), jnp.int32)
+    order = np.asarray(ffd_order(req, jnp.ones((g,), bool)))
+    lim = jnp.zeros((g,), bool)
+    plan = build_wavefront_plan(mask_np, order)
+    assert plan.n_waves == 1 and plan.worthwhile
+    _assert_wavefront_equal(free, jnp.asarray(mask_np), req, count,
+                            jnp.asarray(order), lim, plan)
+
+
+def test_wavefront_pallas_full_overlap_w_equals_g():
+    """W==G degenerate shape: every mask overlaps every other, so each
+    wavefront holds exactly one group — the kernel degrades to the serial
+    order without changing a byte."""
+    from kubernetes_autoscaler_tpu.ops.pack import build_wavefront_plan
+
+    g, n, r = 5, 140, 4
+    rng = np.random.default_rng(1)
+    mask_np = np.ones((g, n), bool)
+    free = jnp.asarray(rng.integers(0, 15, size=(n, r)), jnp.int32)
+    req = jnp.asarray(rng.integers(1, 5, size=(g, r)), jnp.int32)
+    count = jnp.asarray(rng.integers(1, 80, size=(g,)), jnp.int32)
+    order = np.asarray(ffd_order(req, jnp.ones((g,), bool)))
+    lim = jnp.zeros((g,), bool)
+    plan = build_wavefront_plan(mask_np, order)
+    assert plan.n_waves == g and not plan.worthwhile
+    _assert_wavefront_equal(free, jnp.asarray(mask_np), req, count,
+                            jnp.asarray(order), lim, plan)
+
+
+def test_wavefront_pallas_superset_plan_mask():
+    """The PR 2 superset contract carries over: a plan built from a SUPERSET
+    of the runtime mask (the schedule-layer anti-affinity subtraction) stays
+    byte-identical to the serial pack on the runtime mask."""
+    from kubernetes_autoscaler_tpu.ops.pack import build_wavefront_plan
+    from kubernetes_autoscaler_tpu.ops.pallas.pack_kernel import (
+        pack_groups_wavefront_pallas,
+    )
+
+    rng = np.random.default_rng(17)
+    g, n, r = 8, 150, 4
+    plan_mask = rng.random((g, n)) < 0.3
+    runtime_mask = plan_mask & (rng.random((g, n)) < 0.7)   # strict subset
+    free = jnp.asarray(rng.integers(0, 30, size=(n, r)), jnp.int32)
+    req = jnp.asarray(rng.integers(0, 6, size=(g, r)), jnp.int32)
+    count = jnp.asarray(rng.integers(0, 40, size=(g,)), jnp.int32)
+    order = np.asarray(ffd_order(req, jnp.ones((g,), bool)))
+    lim = jnp.asarray(rng.random((g,)) < 0.2)
+    plan = build_wavefront_plan(plan_mask, order)
+    ref = pack_groups(free, jnp.asarray(runtime_mask), req, count,
+                      jnp.asarray(order), lim)
+    got = pack_groups_wavefront_pallas(
+        free, jnp.asarray(runtime_mask), req, count, lim, plan,
+        tile=128, interpret=True)
+    _assert_same(ref, got)
+
+
+def test_schedule_honors_pallas_wavefront_backend(monkeypatch):
+    """KA_TPU_PACK=pallas routes the existing-nodes wavefront pack through
+    the Mosaic kernel — identical PackResult to the XLA route."""
+    from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+    from kubernetes_autoscaler_tpu.ops.pack import WavefrontCache
+    from kubernetes_autoscaler_tpu.ops.schedule import (
+        plan_wavefronts,
+        schedule_pending_on_existing,
+    )
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192,
+                             labels={"disk": "ssd" if i % 2 else "hdd"})
+             for i in range(12)]
+    pods = [build_test_pod(f"p{i}", cpu_milli=400 + 100 * (i % 4),
+                           mem_mib=256, owner_name=f"rs{i % 4}",
+                           node_selector={"disk": "ssd" if i % 2 else "hdd"})
+            for i in range(30)]
+    enc = encode_cluster(nodes, pods, node_bucket=16, group_bucket=16)
+    plan = plan_wavefronts(enc.nodes, enc.specs, WavefrontCache())
+
+    monkeypatch.setenv("KA_TPU_PACK", "xla")
+    ref = schedule_pending_on_existing(enc.nodes, enc.specs, enc.scheduled,
+                                       wavefront_plan=plan)
+    monkeypatch.setenv("KA_TPU_PACK", "pallas")
+    got = schedule_pending_on_existing(enc.nodes, enc.specs, enc.scheduled,
+                                       wavefront_plan=plan)
+    _assert_same(ref, got)
+
+
+def test_wavefront_pallas_inside_shard_map():
+    """The segmented kernel runs under shard_map (replicated specs, whole
+    node axis per shard) — the form the mesh path uses; byte-identical."""
+    from functools import partial
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from kubernetes_autoscaler_tpu.ops.pack import _SHARD_MAP_KW, _shard_map
+    from kubernetes_autoscaler_tpu.ops.pallas.pack_kernel import (
+        pack_groups_wavefront_pallas,
+    )
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs ≥2 devices (virtual CPU mesh)")
+    rng = np.random.default_rng(23)
+    free, mask, req, count, order, lim, plan = _wf_instance(rng, 160, 10)
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:2]), ("p",))
+
+    @partial(_shard_map, mesh=mesh,
+             in_specs=(P(), P(), P(), P(), P()),
+             out_specs=(P(), P(), P()), **_SHARD_MAP_KW)
+    def run(free_r, mask_r, req_r, count_r, lim_r):
+        res = pack_groups_wavefront_pallas(
+            free_r, mask_r, req_r, count_r, lim_r, plan,
+            tile=128, interpret=True)
+        return res.free_after, res.placed, res.scheduled
+
+    fa, placed, sched = run(free, mask, req, count, lim)
+    ref = pack_groups(free, mask, req, count, order, lim)
+    np.testing.assert_array_equal(np.asarray(placed), np.asarray(ref.placed))
+    np.testing.assert_array_equal(np.asarray(fa), np.asarray(ref.free_after))
+    np.testing.assert_array_equal(np.asarray(sched),
+                                  np.asarray(ref.scheduled))

@@ -89,6 +89,29 @@ def test_sharded_estimator_through_binpacking_estimator():
         got.estimate_all_groups(enc.specs, groups, cluster_size=64))
 
 
+@needs_mesh
+# nodes_parallel=1 puts all 8 shards on the pods axis (1 option per shard —
+# the strongest pallas-inside-shard_map shape) and stays in tier-1; the
+# mixed factorization runs in the CI pallas job (no slow filter)
+@pytest.mark.parametrize(
+    "nodes_parallel", [pytest.param(4, marks=pytest.mark.slow), 1])
+def test_sharded_estimator_honors_pack_backend(monkeypatch, nodes_parallel):
+    """KA_TPU_PACK is honored INSIDE shard_map: the mesh estimator runs the
+    fused Pallas kernel per shard (interpret mode on the CPU mesh) and must
+    be bit-identical to both the sharded scan formulation and the
+    single-device path — the scan-per-shard fallback is gone."""
+    mesh = make_mesh(8, nodes_parallel=nodes_parallel)
+    enc, groups = graft._small_world(n_nodes=64, n_nodegroups=8)
+
+    monkeypatch.setenv("KA_TPU_PACK", "xla")
+    ref_single = estimate_all(enc.specs, groups, DEFAULT_DIMS, 32)
+    ref_scan = estimate_all(enc.specs, groups, DEFAULT_DIMS, 32, mesh=mesh)
+    monkeypatch.setenv("KA_TPU_PACK", "pallas")
+    got = estimate_all(enc.specs, groups, DEFAULT_DIMS, 32, mesh=mesh)
+    _assert_estimates_equal(ref_single, got)
+    _assert_estimates_equal(ref_scan, got)
+
+
 # ---- vectorized limiter composition (no per-group host loop) ----
 
 
