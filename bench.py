@@ -86,6 +86,11 @@ TOTAL_BUDGET_S = float(os.environ.get("KA_TPU_BENCH_TOTAL_BUDGET_S", "180"))
 # dumped Perfetto file (CI asserts the overlap)
 _PIPELINE_TRACER = None
 
+# per-member tracers from one synchronized multi-tenant round (--tenants
+# with --trace): each carries the merged server-side `batch` span, so the
+# dumped Perfetto file shows the coalescing window (docs/SERVING.md)
+_TENANT_TRACERS: list = []
+
 
 class InitBudget:
     """Deadline shared by every init stage: `clamp(s)` bounds a stage's
@@ -218,6 +223,11 @@ def run_floor_child(metric: str, args) -> int:
         cmd += ["--trace", args.trace]
     if args.schedulable_world:
         cmd += ["--schedulable-world"]
+    if args.tenants:
+        cmd += ["--tenants", str(args.tenants),
+                "--tenant-rounds", str(args.tenant_rounds)]
+    if args.no_batching:
+        cmd += ["--no-batching"]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     print(f"[bench] degrading to CPU floor metric: {' '.join(cmd[1:])}",
@@ -369,6 +379,20 @@ def main() -> None:
                          "template — the all-schedulable shape CI uses to "
                          "assert the reason plane stays off the hot path "
                          "(reason_extraction_dispatches == 0)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="multi-tenant serving smoke: spin N synthetic "
+                         "tenants at mixed shapes against a localhost "
+                         "sidecar and measure clusters/sec through the "
+                         "batched (shape-class vmapped) dispatch, plus a "
+                         "serial --no-batching comparison run; prints a "
+                         "multi_tenant_clusters_per_sec JSON line "
+                         "(docs/SERVING.md)")
+    ap.add_argument("--no-batching", action="store_true",
+                    help="with --tenants: serve every request through the "
+                         "legacy serial per-tenant dispatch (the baseline "
+                         "the batched speedup is measured against)")
+    ap.add_argument("--tenant-rounds", type=int, default=40,
+                    help="scale-up sims per tenant in the measured window")
     ap.add_argument("--require-tpu", action="store_true",
                     help="disable the CPU-floor degradation: a missing/hung "
                          "TPU backend emits the null-value error JSON and "
@@ -803,6 +827,19 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
                 "vs_baseline": 0.0,
                 "error": f"{type(e).__name__}: {e}",
             }), flush=True)
+    if args.tenants:
+        try:
+            with_timeout(lambda: bench_multi_tenant(args), seconds=900)()
+        except Exception as e:
+            print(f"[bench] multi-tenant phase failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "multi_tenant_clusters_per_sec", "value": None,
+                "unit": "clusters/s", "tenants": args.tenants,
+                "error": f"{type(e).__name__}: {e}",
+            }), flush=True)
+
     if args.trace:
         try:
             with_timeout(lambda: bench_trace(args, args.trace), seconds=600)()
@@ -812,7 +849,7 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
             print(f"[bench] trace phase failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
 
-    if args.scaledown or args.e2e or args.trace:
+    if args.scaledown or args.e2e or args.trace or args.tenants:
         print(primary_line, flush=True)
 
 
@@ -925,6 +962,263 @@ def bench_scaledown(args) -> None:
     )
 
 
+def bench_multi_tenant(args) -> None:
+    """--tenants N: the fleet-serving smoke (docs/SERVING.md, ISSUE 7).
+
+    Spins N synthetic tenants at MIXED shapes (two shape classes) against a
+    localhost gRPC sidecar and storms scale-up sims from one thread per
+    tenant, rounds synchronized so requests genuinely coalesce. Measures:
+
+      clusters_per_sec           served sims / wall over the measured window
+      batch_occupancy_p50        member tenants per coalesced dispatch
+      shape_class_hit_rate       classifications landing in warm classes
+                                 during the window (must be 1.0 post-warmup)
+      recompiles_per_new_tenant  XLA compiles charged to tenants admitted
+                                 AFTER the warmup window (must be 0)
+      steady_state_recompiles    jit-cache growth across the window (0)
+
+    Unless --no-batching, a second serving stack with batching disabled runs
+    the same storm, and the JSON carries serial_clusters_per_sec +
+    speedup_vs_serial — the acceptance evidence that batching converts
+    single-cluster latency into fleet throughput. Never-null contract: the
+    whole phase runs on the CPU floor backend (tenant worlds are smoke-
+    scale); grpc/native-codec absence degrades to in-process service calls
+    with a stderr note."""
+    import threading
+
+    import jax
+
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimParams,
+        SimulatorService,
+    )
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    n_tenants = max(args.tenants, 1)
+    rounds = max(args.tenant_rounds, 2)
+    mib = 1024 * 1024
+    ngs = [
+        {"id": "ng-4c", "template": {"name": "t4", "capacity": {
+            "cpu": 4.0, "memory": 16384 * mib, "pods": 110}},
+         "max_new": 32, "price": 1.0},
+        {"id": "ng-8c", "template": {"name": "t8", "capacity": {
+            "cpu": 8.0, "memory": 32768 * mib, "pods": 110}},
+         "max_new": 32, "price": 1.9},
+    ]
+
+    def tenant_delta(i: int) -> bytes:
+        # mixed shapes: even tenants ~8 nodes (class n16...), odd tenants
+        # ~24 nodes (class n32...) — two classes, so windows split and the
+        # per-class batching is actually exercised
+        small = i % 2 == 0
+        n_nodes = 8 if small else 24
+        n_pods = 30 if small else 90
+        w = DeltaWriter()
+        for k in range(n_nodes):
+            w.upsert_node(build_test_node(
+                f"t{i}-n{k}", cpu_milli=2000 + 1000 * (k % 3),
+                mem_mib=8192, pods=110))
+        for k in range(n_pods):
+            w.upsert_pod(build_test_pod(
+                f"t{i}-p{k}", cpu_milli=300 + 100 * (i % 4), mem_mib=256,
+                owner_name=f"t{i}-rs{k % 3}",
+                node_name=f"t{i}-n{k % n_nodes}" if k % 3 == 0 else ""))
+        return w.payload()
+
+    try:
+        import grpc  # noqa: F401
+        have_grpc = True
+    except ImportError:
+        have_grpc = False
+        print("[bench-tenants] grpc unavailable — driving the service "
+              "in-process (same dispatch path, no wire hop)",
+              file=sys.stderr)
+
+    def run_serving(batching: bool) -> dict:
+        # lane width = expected per-class occupancy (tenants split over two
+        # shape classes): padding is wasted compute on the lane-serial CPU
+        # floor, so lanes match the real batch and window_max (the coalescing
+        # cap) closes the window early once every tenant's request arrived
+        svc = SimulatorService(
+            node_bucket=16, group_bucket=16,
+            batch_lanes=(min(max(n_tenants // 2, 1), 16) if batching else 0),
+            batch_window_ms=25.0, batch_window_max=n_tenants,
+            queue_depth=max(4 * n_tenants, 64))
+        server = None
+        try:
+            if have_grpc:
+                from kubernetes_autoscaler_tpu.sidecar.server import (
+                    SimulatorClient,
+                    make_grpc_server,
+                )
+
+                server, port = make_grpc_server(
+                    svc, port=0, max_workers=4 * n_tenants)
+                server.start()
+                clients = {}
+
+                def client(i):
+                    if i not in clients:
+                        clients[i] = SimulatorClient(port, tenant=f"t{i}")
+                    return clients[i]
+
+                for i in range(n_tenants):
+                    client(i)   # eager: the storm threads only read the dict
+
+                def up(i):
+                    return client(i).scale_up_sim(
+                        max_new_nodes=32, node_groups=ngs)
+
+                def down(i):
+                    return client(i).scale_down_sim(threshold=0.5)
+
+                def apply(i, payload):
+                    return client(i)._call_json("ApplyDelta", payload)
+            else:
+                def up(i):
+                    return svc.scale_up_sim(SimParams(
+                        max_new_nodes=32, node_groups=ngs), tenant=f"t{i}")
+
+                def down(i):
+                    return svc.scale_down_sim(SimParams(threshold=0.5),
+                                              tenant=f"t{i}")
+
+                def apply(i, payload):
+                    return svc.apply_delta(payload, tenant=f"t{i}")
+
+            for i in range(n_tenants):
+                ack = apply(i, tenant_delta(i))
+                assert not ack.get("error"), ack
+
+            barrier = threading.Barrier(n_tenants)
+            errors: list = []
+
+            def storm(k: int):
+                def worker(i):
+                    try:
+                        for _ in range(k):
+                            barrier.wait(60)
+                            up(i)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+                        raise
+                threads = [threading.Thread(target=worker, args=(i,))
+                           for i in range(n_tenants)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                if errors:
+                    raise errors[0]
+
+            storm(2)                      # warmup: compiles + caches warm
+            for i in range(n_tenants):
+                down(i)                   # warm the scale-down program too
+            svc.occupancies.clear()
+            hits0, misses0 = svc.ladder.hits, svc.ladder.misses
+            cache0 = svc._sim_cache_size()
+            t0 = time.perf_counter()
+            storm(rounds)
+            wall = time.perf_counter() - t0
+            steady_recompiles = svc._sim_cache_size() - cache0
+            d_hits = svc.ladder.hits - hits0
+            d_misses = svc.ladder.misses - misses0
+            hit_rate = (d_hits / (d_hits + d_misses)
+                        if d_hits + d_misses else 1.0)
+            occ = list(svc.occupancies)
+            # new-tenant segment: one fresh tenant per shape class, admitted
+            # AFTER warmup — the ≈0-recompile guarantee, measured
+            cache1 = svc._sim_cache_size()
+            for j in (n_tenants, n_tenants + 1):
+                ack = apply(j, tenant_delta(j))
+                assert not ack.get("error"), ack
+                up(j)
+                down(j)
+            new_tenant_recompiles = (svc._sim_cache_size() - cache1) / 2.0
+            if batching and getattr(args, "trace", None):
+                # one extra synchronized round under per-member tracers:
+                # the merged server spans put each member's `batch` span
+                # (shape class, occupancy, member ids) on its timeline, and
+                # bench_trace records these tracers into the Perfetto dump
+                # so the coalescing window is visible there. Four members =
+                # two per-class batches of occupancy 2 at mixed shapes.
+                from kubernetes_autoscaler_tpu.metrics import trace as _tr
+
+                n_traced = min(n_tenants, 4)
+                tbar = threading.Barrier(n_traced)
+
+                def traced(i):
+                    t = _tr.Tracer()
+                    with _tr.active(t):
+                        with t.span(f"tenant-{i}", cat="bench"):
+                            tbar.wait(60)
+                            up(i)
+                    _TENANT_TRACERS.append(t)
+
+                tthreads = [threading.Thread(target=traced, args=(i,))
+                            for i in range(n_traced)]
+                for t in tthreads:
+                    t.start()
+                for t in tthreads:
+                    t.join()
+            return {
+                "clusters_per_sec": n_tenants * rounds / wall,
+                "wall_s": wall,
+                "occupancy_p50": (float(np.percentile(occ, 50))
+                                  if occ else None),
+                "hit_rate": hit_rate,
+                "steady_recompiles": steady_recompiles,
+                "recompiles_per_new_tenant": new_tenant_recompiles,
+                "stats": svc.batch_stats(),
+            }
+        finally:
+            if server is not None:
+                server.stop(None)
+            svc.close()
+
+    batching = not args.no_batching
+    primary = run_serving(batching=batching)
+    serial = None
+    if batching:
+        serial = run_serving(batching=False)
+    print(f"[bench-tenants] tenants={n_tenants} rounds={rounds} "
+          f"batching={batching} cps={primary['clusters_per_sec']:.1f} "
+          f"occupancy_p50={primary['occupancy_p50']} "
+          f"hit_rate={primary['hit_rate']:.3f} "
+          f"new_tenant_recompiles={primary['recompiles_per_new_tenant']} "
+          f"stats={json.dumps(primary['stats'])}"
+          + (f" serial_cps={serial['clusters_per_sec']:.1f}"
+             f" speedup={primary['clusters_per_sec'] / serial['clusters_per_sec']:.2f}x"
+             if serial else ""),
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "multi_tenant_clusters_per_sec",
+        "value": round(primary["clusters_per_sec"], 2),
+        "unit": "clusters/s",
+        "tenants": n_tenants,
+        "rounds": rounds,
+        "batching": batching,
+        # same provenance contract as the primary line: report the platform
+        # the sims actually ran on, never assume tpu (an explicit
+        # JAX_PLATFORMS=cpu run must not record cpu numbers as tpu evidence)
+        "backend": ("cpu-floor" if args.smoke or args.floor_for
+                    else jax.default_backend()),
+        "transport": "grpc" if have_grpc else "in-process",
+        "batch_occupancy_p50": primary["occupancy_p50"],
+        "shape_class_hit_rate": round(primary["hit_rate"], 4),
+        "recompiles_per_new_tenant": primary["recompiles_per_new_tenant"],
+        "steady_state_recompiles": primary["steady_recompiles"],
+        **({"serial_clusters_per_sec": round(serial["clusters_per_sec"], 2),
+            "speedup_vs_serial": round(primary["clusters_per_sec"]
+                                       / serial["clusters_per_sec"], 2)}
+           if serial else {}),
+    }), flush=True)
+
+
 def bench_trace(args, path: str) -> None:
     """Flight-recorder smoke (docs/OBSERVABILITY.md): a few RunOnce loops at
     toy scale with the tracer on, dumped as ONE Perfetto file. The pending
@@ -984,6 +1278,11 @@ def bench_trace(args, path: str) -> None:
         # the next loop's encode/dispatch nested inside) join the dump so
         # the overlap is assertable on the one Perfetto file
         a.flight_recorder.record(_PIPELINE_TRACER)
+    for t in _TENANT_TRACERS:
+        # the multi-tenant traced round (--tenants): each member timeline
+        # carries its merged `batch` span, so the dump shows the
+        # coalescing window across tenants
+        a.flight_recorder.record(t)
     out = a.flight_recorder.dump(path)
     doc = a.flight_recorder.to_chrome_trace()
     by_cat: dict = {}

@@ -35,6 +35,18 @@ class Counter:
         with self._lock:
             return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def zero_matching(self, **labels) -> None:
+        """Stale-label zeroing (the reason-plane convention from the status
+        layer): every series whose label set CONTAINS `labels` resets to 0 —
+        e.g. a dropped tenant's rpc_total{tenant=...} series must not keep
+        claiming traffic for a tenant that no longer exists. A counter reset
+        to 0 is well-formed prometheus (clients handle counter resets)."""
+        items = set(labels.items())
+        with self._lock:
+            for key in self._values:
+                if items <= set(key):
+                    self._values[key] = 0.0
+
 
 @dataclass
 class Gauge:
@@ -76,6 +88,16 @@ class Histogram:
     def count(self, **labels) -> int:
         with self._lock:
             return sum(self._counts.get(tuple(sorted(labels.items())), []))
+
+    def zero_matching(self, **labels) -> None:
+        """Stale-label zeroing: bucket counts and sums of every series whose
+        label set contains `labels` reset to zero (see Counter)."""
+        items = set(labels.items())
+        with self._lock:
+            for key in self._counts:
+                if items <= set(key):
+                    self._counts[key] = [0] * (len(self.buckets) + 1)
+                    self._sums[key] = 0.0
 
 
 class Registry:

@@ -154,6 +154,22 @@ class Tracer:
         """`with tracer.span("confirm", cat="planner"): ...`"""
         return Tracer._SpanCtx(self, self.begin(name, cat, **args))
 
+    def add_span(self, name: str, cat: str = "",
+                 begin_abs_ns: int | None = None, dur_ns: int = 0,
+                 **args) -> None:
+        """Append an already-timed CLOSED span recorded by another thread —
+        e.g. the sidecar's batch scheduler measured a coalesced dispatch
+        window and each member RPC's handler tracer adopts it. Timestamps
+        are absolute `perf_counter_ns` values (comparable across threads of
+        one process); they are rebased onto this tracer's epoch. The span
+        does not touch the open-span stack, so it can be added mid-RPC."""
+        if len(self.spans) >= MAX_SPANS_PER_TRACE:
+            self.dropped += 1
+            return
+        t0 = (time.perf_counter_ns() if begin_abs_ns is None else begin_abs_ns)
+        self.spans.append([name, cat, t0 - self._t0_ns, max(int(dur_ns), 0),
+                           len(self._stack), args or None])
+
     def annotate(self, **args) -> None:
         """Merge attributes into the innermost open span (root span if none
         is open)."""

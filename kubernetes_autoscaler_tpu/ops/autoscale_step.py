@@ -129,6 +129,56 @@ def scale_down_sim(
     return ScaleDownSim(eligible=eligible, removal=removal, utilization=util)
 
 
+@partial(jax.jit, static_argnames=("dims", "max_new_nodes", "strategy"))
+def scale_up_sim_batch(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors,
+    groups: NodeGroupTensors,
+    dims: Dims,
+    max_new_nodes: int = 256,
+    strategy: str = "least-waste",
+) -> ScaleUpSim:
+    """`scale_up_sim` vmapped over a leading tenant axis — the multi-cluster
+    serving dispatch (docs/SERVING.md). Every input tensor gains axis 0 of
+    size B (one lane per tenant world, stacked by sidecar/batch.py); the
+    output is the SAME pytree with every leaf batched. Lane i is
+    bit-identical to a serial `scale_up_sim` call on lane i's world
+    (tests/test_batched_sim.py) — batching is a dispatch-shape change only.
+
+    The per-lane body is the unsharded single-device path (no mesh, no
+    wavefront plan, no constraint planes): tenants with a constraint overlay
+    are dispatched serially by the sidecar instead of batched."""
+    def one(nt, gt, pt, gr):
+        return scale_up_sim.__wrapped__(
+            nt, gt, pt, gr, dims, max_new_nodes, strategy,
+            None, False, None, None)
+
+    return jax.vmap(one)(nodes, specs, scheduled, groups)
+
+
+@partial(jax.jit, static_argnames=("max_pods_per_node", "chunk", "max_zones"))
+def scale_down_sim_batch(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors,
+    thresholds: jax.Array,       # f32[B] per-tenant utilization threshold
+    max_pods_per_node: int = 128,
+    chunk: int = 32,
+    max_zones: int = 16,
+) -> ScaleDownSim:
+    """`scale_down_sim` vmapped over a leading tenant axis. The utilization
+    threshold is a TRACED per-lane scalar (f32[B]) — tenants with different
+    thresholds share one compiled program, so threshold knobs never fragment
+    the batch. Lane-exact vs serial, like `scale_up_sim_batch`."""
+    def one(nt, gt, pt, th):
+        return scale_down_sim.__wrapped__(
+            nt, gt, pt, th, max_pods_per_node, chunk,
+            None, max_zones, False)
+
+    return jax.vmap(one)(nodes, specs, scheduled, thresholds)
+
+
 @partial(jax.jit, static_argnames=("dims", "max_new_nodes", "strategy",
                                    "max_pods_per_node", "with_constraints"))
 def run_once_sim(

@@ -1,0 +1,276 @@
+"""Admission control for the multi-tenant sidecar: bounded queue, per-tenant
+fairness, and the pipelined coalescing-window scheduler.
+
+Three pieces (docs/SERVING.md):
+
+  AdmissionQueue   a bounded, tenant-aware queue. `submit` raises QueueFull
+                   (→ gRPC RESOURCE_EXHAUSTED + retry-after) once the depth
+                   bound is hit — the service sheds load explicitly instead
+                   of wedging behind an unbounded backlog. Window formation
+                   is ROUND-ROBIN ACROSS TENANTS, not FIFO across all
+                   requests: each cycle takes at most one ticket per tenant,
+                   so a chatty tenant fills only the lanes quiet tenants
+                   left unused and can never starve them
+                   (tests/test_admission.py pins this).
+  Ticket           one queued simulation request: the prepared per-lane
+                   payload, a completion event the handler thread waits on,
+                   and the batch_info the observability layer turns into a
+                   `batch` span.
+  BatchScheduler   the single dispatch thread. Collects a coalescing window
+                   (first arrival, then up to `window_s` for concurrent
+                   requests to join), splits it by batch-compatibility key,
+                   and PIPELINES windows: window k's device results are
+                   harvested (ops/hostfetch.AsyncFetch.get) only after
+                   window k+1's upload+dispatch is in flight, so the
+                   device→host fetch of one window hides under the next
+                   window's encode/dispatch — the serving-side double
+                   buffer, same mechanism as PR 6's bench loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+class QueueFull(Exception):
+    """Admission bound hit: reject now, retry after `retry_after_ms`.
+
+    Mapped to gRPC RESOURCE_EXHAUSTED by the server handler. The request was
+    NOT enqueued — retrying it later is always safe (nothing partial
+    happened), which tests/test_admission.py proves end to end."""
+
+    def __init__(self, depth: int | None, retry_after_ms: int,
+                 what: str = "admission queue"):
+        where = (f"{depth} queued" if isinstance(depth, int)
+                 else "server backpressure")
+        super().__init__(
+            f"{what} full ({where}); retry in {retry_after_ms}ms")
+        self.depth = depth
+        self.retry_after_ms = retry_after_ms
+
+
+@dataclass
+class Ticket:
+    tenant: str
+    kind: str                    # "up" | "down"
+    key: tuple                   # batch-compatibility key (shape class + statics)
+    lane: object                 # prepared per-lane input (sidecar/batch.py)
+    fp: tuple | None = None      # world fingerprint (stack-cache key part)
+    trace_id: str | None = None
+    result: object = None
+    error: Exception | None = None
+    batch_info: dict | None = None
+    done: threading.Event = field(default_factory=threading.Event)
+    enqueued_ns: int = field(default_factory=time.perf_counter_ns)
+
+    def wait(self, timeout_s: float = 60.0):
+        if not self.done.wait(timeout_s):
+            raise TimeoutError(
+                f"{self.kind} ticket for tenant {self.tenant!r} not served "
+                f"within {timeout_s:.0f}s (scheduler wedged?)")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    def resolve(self, result=None, error: Exception | None = None,
+                batch_info: dict | None = None) -> None:
+        self.result = result
+        self.error = error
+        self.batch_info = batch_info
+        self.done.set()
+
+
+class AdmissionQueue:
+    """Bounded queue with per-tenant sub-queues and a persistent round-robin
+    cursor (fairness holds ACROSS windows too: the tenant served last in one
+    window is first only when its turn comes around again)."""
+
+    def __init__(self, max_depth: int = 128, retry_after_ms: int = 20):
+        self.max_depth = max_depth
+        self.retry_after_ms = retry_after_ms
+        self._cond = threading.Condition()
+        self._by_tenant: dict[str, deque[Ticket]] = {}
+        self._ring: list[str] = []       # tenant round-robin order
+        self._cursor = 0
+        self.depth = 0
+        self.submitted = 0
+        self.rejected = 0
+
+    def submit(self, t: Ticket) -> None:
+        with self._cond:
+            if self.depth >= self.max_depth:
+                self.rejected += 1
+                raise QueueFull(self.depth, self.retry_after_ms)
+            dq = self._by_tenant.get(t.tenant)
+            if dq is None:
+                dq = deque()
+                self._by_tenant[t.tenant] = dq
+                self._ring.append(t.tenant)
+            dq.append(t)
+            self.depth += 1
+            self.submitted += 1
+            self._cond.notify_all()
+
+    def collect(self, max_lanes: int, wait_s: float,
+                coalesce_s: float) -> list[Ticket]:
+        """One coalescing window: block up to `wait_s` for a first ticket,
+        then hold the window open `coalesce_s` (or until `max_lanes` tickets
+        are waiting) so concurrent in-flight requests coalesce, then pop
+        round-robin. Empty list = idle timeout."""
+        with self._cond:
+            deadline = time.monotonic() + wait_s
+            while self.depth == 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(remaining)
+            cdeadline = time.monotonic() + coalesce_s
+            while self.depth < max_lanes:
+                remaining = cdeadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            return self._pop_round_robin(max_lanes)
+
+    def _pop_round_robin(self, max_lanes: int) -> list[Ticket]:
+        out: list[Ticket] = []
+        while len(out) < max_lanes and self.depth > 0:
+            # one full cycle over the ring = at most one ticket per tenant
+            took_any = False
+            n = len(self._ring)
+            for _ in range(n):
+                if len(out) >= max_lanes:
+                    break
+                tenant = self._ring[self._cursor % len(self._ring)]
+                self._cursor = (self._cursor + 1) % len(self._ring)
+                dq = self._by_tenant.get(tenant)
+                if dq:
+                    out.append(dq.popleft())
+                    self.depth -= 1
+                    took_any = True
+            if not took_any:
+                break
+        # prune empty tenants so the ring stays proportional to ACTIVE
+        # tenants (the cursor re-anchors; fairness is per-cycle, unaffected)
+        if any(not dq for dq in self._by_tenant.values()):
+            live = [t for t in self._ring if self._by_tenant.get(t)]
+            for t in list(self._by_tenant):
+                if not self._by_tenant[t]:
+                    del self._by_tenant[t]
+            self._cursor = 0 if not live else self._cursor % len(live)
+            self._ring = live
+        return out
+
+    def drain(self) -> list[Ticket]:
+        with self._cond:
+            out = [t for dq in self._by_tenant.values() for t in dq]
+            self._by_tenant.clear()
+            self._ring.clear()
+            self.depth = 0
+            return out
+
+
+def split_by_key(window: list[Ticket]) -> list[list[Ticket]]:
+    """Group a window's tickets into batch-compatible runs (same vmapped
+    program: kind + shape class + static params), preserving first-seen
+    order so fairness inside the window survives the split."""
+    groups: dict[tuple, list[Ticket]] = {}
+    order: list[tuple] = []
+    for t in window:
+        if t.key not in groups:
+            groups[t.key] = []
+            order.append(t.key)
+        groups[t.key].append(t)
+    return [groups[k] for k in order]
+
+
+class BatchScheduler:
+    """The dispatch thread. `dispatch(batch)` (the service's stacked-vmap
+    issue path) must return an in-flight handle with a `.harvest()` method
+    that blocks for the device→host fetch and resolves every ticket; the
+    scheduler calls it one window LATE to overlap fetch with the next
+    window's dispatch."""
+
+    def __init__(self, queue: AdmissionQueue, dispatch, lanes: int,
+                 window_s: float = 0.002, idle_wait_s: float = 0.05,
+                 window_max: int | None = None):
+        self.queue = queue
+        self.dispatch = dispatch
+        self.lanes = max(int(lanes), 1)
+        # the window collects MORE than one dispatch's lanes (a window mixes
+        # batch keys; each key run then chunks into lane-width dispatches) —
+        # decoupling the coalescing cap from the compiled lane width lets the
+        # lane width stay small (padding is wasted compute on lane-serial
+        # backends) without shrinking the coalescing opportunity
+        self.window_max = max(int(window_max or 4 * self.lanes), self.lanes)
+        self.window_s = window_s
+        self.idle_wait_s = idle_wait_s
+        self.windows = 0
+        self.batches = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name="katpu-batch-scheduler", daemon=True)
+
+    def start(self) -> "BatchScheduler":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout_s)
+        err = RuntimeError("sidecar batch scheduler stopped")
+        for t in self.queue.drain():
+            t.resolve(error=err)
+
+    def _serve(self) -> None:
+        pending = None   # previous batch, fetch still in flight
+        while not self._stop.is_set():
+            # with a fetch in flight, poll instead of sleeping: an empty
+            # queue means there is nothing to overlap the fetch with, and
+            # the waiters of the pending batch may be exactly what the next
+            # request is blocked on (request-response clients) — sleeping
+            # idle_wait_s here adds a dead stall to every round trip
+            window = self.queue.collect(
+                self.window_max,
+                wait_s=0.0 if pending is not None else self.idle_wait_s,
+                coalesce_s=self.window_s)
+            if not window:
+                # idle: nothing to overlap the pending fetch with — harvest
+                if pending is not None:
+                    self._harvest(pending)
+                    pending = None
+                continue
+            self.windows += 1
+            for run in split_by_key(window):
+                # canonical member order: the round-robin cursor rotates the
+                # pop order window to window, but lane order is irrelevant to
+                # latency (a batch completes together) and a STABLE order
+                # keys the server's stacked-pytree cache — steady-state
+                # windows with the same members must re-hit, not restack
+                run.sort(key=lambda t: (t.tenant, t.enqueued_ns))
+                for lo in range(0, len(run), self.lanes):
+                    batch = run[lo:lo + self.lanes]
+                    self.batches += 1
+                    try:
+                        inflight = self.dispatch(batch)
+                    except Exception as e:  # noqa: BLE001 — fail the batch, keep serving
+                        for t in batch:
+                            t.resolve(error=e)
+                        continue
+                    # pipeline point: THIS batch's upload+dispatch is now in
+                    # flight; only now pay the previous batch's fetch wait
+                    if pending is not None:
+                        self._harvest(pending)
+                    pending = inflight
+        if pending is not None:
+            self._harvest(pending)
+
+    @staticmethod
+    def _harvest(inflight) -> None:
+        try:
+            inflight.harvest()
+        except Exception:  # noqa: BLE001 — harvest resolves tickets itself
+            pass

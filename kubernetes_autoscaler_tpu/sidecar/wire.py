@@ -33,6 +33,17 @@ MAGIC = b"KAD1"
 # spans back in the RESPONSE json ("trace" field), also off-wire-format.
 TRACE_ID_HEADER = "katpu-trace-id"
 
+# Tenant identity for the multi-tenant serving sidecar (docs/SERVING.md)
+# rides request metadata exactly like the trace id — NEVER the KAD1 bytes,
+# so single-tenant encoders (the committed goldens, the Go shim) are
+# untouched. Absent/empty header = the default tenant: the pre-multi-tenant
+# wire behavior, byte-for-byte.
+TENANT_ID_HEADER = "katpu-tenant-id"
+
+# Backpressure: a RESOURCE_EXHAUSTED rejection carries its retry hint in
+# trailing metadata under this key (milliseconds, decimal string).
+RETRY_AFTER_MS_HEADER = "katpu-retry-after-ms"
+
 UPSERT_NODE, DELETE_NODE, UPSERT_POD, DELETE_POD = 1, 2, 3, 4
 
 _EFFECTS = {NO_SCHEDULE: 0, NO_EXECUTE: 1}

@@ -1,0 +1,175 @@
+"""Batched-vmapped ≡ per-tenant-serial: the multi-cluster dispatch contract.
+
+The serving tentpole (docs/SERVING.md) claims batching is a DISPATCH-SHAPE
+change only: lane i of `scale_up_sim_batch` / `scale_down_sim_batch` must be
+bit-for-bit the serial `scale_up_sim` / `scale_down_sim` result on lane i's
+world — across mixed shape classes, occupancy padding (duplicated lanes) and
+tenant order permutations. Everything here runs on encode_cluster worlds, no
+native codec or gRPC needed."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS  # noqa: E402
+from kubernetes_autoscaler_tpu.models.encode import (  # noqa: E402
+    encode_cluster,
+    encode_node_groups,
+)
+from kubernetes_autoscaler_tpu.ops.autoscale_step import (  # noqa: E402
+    scale_down_sim,
+    scale_down_sim_batch,
+    scale_up_sim,
+    scale_up_sim_batch,
+)
+from kubernetes_autoscaler_tpu.sidecar.batch import pad_lanes  # noqa: E402
+from kubernetes_autoscaler_tpu.utils.testing import (  # noqa: E402
+    build_test_node,
+    build_test_pod,
+)
+
+
+def make_world(seed: int, n_nodes: int, n_pods: int, node_bucket: int = 16,
+               group_bucket: int = 16, pod_bucket: int = 64):
+    """A randomized small world + 3 expansion templates, padded to the given
+    buckets (one bucket triple = one shape class)."""
+    rng = np.random.RandomState(seed)
+    nodes = [
+        build_test_node(
+            f"n{i}", cpu_milli=int(rng.choice([4000, 8000, 16000])),
+            mem_mib=16384, pods=110,
+            labels={"pool": "a" if i % 2 else "b"})
+        for i in range(n_nodes)
+    ]
+    pods = [
+        build_test_pod(
+            f"p{i}", cpu_milli=int(rng.choice([250, 500, 1000])),
+            mem_mib=int(rng.choice([256, 512])),
+            owner_name=f"rs{i % 5}",
+            node_name=(f"n{i % n_nodes}" if i % 3 == 0 else None))
+        for i in range(n_pods)
+    ]
+    enc = encode_cluster(nodes, pods, node_bucket=node_bucket,
+                         group_bucket=group_bucket, pod_bucket=pod_bucket)
+    tmpl = [(build_test_node(f"t{k}", cpu_milli=8000, mem_mib=32768,
+                             pods=110), 50, 1.0 + k) for k in range(3)]
+    groups = encode_node_groups(tmpl, enc.registry, enc.zone_table, bucket=4)
+    return enc, groups
+
+
+def stack(trees):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def assert_lane_equal(serial_out, batched_out, lane: int, ctx=""):
+    ls = jax.tree_util.tree_leaves_with_path(serial_out)
+    lb = jax.tree_util.tree_leaves_with_path(batched_out)
+    assert len(ls) == len(lb)
+    for (path, a), (_, b) in zip(ls, lb):
+        a = np.asarray(a)
+        b = np.asarray(b)[lane]
+        assert a.dtype == b.dtype and a.shape == b.shape, (ctx, path)
+        np.testing.assert_array_equal(a, b, err_msg=f"{ctx} lane={lane} {path}")
+
+
+def batch_inputs(worlds):
+    nt = stack([w[0].nodes for w in worlds])
+    gt = stack([w[0].specs for w in worlds])
+    pt = stack([w[0].scheduled for w in worlds])
+    gr = stack([w[1] for w in worlds])
+    return nt, gt, pt, gr
+
+
+WORLDS = [make_world(s, n_nodes=6 + s, n_pods=30 + 7 * s) for s in range(4)]
+
+
+def test_scale_up_batched_equals_serial_bit_for_bit():
+    nt, gt, pt, gr = batch_inputs(WORLDS)
+    out_b = scale_up_sim_batch(nt, gt, pt, gr, DEFAULT_DIMS, 16, "least-waste")
+    for i, (enc, groups) in enumerate(WORLDS):
+        out_s = scale_up_sim(enc.nodes, enc.specs, enc.scheduled, groups,
+                             DEFAULT_DIMS, 16, "least-waste")
+        assert_lane_equal(out_s, out_b, i, "scale_up")
+
+
+def test_scale_down_batched_equals_serial_bit_for_bit():
+    nt, gt, pt, _ = batch_inputs(WORLDS)
+    thresholds = jnp.asarray([0.5, 0.35, 0.65, 0.5], jnp.float32)
+    out_b = scale_down_sim_batch(nt, gt, pt, thresholds,
+                                 max_pods_per_node=16, chunk=8, max_zones=16)
+    for i, (enc, _) in enumerate(WORLDS):
+        out_s = scale_down_sim(enc.nodes, enc.specs, enc.scheduled,
+                               float(thresholds[i]), 16, 8, None, 16, False)
+        assert_lane_equal(out_s, out_b, i, "scale_down")
+
+
+def test_batched_is_order_independent():
+    """Tenant order inside the batch cannot change any lane's verdicts —
+    permuting lanes permutes outputs, bit-for-bit."""
+    nt, gt, pt, gr = batch_inputs(WORLDS)
+    out_a = scale_up_sim_batch(nt, gt, pt, gr, DEFAULT_DIMS, 16, "least-waste")
+    perm = [2, 0, 3, 1]
+    nt2, gt2, pt2, gr2 = batch_inputs([WORLDS[i] for i in perm])
+    out_b = scale_up_sim_batch(nt2, gt2, pt2, gr2, DEFAULT_DIMS, 16,
+                               "least-waste")
+    for new_lane, old_lane in enumerate(perm):
+        la = jax.tree_util.tree_leaves(out_a)
+        lb = jax.tree_util.tree_leaves(out_b)
+        for a, b in zip(la, lb):
+            np.testing.assert_array_equal(np.asarray(a)[old_lane],
+                                          np.asarray(b)[new_lane])
+
+
+def test_padded_lanes_do_not_perturb_members():
+    """Occupancy padding (sidecar/batch.pad_lanes duplicates lane 0) must
+    leave member lanes bit-identical to a full-occupancy batch of the same
+    worlds — padded lanes are dead weight, not neighbors that interact."""
+    members = WORLDS[:2]
+    padded = pad_lanes(list(members), 4)
+    assert len(padded) == 4 and padded[2] is padded[0]
+    nt, gt, pt, gr = batch_inputs(padded)
+    out_p = scale_up_sim_batch(nt, gt, pt, gr, DEFAULT_DIMS, 16, "least-waste")
+    for i, (enc, groups) in enumerate(members):
+        out_s = scale_up_sim(enc.nodes, enc.specs, enc.scheduled, groups,
+                             DEFAULT_DIMS, 16, "least-waste")
+        assert_lane_equal(out_s, out_p, i, "padded")
+    # and the pad lanes replicate lane 0's result exactly
+    for leaf in jax.tree_util.tree_leaves(out_p):
+        leaf = np.asarray(leaf)
+        np.testing.assert_array_equal(leaf[2], leaf[0])
+        np.testing.assert_array_equal(leaf[3], leaf[0])
+
+
+def test_mixed_shape_classes_batch_per_class():
+    """Two shape classes (different padded buckets) each batch internally
+    and match their serial results — the per-class dispatch the admission
+    scheduler performs after split_by_key."""
+    small = [make_world(s, 5, 20, node_bucket=8, group_bucket=8,
+                        pod_bucket=32) for s in range(2)]
+    big = [make_world(10 + s, 20, 90, node_bucket=32, group_bucket=16,
+                      pod_bucket=128) for s in range(2)]
+    for cls in (small, big):
+        nt, gt, pt, gr = batch_inputs(cls)
+        out_b = scale_up_sim_batch(nt, gt, pt, gr, DEFAULT_DIMS, 16,
+                                   "least-waste")
+        for i, (enc, groups) in enumerate(cls):
+            out_s = scale_up_sim(enc.nodes, enc.specs, enc.scheduled, groups,
+                                 DEFAULT_DIMS, 16, "least-waste")
+            assert_lane_equal(out_s, out_b, i, "mixed-class")
+
+
+def test_fuzzed_worlds_many_seeds():
+    """Wider fuzz at one shape class: every seed's lane stays bit-exact.
+    Sizes stay inside one (16, 16, 64) bucket triple so the lanes stack —
+    exactly the class membership the ladder enforces in production."""
+    worlds = [make_world(100 + s, n_nodes=4 + (s % 9), n_pods=10 + 5 * s,
+                         group_bucket=32)
+              for s in range(8)]
+    nt, gt, pt, gr = batch_inputs(worlds)
+    out_b = scale_up_sim_batch(nt, gt, pt, gr, DEFAULT_DIMS, 16, "least-waste")
+    for i, (enc, groups) in enumerate(worlds):
+        out_s = scale_up_sim(enc.nodes, enc.specs, enc.scheduled, groups,
+                             DEFAULT_DIMS, 16, "least-waste")
+        assert_lane_equal(out_s, out_b, i, f"fuzz seed={100 + i}")

@@ -1,0 +1,314 @@
+"""Multi-tenant serving sidecar end to end: batched ≡ serial responses,
+tenant isolation, backpressure over gRPC, the recompile guarantee, tenant-
+labelled metrics with stale zeroing, and the batch span on the trace."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_autoscaler_tpu.sidecar import native_api
+
+pytestmark = pytest.mark.skipif(
+    not native_api.available(), reason="native codec not buildable"
+)
+
+MIB = 1024 * 1024
+
+NGS = [
+    {"id": "ng-big",
+     "template": {"name": "t", "capacity": {"cpu": 4.0,
+                                            "memory": 8192 * MIB,
+                                            "pods": 110}},
+     "max_new": 10, "price": 1.0},
+    {"id": "ng-small",
+     "template": {"name": "t2", "capacity": {"cpu": 2.0,
+                                             "memory": 4096 * MIB,
+                                             "pods": 110}},
+     "max_new": 10, "price": 0.5},
+]
+
+
+def tenant_delta(seed: int, n_nodes: int = 2, n_pods: int = 6):
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    w = DeltaWriter()
+    for i in range(n_nodes):
+        w.upsert_node(build_test_node(
+            f"n{seed}-{i}", cpu_milli=2000 + 1000 * (i % 2), mem_mib=4096))
+    for i in range(n_pods):
+        w.upsert_pod(build_test_pod(
+            f"p{seed}-{i}", cpu_milli=400 + 100 * (seed % 3), mem_mib=256,
+            owner_name=f"rs{seed}"))
+    return w
+
+
+@pytest.fixture(scope="module")
+def batched():
+    grpc = pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimulatorClient,
+        SimulatorService,
+        make_grpc_server,
+    )
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16,
+                           batch_lanes=4, batch_window_ms=5.0)
+    server, port = make_grpc_server(svc, port=0)
+    server.start()
+    clients = {t: SimulatorClient(port, tenant=t) for t in ("a", "b", "c")}
+    for i, (t, c) in enumerate(sorted(clients.items())):
+        ack = c.apply_delta(tenant_delta(i))
+        assert ack["error"] == "" and ack["version"] == 1
+    yield svc, clients, port
+    server.stop(None)
+    svc.close()
+
+
+def serial_reference(seed: int, params_up=None, params_down=None):
+    """The per-tenant serial dispatch the batched path must match."""
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimParams,
+        SimulatorService,
+    )
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16)
+    assert svc.apply_delta(tenant_delta(seed).payload())["error"] == ""
+    up = svc.scale_up_sim(SimParams(**(params_up or {
+        "max_new_nodes": 16, "node_groups": NGS})))
+    down = svc.scale_down_sim(SimParams(**(params_down or {
+        "threshold": 0.5})))
+    return up, down
+
+
+def test_batched_responses_equal_serial_per_tenant(batched):
+    """Concurrent tenants through the coalescing window get EXACTLY the
+    response a dedicated single-tenant serial sidecar would give them —
+    tenant isolation and batching transparency in one assertion."""
+    svc, clients, _ = batched
+    results = {}
+
+    def run(t):
+        c = clients[t]
+        results[t] = (c.scale_up_sim(max_new_nodes=16, node_groups=NGS),
+                      c.scale_down_sim(threshold=0.5))
+
+    threads = [threading.Thread(target=run, args=(t,)) for t in clients]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    for i, t in enumerate(sorted(clients)):
+        up, down = results[t]
+        ref_up, ref_down = serial_reference(i)
+        assert up == ref_up, t
+        assert down == ref_down, t
+
+
+def test_occupancy_and_dispatch_metrics_recorded(batched):
+    svc, clients, _ = batched
+    stats = svc.batch_stats()
+    assert stats["batches"] >= 1
+    assert stats["occupancy_p50"] is not None
+    assert svc.registry.counter("batched_dispatches_total").value(
+        kind="up") >= 1
+
+
+def test_new_tenant_joining_warm_class_recompiles_nothing(batched):
+    """The headline guarantee: tenant 'd' matches the already-served shape
+    class, so its first dispatch compiles zero XLA programs."""
+    svc, clients, port = batched
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorClient
+
+    c = SimulatorClient(port, tenant="d")
+    assert c.apply_delta(tenant_delta(3))["error"] == ""
+    c.scale_down_sim(threshold=0.5)
+    assert svc.registry.gauge("recompiles_per_new_tenant").value() == 0.0
+    c.scale_up_sim(max_new_nodes=16, node_groups=NGS)
+    assert svc.registry.gauge("recompiles_per_new_tenant").value() == 0.0
+    assert svc.ladder.hit_rate() > 0.5
+
+
+def test_tenant_label_on_rpc_metrics_and_stale_zeroing(batched):
+    """rpc_total/rpc_duration_seconds carry the tenant label; dropping a
+    tenant zeroes its series (the PR 4 stale-label convention) while other
+    tenants' series keep counting."""
+    svc, clients, _ = batched
+    before = svc.registry.counter("rpc_total").value(
+        method="ScaleDownSim", tenant="a")
+    clients["a"].scale_down_sim(threshold=0.5)
+    assert svc.registry.counter("rpc_total").value(
+        method="ScaleDownSim", tenant="a") == before + 1
+    text = clients["a"].metricz()
+    assert 'katpu_sidecar_rpc_total{method="ScaleDownSim",tenant="a"}' in text
+    # drop an auxiliary tenant and verify zeroing
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorClient
+
+    svc._tenant("ephemeral")
+    svc.registry.counter("rpc_total").inc(method="ScaleDownSim",
+                                          tenant="ephemeral")
+    assert svc.drop_tenant("ephemeral")
+    assert svc.registry.counter("rpc_total").value(
+        method="ScaleDownSim", tenant="ephemeral") == 0.0
+    assert svc.registry.counter("rpc_total").value(
+        method="ScaleDownSim", tenant="a") == before + 1
+
+
+def test_batch_span_links_members_on_the_trace(batched):
+    """A traced member RPC's merged server spans include the `batch` span
+    (shape class, occupancy, member tenant/trace ids) and the RPC span is
+    annotated with the batch id — the Perfetto view of the coalescing
+    window."""
+    svc, clients, _ = batched
+    from kubernetes_autoscaler_tpu.metrics import trace
+
+    tracer = trace.Tracer()
+    with trace.active(tracer):
+        clients["b"].scale_down_sim(threshold=0.5)
+    snap = tracer.snapshot()
+    assert snap["remote"], "no server spans merged"
+    spans = snap["remote"][-1]["spans"]
+    by_name = {s["name"]: s for s in spans}
+    batch_span = by_name["batch"]
+    assert batch_span["args"]["occupancy"] >= 1
+    assert batch_span["args"]["lanes"] == 4
+    assert batch_span["args"]["shape_class"].startswith("n")
+    members = batch_span["args"]["members"]
+    assert {"tenant": "b", "trace_id": tracer.trace_id} in members
+    rpc_span = by_name["sidecar/ScaleDownSim"]
+    assert rpc_span["args"]["batch"] == batch_span["args"]["batch_id"]
+    assert rpc_span["args"]["tenant"] == "b"
+
+
+def test_backpressure_maps_to_resource_exhausted_and_is_retryable():
+    """Queue overflow surfaces as gRPC RESOURCE_EXHAUSTED with a retry-after
+    hint (admission.QueueFull client-side); once load drains, the SAME
+    request succeeds — rejection is stateless."""
+    grpc = pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar.admission import QueueFull
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimulatorClient,
+        SimulatorService,
+        make_grpc_server,
+    )
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16,
+                           batch_lanes=1, batch_window_ms=1.0, queue_depth=1)
+    server, port = make_grpc_server(svc, port=0)
+    server.start()
+    try:
+        c = SimulatorClient(port, tenant="t0")
+        assert c.apply_delta(tenant_delta(0))["error"] == ""
+        # wedge the dispatch behind a gate so the queue can actually fill
+        gate = threading.Event()
+        orig = svc._scheduler.dispatch
+
+        def slow(batch):
+            gate.wait(30)
+            return orig(batch)
+
+        svc._scheduler.dispatch = slow
+        results = {}
+
+        def bg(name):
+            try:
+                results[name] = c.scale_down_sim(threshold=0.5)
+            except Exception as e:  # noqa: BLE001
+                results[name] = e
+
+        t1 = threading.Thread(target=bg, args=("first",))
+        t1.start()
+        time.sleep(0.3)     # scheduler popped "first"; its dispatch is gated
+        t2 = threading.Thread(target=bg, args=("second",))
+        t2.start()
+        time.sleep(0.3)     # "second" occupies the whole queue (depth 1)
+        with pytest.raises(QueueFull) as ei:
+            c.scale_down_sim(threshold=0.5)
+        assert ei.value.retry_after_ms >= 1
+        assert svc._queue.rejected >= 1
+        gate.set()
+        t1.join(60)
+        t2.join(60)
+        assert isinstance(results["first"], dict), results["first"]
+        assert isinstance(results["second"], dict), results["second"]
+        # the rejected request, retried after the hint, now succeeds
+        time.sleep(ei.value.retry_after_ms / 1000.0)
+        retried = c.scale_down_sim(threshold=0.5)
+        assert retried == results["first"]
+    finally:
+        server.stop(None)
+        svc.close()
+
+
+def test_constrained_tenant_routes_serial_not_batched():
+    """A tenant with a KAUX constraint overlay needs the planes-attached
+    serial tier; the service must keep serving it (and still serve plain
+    tenants batched)."""
+    from kubernetes_autoscaler_tpu.models.api import TopologySpreadConstraint
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimParams,
+        SimulatorService,
+    )
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16,
+                           batch_lanes=2, batch_window_ms=1.0)
+    try:
+        w = DeltaWriter()
+        w.upsert_node(build_test_node("cz", cpu_milli=4000, mem_mib=8192,
+                                      zone="za"))
+        p = build_test_pod("sp", cpu_milli=500, mem_mib=256,
+                           labels={"app": "w"}, owner_name="rs")
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key="topology.kubernetes.io/zone",
+            match_labels={"app": "w"})]
+        w.upsert_pod(p)
+        assert svc.apply_delta(w.payload(), tenant="cons")["error"] == ""
+        ts = svc._tenant("cons")
+        assert ts.aux and not svc._batchable(ts)
+        down = svc.scale_down_sim(SimParams(threshold=0.5), tenant="cons")
+        assert "eligible" in down
+        batches_before = svc._scheduler.batches if svc._scheduler else 0
+        svc.scale_down_sim(SimParams(threshold=0.5), tenant="cons")
+        assert (svc._scheduler.batches if svc._scheduler else 0) \
+            == batches_before
+    finally:
+        svc.close()
+
+
+def test_tenant_table_cap_rejects_and_drop_frees_slot():
+    """Tenant ids arrive on unauthenticated metadata: the world table is
+    CAPPED (max_tenants). A fresh id past the cap gets the retryable
+    RESOURCE_EXHAUSTED rejection (QueueFull — same surface as admission
+    backpressure), existing tenants keep working, and drop_tenant frees a
+    slot. Observability paths never allocate: _tenant_peek on an unknown
+    id returns None and mints nothing."""
+    from kubernetes_autoscaler_tpu.sidecar.admission import QueueFull
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorService
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16, max_tenants=3)
+    try:
+        assert svc.apply_delta(tenant_delta(0).payload(),
+                               tenant="a")["error"] == ""
+        assert svc.apply_delta(tenant_delta(1).payload(),
+                               tenant="b")["error"] == ""   # + default = 3
+        with pytest.raises(QueueFull) as e:
+            svc.apply_delta(tenant_delta(2).payload(), tenant="c")
+        assert e.value.retry_after_ms > 0
+        assert svc._tenant_peek("c") is None        # nothing half-created
+        # existing tenants are unaffected by the rejection
+        assert svc.apply_delta(tenant_delta(0).payload(),
+                               tenant="a")["version"] == 2
+        assert svc.drop_tenant("b")
+        assert svc.apply_delta(tenant_delta(2).payload(),
+                               tenant="c")["error"] == ""
+    finally:
+        svc.close()

@@ -1,0 +1,57 @@
+"""Shape-class ladder: geometric rungs, stability under churn, hit/miss."""
+
+import pytest
+
+from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+from kubernetes_autoscaler_tpu.sidecar.shapes import ShapeLadder, rung
+
+
+def test_rung_is_geometric_from_base():
+    assert rung(0, 64) == 64
+    assert rung(1, 64) == 64
+    assert rung(64, 64) == 64
+    assert rung(65, 64) == 128
+    assert rung(1000, 64) == 1024
+    assert rung(100_000, 256) == 131072
+    with pytest.raises(ValueError):
+        rung(5, 0)
+
+
+def test_ladder_stays_small_across_wide_size_range():
+    """The whole point: tenant sizes spanning 1..1M nodes land in ~15
+    classes, so a new tenant joins an existing class with probability ≈ 1."""
+    ladder = ShapeLadder(64, 64, 256)
+    for n in range(1, 1_000_000, 997):
+        ladder.classify(n, n // 10, n * 4)
+    assert len(ladder.seen()) < 40
+
+
+def test_count_churn_within_rung_is_always_a_hit():
+    ladder = ShapeLadder(16, 16, 64)
+    first = ladder.classify(10, 3, 40)
+    assert ladder.misses == 1
+    for n_pods in (41, 55, 64, 30, 1):
+        assert ladder.classify(10, 3, n_pods) == first
+    assert ladder.hits == 5 and ladder.misses == 1
+    assert ladder.hit_rate() == 5 / 6
+
+
+def test_growth_past_rung_is_one_miss_then_hits():
+    ladder = ShapeLadder(16, 16, 64)
+    a = ladder.classify(10, 3, 40)
+    b = ladder.classify(10, 3, 65)     # pods crossed the 64 rung
+    assert b != a and b.pods == 128
+    assert ladder.misses == 2
+    assert ladder.classify(12, 3, 100) == b
+    assert ladder.hits == 1
+
+
+def test_counters_land_in_registry_with_class_label():
+    reg = Registry(prefix="t")
+    ladder = ShapeLadder(16, 16, 64, registry=reg)
+    sc = ladder.classify(5, 2, 10)
+    ladder.classify(6, 2, 12)
+    assert reg.counter("shape_class_miss_total").value(
+        shape_class=sc.key) == 1.0
+    assert reg.counter("shape_class_hit_total").value(
+        shape_class=sc.key) == 1.0
