@@ -226,6 +226,8 @@ def run_floor_child(metric: str, args) -> int:
     if args.tenants:
         cmd += ["--tenants", str(args.tenants),
                 "--tenant-rounds", str(args.tenant_rounds)]
+        if args.tail_dump:
+            cmd += ["--tail-dump", args.tail_dump]
     if args.no_batching:
         cmd += ["--no-batching"]
     env = dict(os.environ)
@@ -393,6 +395,10 @@ def main() -> None:
                          "the batched speedup is measured against)")
     ap.add_argument("--tenant-rounds", type=int, default=40,
                     help="scale-up sims per tenant in the measured window")
+    ap.add_argument("--tail-dump", default="",
+                    help="with --tenants: write the tail sampler's retained "
+                         "request traces (slow/breached/failed only) as one "
+                         "Perfetto file here")
     ap.add_argument("--require-tpu", action="store_true",
                     help="disable the CPU-floor degradation: a missing/hung "
                          "TPU backend emits the null-value error JSON and "
@@ -1038,17 +1044,25 @@ def bench_multi_tenant(args) -> None:
               "in-process (same dispatch path, no wire hop)",
               file=sys.stderr)
 
-    def run_serving(batching: bool) -> dict:
+    def run_serving(batching: bool, tail_dump: str = "") -> dict:
+        import tempfile
+
         # lane width = expected per-class occupancy (tenants split over two
         # shape classes): padding is wasted compute on the lane-serial CPU
         # floor, so lanes match the real batch and window_max (the coalescing
         # cap) closes the window early once every tenant's request arrived
+        slo_dir = tempfile.mkdtemp(prefix="katpu-slo-") if batching else ""
         svc = SimulatorService(
             node_bucket=16, group_bucket=16,
             batch_lanes=(min(max(n_tenants // 2, 1), 16) if batching else 0),
             batch_window_ms=25.0, batch_window_max=n_tenants,
-            queue_depth=max(4 * n_tenants, 64))
+            queue_depth=max(4 * n_tenants, 64),
+            slo_dump_dir=slo_dir)
         server = None
+        # per-tenant server-side lifecycle blocks (request-phase
+        # decomposition) collected during the measured window
+        lifecycles: dict = {i: [] for i in range(n_tenants + 2)}
+        lc_lock = threading.Lock()
         try:
             if have_grpc:
                 from kubernetes_autoscaler_tpu.sidecar.server import (
@@ -1070,8 +1084,9 @@ def bench_multi_tenant(args) -> None:
                     client(i)   # eager: the storm threads only read the dict
 
                 def up(i):
-                    return client(i).scale_up_sim(
+                    r = client(i).scale_up_sim(
                         max_new_nodes=32, node_groups=ngs)
+                    return r, client(i).last_lifecycle
 
                 def down(i):
                     return client(i).scale_down_sim(threshold=0.5)
@@ -1080,8 +1095,9 @@ def bench_multi_tenant(args) -> None:
                     return client(i)._call_json("ApplyDelta", payload)
             else:
                 def up(i):
-                    return svc.scale_up_sim(SimParams(
+                    r = svc.scale_up_sim(SimParams(
                         max_new_nodes=32, node_groups=ngs), tenant=f"t{i}")
+                    return r, r.pop("lifecycle", None)
 
                 def down(i):
                     return svc.scale_down_sim(SimParams(threshold=0.5),
@@ -1102,7 +1118,10 @@ def bench_multi_tenant(args) -> None:
                     try:
                         for _ in range(k):
                             barrier.wait(60)
-                            up(i)
+                            _, lc = up(i)
+                            if lc:
+                                with lc_lock:
+                                    lifecycles[i].append(lc)
                     except Exception as e:  # noqa: BLE001
                         errors.append(e)
                         raise
@@ -1119,6 +1138,9 @@ def bench_multi_tenant(args) -> None:
             for i in range(n_tenants):
                 down(i)                   # warm the scale-down program too
             svc.occupancies.clear()
+            svc.gaps.clear()              # gap stats from the window only
+            for v in lifecycles.values():
+                v.clear()
             hits0, misses0 = svc.ladder.hits, svc.ladder.misses
             cache0 = svc._sim_cache_size()
             t0 = time.perf_counter()
@@ -1130,6 +1152,33 @@ def bench_multi_tenant(args) -> None:
             hit_rate = (d_hits / (d_hits + d_misses)
                         if d_hits + d_misses else 1.0)
             occ = list(svc.occupancies)
+            # per-tenant latency percentiles + phase decomposition (ISSUE
+            # 8): server-side e2e percentiles and the mean contiguous phase
+            # breakdown; phase_sum_over_e2e ≈ 1.0 is the "phases sum to
+            # end-to-end" contract, CI-asserted within 5%
+            per_tenant = {}
+            for i in range(n_tenants):
+                lcs = lifecycles[i]
+                if not lcs:
+                    continue
+                e2es = [lc["e2e_ms"] for lc in lcs]
+                phase_keys = sorted({k for lc in lcs
+                                     for k in lc["phases_ms"]})
+                sums = [sum(lc["phases_ms"].values()) for lc in lcs]
+                per_tenant[f"t{i}"] = {
+                    "requests": len(lcs),
+                    "p50": round(float(np.percentile(e2es, 50)), 3),
+                    "p95": round(float(np.percentile(e2es, 95)), 3),
+                    "p99": round(float(np.percentile(e2es, 99)), 3),
+                    "phases_ms_mean": {
+                        k: round(float(np.mean(
+                            [lc["phases_ms"].get(k, 0.0) for lc in lcs])), 4)
+                        for k in phase_keys},
+                    "phase_sum_over_e2e": round(float(np.mean(
+                        [s / e if e else 1.0
+                         for s, e in zip(sums, e2es)])), 4),
+                }
+            gap = svc.gap_stats()
             # new-tenant segment: one fresh tenant per shape class, admitted
             # AFTER warmup — the ≈0-recompile guarantee, measured
             cache1 = svc._sim_cache_size()
@@ -1139,6 +1188,23 @@ def bench_multi_tenant(args) -> None:
                 up(j)
                 down(j)
             new_tenant_recompiles = (svc._sim_cache_size() - cache1) / 2.0
+            # forced SLO breach (gRPC path only — the breach hook lives in
+            # traced_call): an impossible budget for t0, one more request,
+            # then the tenant-scoped dump must exist and hold only t0's
+            # retained traces
+            slo_evidence = None
+            if batching and have_grpc:
+                svc.slo.set("t0", 1e-6)
+                up(0)
+                dumps = sorted(os.listdir(slo_dir)) if slo_dir else []
+                slo_evidence = {
+                    "breaches_t0": svc.registry.counter(
+                        "tenant_slo_breaches_total").value(tenant="t0"),
+                    "tenant_dump": (os.path.join(slo_dir, dumps[0])
+                                    if dumps else None),
+                }
+            if tail_dump:
+                svc.tail.dump(tail_dump)
             if batching and getattr(args, "trace", None):
                 # one extra synchronized round under per-member tracers:
                 # the merged server spans put each member's `batch` span
@@ -1174,6 +1240,10 @@ def bench_multi_tenant(args) -> None:
                 "steady_recompiles": steady_recompiles,
                 "recompiles_per_new_tenant": new_tenant_recompiles,
                 "stats": svc.batch_stats(),
+                "per_tenant": per_tenant,
+                "dispatch_gap": gap,
+                "tail_sampler": svc.tail.stats(),
+                "slo": slo_evidence,
             }
         finally:
             if server is not None:
@@ -1181,7 +1251,8 @@ def bench_multi_tenant(args) -> None:
             svc.close()
 
     batching = not args.no_batching
-    primary = run_serving(batching=batching)
+    tail_dump = getattr(args, "tail_dump", "") or ""
+    primary = run_serving(batching=batching, tail_dump=tail_dump)
     serial = None
     if batching:
         serial = run_serving(batching=False)
@@ -1190,6 +1261,8 @@ def bench_multi_tenant(args) -> None:
           f"occupancy_p50={primary['occupancy_p50']} "
           f"hit_rate={primary['hit_rate']:.3f} "
           f"new_tenant_recompiles={primary['recompiles_per_new_tenant']} "
+          f"dispatch_gap_p50_ms={primary['dispatch_gap']['p50_ms']} "
+          f"tail={json.dumps(primary['tail_sampler'])} "
           f"stats={json.dumps(primary['stats'])}"
           + (f" serial_cps={serial['clusters_per_sec']:.1f}"
              f" speedup={primary['clusters_per_sec'] / serial['clusters_per_sec']:.2f}x"
@@ -1212,6 +1285,15 @@ def bench_multi_tenant(args) -> None:
         "shape_class_hit_rate": round(primary["hit_rate"], 4),
         "recompiles_per_new_tenant": primary["recompiles_per_new_tenant"],
         "steady_state_recompiles": primary["steady_recompiles"],
+        # serving-grade observability (ISSUE 8): WHERE the serving time
+        # goes, per tenant — never-null on the CPU floor (the decomposition
+        # is host-side stamping, backend-independent)
+        "per_tenant": primary["per_tenant"],
+        "dispatch_gap_p50_ms": primary["dispatch_gap"]["p50_ms"],
+        "dispatch_gap": primary["dispatch_gap"],
+        "tail_sampler": primary["tail_sampler"],
+        "slo": primary["slo"],
+        **({"tail_dump": tail_dump} if tail_dump else {}),
         **({"serial_clusters_per_sec": round(serial["clusters_per_sec"], 2),
             "speedup_vs_serial": round(primary["clusters_per_sec"]
                                        / serial["clusters_per_sec"], 2)}

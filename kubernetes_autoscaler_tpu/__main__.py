@@ -28,7 +28,10 @@ from kubernetes_autoscaler_tpu.config.flags import parse_options
 from kubernetes_autoscaler_tpu.core.loop import LoopTrigger, run_loop
 from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
 from kubernetes_autoscaler_tpu.debuggingsnapshot import DebuggingSnapshotter
-from kubernetes_autoscaler_tpu.metrics.metrics import default_registry
+from kubernetes_autoscaler_tpu.metrics.metrics import (
+    default_registry,
+    expose_all_text,
+)
 from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
 from kubernetes_autoscaler_tpu.utils.leaderelection import FileLeaderElector
 from kubernetes_autoscaler_tpu.utils.testing import build_test_node, build_test_pod
@@ -67,7 +70,16 @@ def make_mux(autoscaler: StaticAutoscaler, snapshotter: DebuggingSnapshotter):
 
         def do_GET(self):
             if self.path == "/metrics":
-                self._send(200, default_registry.expose_text())
+                # default registry + any registered extra registries (an
+                # in-process sidecar's katpu_sidecar_* series) — the same
+                # families the sidecar Metricz RPC serves, one scrape.
+                # OpenMetrics content type: histogram bucket lines may carry
+                # exemplar suffixes (`# {trace_id="…"} v`), which are
+                # OpenMetrics syntax — a classic text/plain parser would
+                # reject the whole scrape
+                self._send(200, expose_all_text(),
+                           ctype="application/openmetrics-text; "
+                                 "version=1.0.0; charset=utf-8")
             elif self.path == "/healthz":
                 ok = autoscaler.health.healthy()
                 self._send(200 if ok else 500, "ok" if ok else "loop stalled")

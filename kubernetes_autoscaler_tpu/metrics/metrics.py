@@ -71,33 +71,52 @@ class Histogram:
     buckets: tuple = _DEFAULT_BUCKETS
     _counts: dict[tuple, list] = field(default_factory=dict)
     _sums: dict[tuple, float] = field(default_factory=dict)
+    # (series key, bucket index) -> (trace_id, observed value): the last
+    # exemplar landing in that bucket. Only observations explicitly carrying
+    # an exemplar (a tail-sampled trace id) are stored, so every exemplar in
+    # the exposition resolves to a RETAINED trace — a bad p99 bucket links
+    # straight to its Perfetto evidence instead of a sampled-away id.
+    _exemplars: dict[tuple, tuple] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
-    def observe(self, v: float, **labels) -> None:
+    def observe(self, v: float, exemplar: str | None = None, **labels) -> None:
         key = tuple(sorted(labels.items()))
         with self._lock:
             counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     counts[i] += 1
+                    bucket = i
                     break
             else:
                 counts[-1] += 1
+                bucket = len(self.buckets)
             self._sums[key] = self._sums.get(key, 0.0) + v
+            if exemplar:
+                self._exemplars[(key, bucket)] = (exemplar, v)
 
     def count(self, **labels) -> int:
         with self._lock:
             return sum(self._counts.get(tuple(sorted(labels.items())), []))
 
+    def exemplars(self, **labels) -> dict[int, tuple]:
+        """bucket index -> (trace_id, value) for one series."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return {b: ex for (k, b), ex in self._exemplars.items()
+                    if k == key}
+
     def zero_matching(self, **labels) -> None:
-        """Stale-label zeroing: bucket counts and sums of every series whose
-        label set contains `labels` reset to zero (see Counter)."""
+        """Stale-label zeroing: bucket counts, sums and exemplars of every
+        series whose label set contains `labels` reset (see Counter)."""
         items = set(labels.items())
         with self._lock:
             for key in self._counts:
                 if items <= set(key):
                     self._counts[key] = [0] * (len(self.buckets) + 1)
                     self._sums[key] = 0.0
+            for kb in [kb for kb in self._exemplars if items <= set(kb[0])]:
+                del self._exemplars[kb]
 
 
 class Registry:
@@ -168,15 +187,20 @@ class Registry:
             elif isinstance(m, Histogram):
                 lines.append(f"# TYPE {full} histogram")
                 with m._lock:
-                    rows = [(key, list(counts), m._sums.get(key, 0.0))
+                    rows = [(key, list(counts), m._sums.get(key, 0.0),
+                             {b: ex for (k, b), ex in m._exemplars.items()
+                              if k == key})
                             for key, counts in m._counts.items()]
-                for key, counts, total in rows:
+                for key, counts, total, exemplars in rows:
                     cum = 0
                     for i, b in enumerate(m.buckets):
                         cum += counts[i]
-                        lines.append(f'{full}_bucket{_fmt(key, le=str(b))} {cum}')
+                        lines.append(f'{full}_bucket{_fmt(key, le=str(b))} '
+                                     f'{cum}{_fmt_exemplar(exemplars.get(i))}')
                     cum += counts[-1]
-                    lines.append(f'{full}_bucket{_fmt(key, le="+Inf")} {cum}')
+                    lines.append(
+                        f'{full}_bucket{_fmt(key, le="+Inf")} {cum}'
+                        f"{_fmt_exemplar(exemplars.get(len(m.buckets)))}")
                     lines.append(f"{full}_sum{_fmt(key)} {total}")
                     lines.append(f"{full}_count{_fmt(key)} {cum}")
         return "\n".join(lines) + "\n"
@@ -188,6 +212,55 @@ def _fmt(key: tuple, **extra) -> str:
         return ""
     inner = ",".join(f'{k}="{v}"' for k, v in items)
     return "{" + inner + "}"
+
+
+def _fmt_exemplar(ex: tuple | None) -> str:
+    """OpenMetrics exemplar suffix for a bucket line:
+    `... 17 # {trace_id="abc"} 0.042` — the trace id resolves to a
+    tail-sampler-retained Perfetto trace (metrics/trace.TailSampler)."""
+    if not ex:
+        return ""
+    trace_id, v = ex
+    return f' # {{trace_id="{trace_id}"}} {v}'
+
+
+# ---- extra exposition registries (in-process sidecar parity) ----
+#
+# The control plane's /metrics mux serves `default_registry`; a sidecar
+# running IN the same process (bench, tests, single-binary deployments)
+# registers its own Registry here so the mux exposes the identical series
+# the sidecar `Metricz` RPC serves — one scrape surface, two transports,
+# same families (the Metricz RPC conversely appends default_registry).
+# Held by WEAK reference: a service that is dropped without close() (or
+# that leaks in tests) falls out of the exposition with its registry
+# instead of being scraped as a ghost forever.
+_extra_expositions: list = []      # list[weakref.ref[Registry]]
+_extra_lock = threading.Lock()
+
+
+def register_exposition(registry: Registry) -> None:
+    import weakref
+
+    with _extra_lock:
+        _extra_expositions[:] = [r for r in _extra_expositions
+                                 if r() is not None]
+        if not any(r() is registry for r in _extra_expositions):
+            _extra_expositions.append(weakref.ref(registry))
+
+
+def unregister_exposition(registry: Registry) -> None:
+    with _extra_lock:
+        _extra_expositions[:] = [r for r in _extra_expositions
+                                 if r() is not None and r() is not registry]
+
+
+def expose_all_text() -> str:
+    """default_registry + every live registered extra registry, one
+    exposition — what the /metrics mux serves."""
+    with _extra_lock:
+        extras = [r() for r in _extra_expositions if r() is not None]
+    return "".join([default_registry.expose_text()]
+                   + [r.expose_text() for r in extras])
 
 
 @dataclass

@@ -118,6 +118,38 @@ REASON_FAMILIES = {
         "emitted by the orchestrator's _apply_quota (unchanged family)"),
 }
 
+# ---- serving surfaces: reference per-loop families → per-request/tenant ----
+#
+# The reference is a single-cluster loop: its latency surfaces are per-LOOP
+# (function_duration_seconds per stage, pending_pods gauges). The multi-
+# tenant sidecar serves a FLEET, so each family gains a per-request,
+# per-tenant analog (ISSUE 8; docs/OBSERVABILITY.md "Serving surfaces").
+# PARITY.md carries the same table.
+SERVING_FAMILIES = {
+    # reference per-loop family -> our per-tenant serving analog
+    "function_duration_seconds": (
+        "katpu_sidecar_request_phase_seconds{phase,tenant} — the per-stage "
+        "decomposition of ONE request (encode/queue/form/stack/dispatch/"
+        "harvest/assembly/reply, contiguous, sums to e2e) instead of one "
+        "process-lifetime histogram per loop stage"),
+    "unschedulable_pods_count (pending work)": (
+        "admission queue depth + admission_rejects_total{reason} — the "
+        "serving-side pending-work surface: queued simulation requests and "
+        "explicit sheds, instead of pending pods"),
+    "errors_total": (
+        "tenant_slo_breaches_total{tenant} + rpc_duration_seconds bucket "
+        "EXEMPLARS resolving to tail-sampled Perfetto traces — breaches "
+        "carry their evidence instead of a bare error count"),
+    "max_node_skip_eval_duration_seconds (work skipped)": (
+        "dispatch_gap_seconds{cause} + device_idle_seconds_total + "
+        "batch_occupancy_ratio — device time NOT spent on member work "
+        "(pipeline stalls, arrival idle, lane padding)"),
+    "cluster_safe_to_autoscale (health doc)": (
+        "the sidecar Statusz RPC — tenant table with latency percentiles, "
+        "SLO budgets/breaches and last-breach exemplar trace ids, queue and "
+        "shape-class state, in one human-readable page"),
+}
+
 # The reference UnremovableReason enum values our planner actually produces,
 # value-for-value (simulator/cluster.go:63-103). A dashboard filtering the
 # reference's unremovable_nodes_count{reason=...} re-points unchanged.

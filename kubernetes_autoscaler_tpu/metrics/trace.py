@@ -257,6 +257,135 @@ def chrome_trace_events(snapshots: list[dict]) -> list[dict]:
     return events
 
 
+class TailSampler:
+    """Tail-based per-request trace retention (the serving-side analog of
+    the FlightRecorder's whole-ring dump).
+
+    The FlightRecorder answers "what did the loops before the breach look
+    like" by dumping everything; at serving rates (thousands of requests per
+    second) retaining every request trace is a memory bomb and dumping the
+    ring on each slow request is an I/O bomb. A tail sampler decides AFTER
+    the request completes — when its latency and outcome are known — whether
+    the full trace is worth keeping:
+
+      * always-keep reasons: `failed`, `backpressure`, `slo_breach` — the
+        requests a post-mortem starts from;
+      * `slow`: e2e above the rolling slow-quantile estimate (a bounded
+        reservoir of recent latencies; quantile re-estimated lazily), so the
+        retained set tracks the CURRENT tail, not a static threshold;
+      * everything else is dropped (only its latency feeds the reservoir).
+
+    The retained set is a bounded ring with eviction accounting
+    (`offered` / `retained` / `evicted` + per-reason counts), exportable as
+    one Perfetto file (`to_chrome_trace`) or filtered per tenant
+    (`tenant_traces`) for tenant-scoped SLO-breach dumps. `retain()` returns
+    the trace id, which the caller attaches as the latency histogram
+    bucket's EXEMPLAR — the link from a bad p99 in /metrics to a retained
+    trace."""
+
+    # quantile re-estimation stride: the threshold is recomputed from the
+    # reservoir every K inserts, not per request — a sort per RPC would
+    # serialize all handler threads on the sampler lock doing O(n log n)
+    # of redundant work at serving rates
+    REESTIMATE_EVERY = 16
+
+    def __init__(self, capacity: int = 64, slow_quantile: float = 0.95,
+                 reservoir: int = 512, min_observations: int = 32):
+        self.capacity = max(int(capacity), 1)
+        self.slow_quantile = float(slow_quantile)
+        self.min_observations = int(min_observations)
+        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        self._lat: deque[float] = deque(maxlen=int(reservoir))
+        self._thresh: float | None = None       # cached slow threshold
+        self._since_estimate = 0
+        self._lock = threading.Lock()
+        self.offered = 0
+        self.retained = 0
+        self.evicted = 0
+        self.reasons: dict[str, int] = {}
+
+    # ---- latency feed + slow classification ----
+
+    def observe_latency(self, e2e_s: float) -> bool:
+        """Feed the reservoir; True when `e2e_s` sits in the slow tail.
+        Before `min_observations` latencies arrive nothing classifies as
+        slow (a cold server would otherwise retain its first N requests
+        and squat the budget on warmup compiles). The quantile threshold
+        is re-estimated lazily, every REESTIMATE_EVERY inserts."""
+        with self._lock:
+            self._lat.append(float(e2e_s))
+            if len(self._lat) < self.min_observations:
+                return False
+            self._since_estimate += 1
+            if (self._thresh is None
+                    or self._since_estimate >= self.REESTIMATE_EVERY):
+                xs = sorted(self._lat)
+                idx = min(int(len(xs) * self.slow_quantile), len(xs) - 1)
+                self._thresh = xs[idx]
+                self._since_estimate = 0
+            return e2e_s >= self._thresh
+
+    def offer(self, snapshot: dict, e2e_s: float,
+              reason: str | None = None) -> str | None:
+        """Offer one completed request's trace snapshot. `reason` is an
+        always-keep override (`failed` / `backpressure` / `slo_breach`);
+        with None the rolling quantile decides (`slow`). Returns the trace
+        id when retained (→ exemplar), else None."""
+        slow = self.observe_latency(e2e_s)
+        with self._lock:
+            self.offered += 1
+            if reason is None and not slow:
+                return None
+            reason = reason or "slow"
+            snap = dict(snapshot)
+            snap["retain_reason"] = reason
+            snap["e2e_s"] = float(e2e_s)
+            if len(self._ring) == self._ring.maxlen:
+                self.evicted += 1
+            self._ring.append(snap)
+            self.retained += 1
+            self.reasons[reason] = self.reasons.get(reason, 0) + 1
+            return snap.get("trace_id")
+
+    # ---- export ----
+
+    def traces(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def tenant_traces(self, tenant: str) -> list[dict]:
+        """Only the retained traces whose request belonged to `tenant` —
+        the tenant-scoped SLO-breach dump's content (never the whole
+        ring)."""
+        return [s for s in self.traces() if s.get("tenant") == tenant]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"offered": self.offered, "retained": self.retained,
+                    "evicted": self.evicted, "held": len(self._ring),
+                    "reasons": dict(self.reasons)}
+
+    def to_chrome_trace(self, snaps: list[dict] | None = None) -> dict:
+        snaps = self.traces() if snaps is None else snaps
+        return {
+            "traceEvents": chrome_trace_events(snaps),
+            "otherData": {
+                "sampler": self.stats(),
+                "trace_ids": [s["trace_id"] for s in snaps],
+                "retain_reasons": {s["trace_id"]: s.get("retain_reason", "")
+                                   for s in snaps},
+            },
+        }
+
+    def dump(self, path: str, snaps: list[dict] | None = None) -> str:
+        doc = self.to_chrome_trace(snaps)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+
 class FlightRecorder:
     """Bounded ring of the last `capacity` loop traces (capacity 0 disables
     tracing entirely — StaticAutoscaler then never constructs a Tracer and

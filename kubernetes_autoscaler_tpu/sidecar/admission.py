@@ -34,22 +34,33 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from kubernetes_autoscaler_tpu.sidecar.lifecycle import Stamps
+
 
 class QueueFull(Exception):
     """Admission bound hit: reject now, retry after `retry_after_ms`.
 
     Mapped to gRPC RESOURCE_EXHAUSTED by the server handler. The request was
     NOT enqueued — retrying it later is always safe (nothing partial
-    happened), which tests/test_admission.py proves end to end."""
+    happened), which tests/test_admission.py proves end to end.
+
+    `reason` distinguishes WHY the reject fired — `queue-full` (admission
+    depth bound) vs `tenant-cap` (resident-world table bound) — so the
+    server's `admission_rejects_total{reason}` and the event sink can tell
+    an overloaded queue (transient; retry helps) from a full tenant table
+    (structural; retry alone never helps, an operator must drop_tenant or
+    run a bigger sidecar)."""
 
     def __init__(self, depth: int | None, retry_after_ms: int,
-                 what: str = "admission queue"):
+                 what: str = "admission queue",
+                 reason: str = "queue-full"):
         where = (f"{depth} queued" if isinstance(depth, int)
                  else "server backpressure")
         super().__init__(
             f"{what} full ({where}); retry in {retry_after_ms}ms")
         self.depth = depth
         self.retry_after_ms = retry_after_ms
+        self.reason = reason
 
 
 @dataclass
@@ -65,6 +76,9 @@ class Ticket:
     batch_info: dict | None = None
     done: threading.Event = field(default_factory=threading.Event)
     enqueued_ns: int = field(default_factory=time.perf_counter_ns)
+    # request-lifecycle marks (sidecar/lifecycle.py): the queue stamps
+    # `enqueue`/`collected`; the dispatch path stamps the batch-level marks
+    stamps: Stamps = field(default_factory=Stamps)
 
     def wait(self, timeout_s: float = 60.0):
         if not self.done.wait(timeout_s):
@@ -109,6 +123,8 @@ class AdmissionQueue:
                 dq = deque()
                 self._by_tenant[t.tenant] = dq
                 self._ring.append(t.tenant)
+            if not t.stamps.enqueue:
+                t.stamps.enqueue = time.perf_counter_ns()
             dq.append(t)
             self.depth += 1
             self.submitted += 1
@@ -137,6 +153,7 @@ class AdmissionQueue:
 
     def _pop_round_robin(self, max_lanes: int) -> list[Ticket]:
         out: list[Ticket] = []
+        collected_ns = time.perf_counter_ns()
         while len(out) < max_lanes and self.depth > 0:
             # one full cycle over the ring = at most one ticket per tenant
             took_any = False
@@ -148,7 +165,9 @@ class AdmissionQueue:
                 self._cursor = (self._cursor + 1) % len(self._ring)
                 dq = self._by_tenant.get(tenant)
                 if dq:
-                    out.append(dq.popleft())
+                    t = dq.popleft()
+                    t.stamps.collected = collected_ns
+                    out.append(t)
                     self.depth -= 1
                     took_any = True
             if not took_any:
@@ -196,7 +215,7 @@ class BatchScheduler:
 
     def __init__(self, queue: AdmissionQueue, dispatch, lanes: int,
                  window_s: float = 0.002, idle_wait_s: float = 0.05,
-                 window_max: int | None = None):
+                 window_max: int | None = None, gap_cb=None):
         self.queue = queue
         self.dispatch = dispatch
         self.lanes = max(int(lanes), 1)
@@ -210,6 +229,22 @@ class BatchScheduler:
         self.idle_wait_s = idle_wait_s
         self.windows = 0
         self.batches = 0
+        # device-utilization accounting: `gap_cb(gap_seconds, cause)` fires
+        # per dispatch with the estimated device idle since the previous
+        # batch's results were ready. Causes:
+        #   pipelined  an unharvested batch was still in flight when this
+        #              dispatch launched — the device had queued work, so
+        #              the gap is 0 BY CONSTRUCTION (the pipelining
+        #              contract, CI-asserted ≈0 under load)
+        #   stall      the previous harvest completed WITH work already
+        #              waiting in the queue, yet the device sat idle until
+        #              this dispatch — a genuine pipeline failure
+        #   idle       the previous harvest completed with an empty queue;
+        #              the gap is arrival-bound (no work to run), reported
+        #              separately so idle fleets don't read as stalls
+        self.gap_cb = gap_cb
+        self._last_harvest_done_ns: int | None = None
+        self._work_waiting_at_harvest = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._serve, name="katpu-batch-scheduler", daemon=True)
@@ -254,6 +289,7 @@ class BatchScheduler:
                 for lo in range(0, len(run), self.lanes):
                     batch = run[lo:lo + self.lanes]
                     self.batches += 1
+                    self._note_gap(pending is not None)
                     try:
                         inflight = self.dispatch(batch)
                     except Exception as e:  # noqa: BLE001 — fail the batch, keep serving
@@ -268,9 +304,26 @@ class BatchScheduler:
         if pending is not None:
             self._harvest(pending)
 
-    @staticmethod
-    def _harvest(inflight) -> None:
+    def _note_gap(self, pipelined: bool) -> None:
+        """Estimated device idle before the dispatch about to launch (see
+        gap_cb causes above). Host-side estimator: the device's results-ready
+        time is observed as the previous harvest's completion."""
+        if self.gap_cb is None:
+            return
+        if pipelined:
+            self.gap_cb(0.0, "pipelined")
+            return
+        if self._last_harvest_done_ns is None:
+            return   # first dispatch ever: no previous batch to idle after
+        gap_s = (time.perf_counter_ns() - self._last_harvest_done_ns) / 1e9
+        self.gap_cb(gap_s,
+                    "stall" if self._work_waiting_at_harvest else "idle")
+
+    def _harvest(self, inflight) -> None:
         try:
             inflight.harvest()
         except Exception:  # noqa: BLE001 — harvest resolves tickets itself
             pass
+        finally:
+            self._last_harvest_done_ns = time.perf_counter_ns()
+            self._work_waiting_at_harvest = self.queue.depth > 0

@@ -140,6 +140,21 @@ def stack_fields(dicts: list[dict]) -> dict:
     return {k: np.stack([d[k] for d in dicts]) for k in dicts[0]}
 
 
+def stacked_nbytes(lanes_list: list) -> int:
+    """Host→device upload size of a stacked batch: the summed nbytes of
+    every numpy field across lanes (the tensor-struct casts upload exactly
+    these buffers). Feeds `device_transfer_bytes_total{direction="h2d"}` —
+    charged only on stack-cache MISSES, since a hit re-uses the resident
+    device pytree and moves nothing."""
+    total = 0
+    for ln in lanes_list:
+        for d in (ln.nodes, ln.groups, ln.pods) + (
+                (ln.ng,) if isinstance(ln, UpLane) else ()):
+            total += sum(int(a.nbytes) for a in d.values()
+                         if hasattr(a, "nbytes"))
+    return total
+
+
 def pad_lanes(items: list, lanes: int) -> list:
     """Occupancy padding: repeat lane 0 up to the fixed lane count. The
     padded lanes compute a real (duplicate) world and their outputs are
@@ -190,10 +205,13 @@ class InFlightBatch:
     def harvest(self) -> None:
         try:
             host = self.fetch.get()
+            harvested_ns = time.perf_counter_ns()
             results = self.assemble(host)
             self.batch_info["dur_ns"] = (
                 time.perf_counter_ns() - self.batch_info["t0_ns"])
             for t, r in zip(self.tickets, results):
+                t.stamps.harvested = harvested_ns
+                t.stamps.resolved = time.perf_counter_ns()
                 t.resolve(result=r, batch_info=self.batch_info)
             if self.on_done is not None:
                 self.on_done(self)

@@ -38,8 +38,13 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from kubernetes_autoscaler_tpu.events import EventSink
 from kubernetes_autoscaler_tpu.metrics import trace
-from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+from kubernetes_autoscaler_tpu.metrics.metrics import (
+    Registry,
+    register_exposition,
+    unregister_exposition,
+)
 from kubernetes_autoscaler_tpu.metrics.phases import PHASE_BUCKETS, PhaseStats
 from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS, Dims
 from kubernetes_autoscaler_tpu.sidecar.admission import (
@@ -48,10 +53,18 @@ from kubernetes_autoscaler_tpu.sidecar.admission import (
     QueueFull,
     Ticket,
 )
+from kubernetes_autoscaler_tpu.sidecar.lifecycle import (
+    REQUEST_PHASE_BUCKETS,
+    SloBudgets,
+    Stamps,
+    add_lifecycle_spans,
+    lifecycle_block,
+)
 from kubernetes_autoscaler_tpu.sidecar.native_api import NativeSnapshotState
 from kubernetes_autoscaler_tpu.sidecar.shapes import ShapeClass, ShapeLadder, rung
 from kubernetes_autoscaler_tpu.sidecar.wire import (
     RETRY_AFTER_MS_HEADER,
+    SLO_BUDGET_MS_HEADER,
     TENANT_ID_HEADER,
     TRACE_ID_HEADER,
     DeltaWriter,
@@ -87,6 +100,11 @@ class _Tenant:
     # request node-group digest -> (ng numpy tensors, ids, ng_rung, digest)
     ng_cache: OrderedDict = field(default_factory=OrderedDict)
     dispatched: bool = False     # has served ≥1 sim (new-tenant accounting)
+    # serving observability: recent e2e latencies (statusz percentiles),
+    # SLO breach count and the last breach's retained exemplar trace id
+    lat_ms: deque = field(default_factory=lambda: deque(maxlen=512))
+    slo_breaches: int = 0
+    last_breach_trace: str = ""
 
 
 class SimulatorService:
@@ -98,18 +116,35 @@ class SimulatorService:
                  batch_lanes: int = 0, batch_window_ms: float = 2.0,
                  batch_window_max: int | None = None,
                  queue_depth: int = 128, ticket_timeout_s: float = 60.0,
-                 max_tenants: int = 256):
+                 max_tenants: int = 256,
+                 slo_default_budget_ms: float = 0.0,
+                 slo_budgets: dict | None = None,
+                 slo_dump_dir: str = "",
+                 tail_sample_capacity: int = 64,
+                 tail_slow_quantile: float = 0.95):
         self.dims = dims
         self.max_tenants = int(max_tenants)
         self.node_bucket = node_bucket
         self.group_bucket = group_bucket
         self.pod_bucket = pod_bucket
         # per-RPC metrics, exposed in prometheus text by the Metricz rpc
-        # (the sidecar's /metricz analog — it has no HTTP mux of its own)
+        # (the sidecar's /metricz analog — it has no HTTP mux of its own).
+        # Registered with the process /metrics exposition too, so an
+        # in-process sidecar's series appear identically on both surfaces.
         self.registry = Registry(prefix="katpu_sidecar")
+        register_exposition(self.registry)
         self.phases = PhaseStats(owner="sidecar", registry=self.registry)
         self.ladder = ShapeLadder(node_bucket, group_bucket, pod_bucket,
                                   registry=self.registry)
+        # serving-grade observability (docs/OBSERVABILITY.md "Serving
+        # surfaces"): per-tenant latency budgets, tail-sampled request
+        # traces with exemplar linkage, admission-reject events
+        self.slo = SloBudgets(slo_default_budget_ms, slo_budgets)
+        self.slo_dump_dir = slo_dump_dir
+        self.tail = trace.TailSampler(capacity=tail_sample_capacity,
+                                      slow_quantile=tail_slow_quantile)
+        self.events = EventSink(registry=self.registry)
+        self._events_lock = threading.Lock()   # EventSink isn't thread-safe
         self._tenants: dict[str, _Tenant] = {}
         self._tenants_lock = threading.Lock()
         # serializes the (cache-size, dispatch, cache-size) window that
@@ -125,6 +160,9 @@ class SimulatorService:
         self.occupancies: deque[int] = deque(maxlen=1024)
         self._queue: AdmissionQueue | None = None
         self._scheduler: BatchScheduler | None = None
+        # device-utilization accounting: recent (gap_seconds, cause) pairs
+        # from the scheduler's dispatch-gap estimator (bench percentiles)
+        self.gaps: deque[tuple] = deque(maxlen=4096)
         if self.batch_lanes > 0:
             from kubernetes_autoscaler_tpu.sidecar.batch import StackCache
 
@@ -135,12 +173,34 @@ class SimulatorService:
             self._scheduler = BatchScheduler(
                 self._queue, self._dispatch_batch, lanes=self.batch_lanes,
                 window_s=batch_window_ms / 1000.0,
-                window_max=batch_window_max).start()
+                window_max=batch_window_max,
+                gap_cb=self._note_gap).start()
 
     def close(self) -> None:
         if self._scheduler is not None:
             self._scheduler.stop()
             self._scheduler = None
+        unregister_exposition(self.registry)
+
+    def _note_gap(self, gap_s: float, cause: str) -> None:
+        """Dispatch-gap accounting (BatchScheduler.gap_cb): `pipelined` and
+        `stall` gaps measure device idle while work existed — the pipelining
+        contract says their distribution sits at ≈0; `idle` gaps are
+        arrival-bound and ride a separate counter so an idle fleet does not
+        read as a pipeline failure."""
+        self.gaps.append((gap_s, cause))
+        if cause == "idle":
+            self.registry.counter(
+                "device_idle_seconds_total",
+                help="Device idle while the admission queue was empty "
+                     "(arrival-bound, not a pipeline stall)").inc(gap_s)
+            return
+        self.registry.histogram(
+            "dispatch_gap_seconds",
+            help="Estimated device idle between one batch's results being "
+                 "ready and the next dispatch launching, while work "
+                 "existed — ≈0 under pipelining (CI-asserted)",
+            buckets=REQUEST_PHASE_BUCKETS).observe(gap_s, cause=cause)
 
     # ---- tenants ----
 
@@ -154,9 +214,12 @@ class SimulatorService:
                     # one world each until OOM. RESOURCE_EXHAUSTED, like the
                     # admission bound — the operator frees slots with
                     # drop_tenant (or runs a bigger sidecar).
-                    raise QueueFull(None, retry_after_ms=1000,
-                                    what=f"tenant table "
-                                         f"({self.max_tenants} worlds)")
+                    e = QueueFull(None, retry_after_ms=1000,
+                                  what=f"tenant table "
+                                       f"({self.max_tenants} worlds)",
+                                  reason="tenant-cap")
+                    self._note_reject(tid, e)
+                    raise e
                 ts = _Tenant(tid=tid, state=NativeSnapshotState(self.dims))
                 self._tenants[tid] = ts
                 self.registry.gauge(
@@ -172,9 +235,10 @@ class SimulatorService:
             return self._tenants.get(tid)
 
     def drop_tenant(self, tid: str) -> bool:
-        """Evict a tenant's world and ZERO its labelled rpc series (the
+        """Evict a tenant's world and ZERO its labelled series (the
         stale-label convention: a dropped tenant must not keep claiming
-        traffic in the exposition)."""
+        traffic — or classification history, phase time, or SLO breaches —
+        in the exposition)."""
         with self._tenants_lock:
             ts = self._tenants.pop(tid, None)
             self.registry.gauge("tenants_active").set(
@@ -184,6 +248,17 @@ class SimulatorService:
         self.registry.counter("rpc_total").zero_matching(tenant=tid)
         self.registry.histogram(
             "rpc_duration_seconds").zero_matching(tenant=tid)
+        # the same sweep for every tenant-labelled family the serving layer
+        # grew: shape-class classification history (ISSUE 8 fix — these
+        # lingered forever before), lifecycle phase histograms, SLO breaches
+        self.registry.counter("shape_class_hit_total").zero_matching(
+            tenant=tid)
+        self.registry.counter("shape_class_miss_total").zero_matching(
+            tenant=tid)
+        self._phase_hist().zero_matching(tenant=tid)
+        self.registry.counter("tenant_slo_breaches_total").zero_matching(
+            tenant=tid)
+        self.slo.drop(tid)
         return True
 
     def tenants(self) -> list[str]:
@@ -225,7 +300,7 @@ class SimulatorService:
         the current rungs keep the class — the hit counters measure exactly
         the "no new padded shape" guarantee."""
         n, p, g = ts.state.counts()
-        ts.shape_class = self.ladder.classify(n, g, p)
+        ts.shape_class = self.ladder.classify(n, g, p, tenant=ts.tid)
         return ts.shape_class
 
     # ---- serial world assembly (legacy + constrained + no-batching path) ----
@@ -283,24 +358,30 @@ class SimulatorService:
     # ---- rpc: ScaleUpSim ----
 
     def scale_up_sim(self, params: SimParams, tenant: str = "") -> dict:
+        entry_ns = _time.perf_counter_ns()
         ts = self._tenant(tenant)
         if self._batchable(ts):
-            return self._submit("up", ts, params)
-        return self._scale_up_serial(ts, params)
+            return self._submit("up", ts, params, entry_ns)
+        return self._scale_up_serial(ts, params, entry_ns)
 
-    def _scale_up_serial(self, ts: _Tenant, params: SimParams) -> dict:
+    def _scale_up_serial(self, ts: _Tenant, params: SimParams,
+                         entry_ns: int = 0) -> dict:
         from kubernetes_autoscaler_tpu.ops.autoscale_step import scale_up_sim
 
+        stamps = Stamps(entry=entry_ns or _time.perf_counter_ns())
         with ts.lock:
             self._classify(ts)
             nt, gt, pt, planes, has_c = self._tensors_with_constraints(ts)
             groups, ids = self._encode_groups(ts, params)
+        stamps.enqueue = _time.perf_counter_ns()   # encode done
         with self._recompile_charge([ts]):
-            out = scale_up_sim(nt, gt, pt, groups, self.dims,
-                               params.max_new_nodes, params.strategy,
-                               planes=planes, with_constraints=has_c)
+            out = self._timed_sim(
+                lambda: scale_up_sim(nt, gt, pt, groups, self.dims,
+                                     params.max_new_nodes, params.strategy,
+                                     planes=planes, with_constraints=has_c))
+        stamps.dispatched = _time.perf_counter_ns()
         best = int(out.best)
-        return {
+        resp = {
             "best": ids[best] if 0 <= best < len(ids) else "",
             "options": [
                 {
@@ -316,33 +397,44 @@ class SimulatorService:
             "fits_existing": int(np.asarray(out.fits_existing).sum()),
             "remaining": int(np.asarray(out.remaining).sum()),
         }
+        stamps.harvested = _time.perf_counter_ns()
+        return self._finish_lifecycle(ts, stamps, resp)
 
     # ---- rpc: ScaleDownSim ----
 
     def scale_down_sim(self, params: SimParams, tenant: str = "") -> dict:
+        entry_ns = _time.perf_counter_ns()
         ts = self._tenant(tenant)
         if self._batchable(ts):
-            return self._submit("down", ts, params)
-        return self._scale_down_serial(ts, params)
+            return self._submit("down", ts, params, entry_ns)
+        return self._scale_down_serial(ts, params, entry_ns)
 
-    def _scale_down_serial(self, ts: _Tenant, params: SimParams) -> dict:
+    def _scale_down_serial(self, ts: _Tenant, params: SimParams,
+                           entry_ns: int = 0) -> dict:
         from kubernetes_autoscaler_tpu.ops.autoscale_step import scale_down_sim
 
+        stamps = Stamps(entry=entry_ns or _time.perf_counter_ns())
         with ts.lock:
             self._classify(ts)
             nt, gt, pt, planes, has_c = self._tensors_with_constraints(ts)
+        stamps.enqueue = _time.perf_counter_ns()   # encode done
         with self._recompile_charge([ts]):
-            out = scale_down_sim(nt, gt, pt, params.threshold,
-                                 planes=planes, max_zones=self.dims.max_zones,
-                                 with_constraints=has_c)
+            out = self._timed_sim(
+                lambda: scale_down_sim(nt, gt, pt, params.threshold,
+                                       planes=planes,
+                                       max_zones=self.dims.max_zones,
+                                       with_constraints=has_c))
+        stamps.dispatched = _time.perf_counter_ns()
         valid = np.asarray(nt.valid)
-        return {
+        resp = {
             "eligible": np.nonzero(np.asarray(out.eligible) & valid)[0].tolist(),
             "drainable": np.nonzero(
                 np.asarray(out.removal.drainable) & valid)[0].tolist(),
             "utilization": [round(float(u), 4)
                             for u in np.asarray(out.utilization)[valid]],
         }
+        stamps.harvested = _time.perf_counter_ns()
+        return self._finish_lifecycle(ts, stamps, resp)
 
     # ---- batched dispatch path ----
 
@@ -383,9 +475,11 @@ class SimulatorService:
             ts.ng_cache.popitem(last=False)
         return val
 
-    def _submit(self, kind: str, ts: _Tenant, params: SimParams) -> dict:
+    def _submit(self, kind: str, ts: _Tenant, params: SimParams,
+                entry_ns: int = 0) -> dict:
         from kubernetes_autoscaler_tpu.sidecar import batch as b
 
+        stamps = Stamps(entry=entry_ns or _time.perf_counter_ns())
         with ts.lock:
             nodes, groups, pods = self._export_np(ts)
             sc = ts.shape_class
@@ -403,9 +497,15 @@ class SimulatorService:
                 key = ("down", sc, self.dims.max_zones)
         tracer = trace.current_tracer()
         ticket = Ticket(tenant=ts.tid, kind=kind, key=key, lane=lane, fp=fp,
-                        trace_id=tracer.trace_id if tracer else None)
-        self._queue.submit(ticket)          # raises QueueFull on overload
+                        trace_id=tracer.trace_id if tracer else None,
+                        stamps=stamps)
+        try:
+            self._queue.submit(ticket)      # raises QueueFull on overload
+        except QueueFull as e:
+            self._note_reject(ts.tid, e)
+            raise
         resp = ticket.wait(self.ticket_timeout_s)
+        stamps.woke = _time.perf_counter_ns()
         bi = ticket.batch_info
         if tracer is not None and bi is not None:
             # the coalescing window on the member's own timeline: one
@@ -418,7 +518,76 @@ class SimulatorService:
                 shape_class=bi["shape_class"], occupancy=bi["occupancy"],
                 lanes=bi["lanes"], members=bi["members"])
             tracer.annotate(batch=bi["batch_id"])
+        return self._finish_lifecycle(
+            ts, stamps, resp, batch_id=bi["batch_id"] if bi else None)
+
+    def _phase_hist(self):
+        """The one accessor for `request_phase_seconds` — every touch
+        (observe OR a drop_tenant sweep) passes the sub-10µs bucket ladder,
+        so whichever call creates the family creates it right (Registry
+        only honors buckets on first touch)."""
+        return self.registry.histogram(
+            "request_phase_seconds",
+            help="Per-request serving-lifecycle phase wall clock "
+                 "(encode/queue/form/stack/dispatch/harvest/assembly/"
+                 "reply — contiguous, sums to e2e)",
+            buckets=REQUEST_PHASE_BUCKETS)
+
+    def _finish_lifecycle(self, ts: _Tenant, stamps: Stamps, resp: dict,
+                          batch_id: str | None = None) -> dict:
+        """One completed request's lifecycle → three surfaces at once:
+        per-tenant `request_phase_seconds{phase,tenant}` histograms, a
+        closed `lifecycle` span tree on the request's trace, and the
+        `lifecycle` block in the response JSON (so the CLIENT can show
+        server-side queue time distinct from network time). The phases are
+        contiguous intervals — they sum to e2e by construction, which CI
+        asserts within tolerance on the bench smoke."""
+        labels = {"tenant": ts.tid} if ts.tid else {}
+        for name, dur_ns in stamps.phases_ns().items():
+            self._phase_hist().observe(dur_ns / 1e9, phase=name, **labels)
+        ts.lat_ms.append(stamps.e2e_ns() / 1e6)
+        tracer = trace.current_tracer()
+        if isinstance(resp, dict):
+            resp["lifecycle"] = lifecycle_block(
+                stamps, batch_id=batch_id,
+                trace_id=tracer.trace_id if tracer else None)
+        add_lifecycle_spans(tracer, stamps, tenant=ts.tid or "default",
+                            **({"batch_id": batch_id} if batch_id else {}))
         return resp
+
+    def _timed_sim(self, fn):
+        """Run one sim dispatch with compile accounting: when the call grew
+        a jit cache, its wall clock is (almost entirely) XLA compilation —
+        counted as `sim_compiles_total` / `sim_compile_seconds_total` so
+        compile stalls on the serving path are a first-class series, not a
+        mystery latency spike."""
+        c0 = self._sim_cache_size()
+        t0 = _time.perf_counter()
+        out = fn()
+        grew = self._sim_cache_size() - c0
+        if grew > 0:
+            self.registry.counter(
+                "sim_compiles_total",
+                help="XLA programs compiled by serving dispatches").inc(grew)
+            self.registry.counter(
+                "sim_compile_seconds_total",
+                help="Wall clock of serving dispatches that compiled "
+                     "(≈ compile time)").inc(_time.perf_counter() - t0)
+        return out
+
+    def _note_reject(self, tenant: str, e: QueueFull) -> None:
+        """Admission-reject accounting, split by WHY (ISSUE 8 fix: a
+        RESOURCE_EXHAUSTED previously carried retry-after but no metric
+        distinguishing queue overload from a full tenant table)."""
+        self.registry.counter(
+            "admission_rejects_total",
+            help="Requests rejected RESOURCE_EXHAUSTED, by reason "
+                 "(queue-full = transient overload; tenant-cap = resident "
+                 "world table full, retry alone never helps)",
+        ).inc(reason=e.reason)
+        with self._events_lock:
+            self.events.emit("AdmissionReject", tenant or "default",
+                             e.reason, message=str(e), now=_time.time())
 
     def _sim_cache_size(self) -> int:
         from kubernetes_autoscaler_tpu.ops import autoscale_step as a
@@ -475,17 +644,34 @@ class SimulatorService:
         kind = tickets[0].kind
         key = tickets[0].key
         t0 = _time.perf_counter_ns()
+        for t in tickets:
+            t.stamps.stack0 = t0
         members = [t.lane for t in tickets]
         lanes_list = b.pad_lanes(members, self.batch_lanes)
         stack_key = (key, tuple(t.fp for t in tickets))
+
+        def _stack(build):
+            # h2d byte accounting rides the cache-miss path only: a hit
+            # re-uses the resident device pytree and uploads nothing
+            self.registry.counter(
+                "device_transfer_bytes_total",
+                help="Host↔device bytes moved by the serving path, by "
+                     "direction (h2d = stacked-world uploads on stack-cache "
+                     "misses; d2h = batched result fetches)",
+            ).inc(b.stacked_nbytes(lanes_list), direction="h2d")
+            return build()
+
         with self._recompile_charge([self._tenant(t.tenant)
                                      for t in tickets]):
             if kind == "up":
                 nt, gt, pt, gr = self._stack_cache.get(
-                    stack_key, lambda: b.stack_up_lanes(lanes_list))
+                    stack_key, lambda: _stack(
+                        lambda: b.stack_up_lanes(lanes_list)))
+                stack1 = _time.perf_counter_ns()
                 _, _, _, max_new_nodes, strategy = key
-                out = a.scale_up_sim_batch(nt, gt, pt, gr, self.dims,
-                                           max_new_nodes, strategy)
+                out = self._timed_sim(
+                    lambda: a.scale_up_sim_batch(nt, gt, pt, gr, self.dims,
+                                                 max_new_nodes, strategy))
                 fetch_tree = {
                     "best": out.best,
                     "node_count": out.estimate.node_count,
@@ -499,11 +685,14 @@ class SimulatorService:
                 assemble = lambda host: b.assemble_up(host, members)  # noqa: E731
             else:
                 nt, gt, pt = self._stack_cache.get(
-                    stack_key, lambda: b.stack_down_lanes(lanes_list)[:3])
+                    stack_key, lambda: _stack(
+                        lambda: b.stack_down_lanes(lanes_list)[:3]))
+                stack1 = _time.perf_counter_ns()
                 th = jnp.asarray(
                     [ln.threshold for ln in lanes_list], jnp.float32)
-                out = a.scale_down_sim_batch(nt, gt, pt, th,
-                                             max_zones=self.dims.max_zones)
+                out = self._timed_sim(
+                    lambda: a.scale_down_sim_batch(
+                        nt, gt, pt, th, max_zones=self.dims.max_zones))
                 fetch_tree = {
                     "eligible": out.eligible,
                     "drainable": out.removal.drainable,
@@ -521,7 +710,23 @@ class SimulatorService:
                  "padding)",
             buckets=tuple(float(x) for x in range(1, 33)),
         ).observe(float(occupancy), kind=kind)
+        # occupancy over time as a scrapeable gauge (device-utilization
+        # accounting): what fraction of the compiled lane width carried
+        # real member tenants on the latest dispatch
+        self.registry.gauge(
+            "batch_occupancy_ratio",
+            help="Members / compiled lanes of the latest coalesced "
+                 "dispatch (1.0 = no padding waste)",
+        ).set(occupancy / self.batch_lanes, kind=kind)
+        d2h0 = self.phases.events.get("batched_fetch_bytes_moved", 0)
         fetch = fetch_pytree_async(fetch_tree, phases=self.phases)
+        self.registry.counter("device_transfer_bytes_total").inc(
+            self.phases.events.get("batched_fetch_bytes_moved", 0) - d2h0,
+            direction="d2h")
+        dispatched_ns = _time.perf_counter_ns()
+        for t in tickets:
+            t.stamps.stack1 = stack1
+            t.stamps.dispatched = dispatched_ns
         batch_info = {
             "batch_id": uuid.uuid4().hex[:8],
             "kind": kind,
@@ -550,7 +755,163 @@ class SimulatorService:
             "queue_rejected": self._queue.rejected if self._queue else 0,
             "recompiles_per_new_tenant": self.registry.gauge(
                 "recompiles_per_new_tenant").value(),
+            "dispatch_gap": self.gap_stats(),
+            "tail_sampler": self.tail.stats(),
         }
+
+    def gap_stats(self) -> dict:
+        """Dispatch-gap summary: the `pipelined`+`stall` population is the
+        device-idle-while-work-existed distribution (≈0 under pipelining);
+        `idle` is arrival-bound and summarized separately."""
+        gaps = list(self.gaps)
+        busy = [g for g, c in gaps if c in ("pipelined", "stall")]
+        idle = [g for g, c in gaps if c == "idle"]
+        stalls = sum(1 for _, c in gaps if c == "stall")
+        return {
+            "dispatches": len(gaps),
+            "p50_ms": (round(float(np.percentile(busy, 50)) * 1000, 4)
+                       if busy else None),
+            "p99_ms": (round(float(np.percentile(busy, 99)) * 1000, 4)
+                       if busy else None),
+            "stalls": stalls,
+            "idle_s_total": round(sum(idle), 4),
+        }
+
+    def tenant_stats(self, tid: str) -> dict:
+        """One tenant's serving view (statusz row)."""
+        ts = self._tenant_peek(tid)
+        if ts is None:
+            return {}
+        lat = list(ts.lat_ms)
+        pct = (lambda q: round(float(np.percentile(lat, q)), 3)) \
+            if lat else (lambda q: None)
+        return {
+            "tenant": tid or "default",
+            "shape_class": ts.shape_class.key if ts.shape_class else "-",
+            "version": ts.state.version,
+            "requests": len(lat),
+            "p50_ms": pct(50), "p95_ms": pct(95), "p99_ms": pct(99),
+            "slo_budget_ms": self.slo.get(tid) or None,
+            "slo_breaches": ts.slo_breaches,
+            "last_breach_trace": ts.last_breach_trace or None,
+        }
+
+    def statusz(self) -> str:
+        """Human-readable serving snapshot (the sidecar's /statusz analog,
+        served by the Statusz RPC): tenant table with latency percentiles
+        and SLO state, queue + reject accounting, shape-class hit rates,
+        batching/occupancy/dispatch-gap figures, tail-sampler budget, and
+        the last-breach exemplar trace ids — the one-page view an operator
+        reads before opening /metrics or a Perfetto dump."""
+        lines = [f"katpu-sidecar statusz @ {_time.strftime('%Y-%m-%dT%H:%M:%SZ', _time.gmtime())}"]
+        with self._tenants_lock:
+            tids = sorted(self._tenants)
+        lines.append(f"tenants: {len(tids)} active (cap {self.max_tenants})")
+        lines.append("  tenant          class            ver   reqs   "
+                     "p50ms    p95ms    p99ms  slo_ms  breaches  last_breach")
+        for tid in tids:
+            st = self.tenant_stats(tid)
+            if not st:
+                continue
+            lines.append(
+                f"  {st['tenant']:<15} {st['shape_class']:<16} "
+                f"{st['version']:>4}  {st['requests']:>5}  "
+                f"{st['p50_ms'] if st['p50_ms'] is not None else '-':>7}  "
+                f"{st['p95_ms'] if st['p95_ms'] is not None else '-':>7}  "
+                f"{st['p99_ms'] if st['p99_ms'] is not None else '-':>7}  "
+                f"{st['slo_budget_ms'] or '-':>6}  {st['slo_breaches']:>8}  "
+                f"{st['last_breach_trace'] or '-'}")
+        q = self._queue
+        rej = self.registry.counter("admission_rejects_total")
+        lines.append(
+            f"queue: depth={q.depth if q else 0} "
+            f"submitted={q.submitted if q else 0} "
+            f"rejected=[queue-full={rej.value(reason='queue-full'):.0f} "
+            f"tenant-cap={rej.value(reason='tenant-cap'):.0f}]")
+        lines.append(
+            f"shape classes: {len(self.ladder.seen())} seen, "
+            f"hits={self.ladder.hits} misses={self.ladder.misses} "
+            f"hit_rate={self.ladder.hit_rate():.3f}")
+        gs = self.gap_stats()
+        occ = list(self.occupancies)
+        lines.append(
+            f"batching: lanes={self.batch_lanes} "
+            f"windows={self._scheduler.windows if self._scheduler else 0} "
+            f"batches={self._scheduler.batches if self._scheduler else 0} "
+            f"occupancy_p50={float(np.percentile(occ, 50)) if occ else '-'} "
+            f"dispatch_gap_p50_ms={gs['p50_ms'] if gs['p50_ms'] is not None else '-'} "
+            f"stalls={gs['stalls']} idle_s={gs['idle_s_total']}")
+        tstats = self.tail.stats()
+        lines.append(
+            f"tail sampler: offered={tstats['offered']} "
+            f"retained={tstats['retained']} evicted={tstats['evicted']} "
+            f"held={tstats['held']} reasons={json.dumps(tstats['reasons'], sort_keys=True)}")
+        comp = self.registry.counter("sim_compiles_total")
+        xfer = self.registry.counter("device_transfer_bytes_total")
+        lines.append(
+            f"device: compiles={comp.value():.0f} "
+            f"compile_s={self.registry.counter('sim_compile_seconds_total').value():.3f} "
+            f"h2d_bytes={xfer.value(direction='h2d'):.0f} "
+            f"d2h_bytes={xfer.value(direction='d2h'):.0f}")
+        events = self.events.snapshot()
+        if events:
+            lines.append(f"events ({len(events)} stored, newest last):")
+            for ev in events[-8:]:
+                lines.append(f"  {ev['kind']} {ev['object']}: "
+                             f"{ev['reason']} x{ev['count']}")
+        return "\n".join(lines) + "\n"
+
+    def _on_complete(self, method: str, tenant: str, dt_s: float,
+                     tracer: "trace.Tracer | None",
+                     error: Exception | None = None) -> str | None:
+        """Per-request completion hook (traced_call): feed the tail
+        sampler, check the tenant's SLO budget, and return the retained
+        exemplar trace id (if any) for the latency histogram bucket.
+
+        A breach bumps `tenant_slo_breaches_total{tenant}` and persists a
+        TENANT-SCOPED dump: only this tenant's retained request traces
+        (TailSampler.tenant_traces), never the whole ring — the serving
+        analog of the FlightRecorder's loop-scoped breach dump."""
+        ts = self._tenant_peek(tenant)
+        breached = self.slo.breached(tenant, dt_s)
+        reason = None
+        if error is not None:
+            reason = ("backpressure" if isinstance(error, QueueFull)
+                      else "failed")
+        elif breached:
+            reason = "slo_breach"
+        exemplar = None
+        if tracer is not None:
+            snap = tracer.snapshot()
+            snap["tenant"] = tenant
+            snap["method"] = method
+            exemplar = self.tail.offer(snap, dt_s, reason)
+        else:
+            self.tail.observe_latency(dt_s)
+        if breached:
+            self.registry.counter(
+                "tenant_slo_breaches_total",
+                help="Requests exceeding their tenant's latency budget "
+                     "(sidecar/lifecycle.SloBudgets)",
+            ).inc(tenant=tenant or "default")
+            if ts is not None:
+                ts.slo_breaches += 1
+                if exemplar:
+                    ts.last_breach_trace = exemplar
+            if self.slo_dump_dir and tracer is not None:
+                try:
+                    import os
+
+                    os.makedirs(self.slo_dump_dir, exist_ok=True)
+                    self.tail.dump(
+                        os.path.join(
+                            self.slo_dump_dir,
+                            f"slo-{tenant or 'default'}-{tracer.trace_id}"
+                            f".trace.json"),
+                        self.tail.tenant_traces(tenant))
+                except OSError:
+                    pass   # a full disk must never sink the RPC
+        return exemplar
 
     def health(self) -> dict:
         return {"version": self.state.version, "error": "",
@@ -574,7 +935,8 @@ class SimulatorService:
 
 
 def traced_call(service: SimulatorService, method: str, fn,
-                trace_id: str | None = None, tenant: str = ""):
+                trace_id: str | None = None, tenant: str = "",
+                sample: bool = True):
     """Run one RPC body under the sidecar's observability contract: RPC
     count/duration always land in `service.registry` (labelled with the
     tenant when one was identified — stale tenant labels are zeroed by
@@ -582,11 +944,21 @@ def traced_call(service: SimulatorService, method: str, fn,
     metadata, the body runs under a child Tracer with the SAME id and the
     closed spans come back as the `(result, trace_group)` second element —
     the shape `metrics/trace.Tracer.add_remote_spans` merges client-side,
-    so one trace covers both processes."""
+    so one trace covers both processes.
+
+    With `sample` (simulation RPCs), the body ALWAYS runs under a tracer —
+    a fresh server-side id when the client stamped none — and the completed
+    trace is OFFERED to the tail sampler (service._on_complete): slow /
+    failed / backpressured / SLO-breaching requests are retained with their
+    full lifecycle span tree, and the retained trace id lands as the
+    latency histogram bucket's exemplar. Unsampled requests cost one
+    snapshot + a reservoir append."""
+    own_id = sample and trace_id is None
     tracer = (trace.Tracer(trace_id=trace_id, process="sidecar")
-              if trace_id else None)
+              if (trace_id or sample) else None)
     prev = trace.activate(tracer) if tracer is not None else None
     t0 = _time.perf_counter()
+    error: Exception | None = None
     try:
         if tracer is not None:
             idx = tracer.begin(f"sidecar/{method}", cat="sidecar",
@@ -599,10 +971,16 @@ def traced_call(service: SimulatorService, method: str, fn,
                     idx, version=ts.state.version if ts is not None else 0)
         else:
             out = fn()
+    except Exception as e:
+        error = e
+        raise
     finally:
         if tracer is not None:
             trace.activate(prev)
         dt = _time.perf_counter() - t0
+        exemplar = (service._on_complete(method, tenant, dt, tracer,
+                                         error=error)
+                    if sample else None)
         labels = {"method": method}
         if tenant:
             labels["tenant"] = tenant
@@ -610,9 +988,11 @@ def traced_call(service: SimulatorService, method: str, fn,
             "rpc_total", help="RPCs served, by method").inc(**labels)
         service.registry.histogram(
             "rpc_duration_seconds", help="Server-side RPC wall clock",
-            buckets=PHASE_BUCKETS).observe(dt, **labels)
+            buckets=PHASE_BUCKETS).observe(dt, exemplar=exemplar, **labels)
     group = None
-    if tracer is not None:
+    if tracer is not None and not own_id:
+        # span report-back only when the CLIENT is tracing (it stamped the
+        # id); a server-side sampling tracer stays server-side
         snap = tracer.snapshot()
         group = {"trace_id": snap["trace_id"], "process": "sidecar",
                  "spans": snap["spans"]}
@@ -661,9 +1041,17 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
         return json.dumps({"error": str(e), "code": "RESOURCE_EXHAUSTED",
                            "retry_after_ms": e.retry_after_ms}).encode()
 
-    def _json_method(name: str, fn, parse_params: bool):
+    def _json_method(name: str, fn, parse_params: bool, sample: bool = True):
         def handler(request: bytes, context):
             tenant = _meta_of(context, TENANT_ID_HEADER) or ""
+            budget = _meta_of(context, SLO_BUDGET_MS_HEADER)
+            if budget:
+                # the client declares its own loop deadline as the tenant's
+                # latency budget (last write wins)
+                try:
+                    service.slo.set(tenant, float(budget))
+                except ValueError:
+                    pass
             try:
                 if parse_params:
                     raw = json.loads(request.decode() or "{}")
@@ -679,7 +1067,7 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
                 resp, group = traced_call(
                     service, name, body,
                     trace_id=_meta_of(context, TRACE_ID_HEADER),
-                    tenant=tenant)
+                    tenant=tenant, sample=sample)
                 if group is not None and isinstance(resp, dict):
                     resp["trace"] = group
                 return json.dumps(resp).encode()
@@ -692,14 +1080,22 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
 
     def _metricz(request: bytes, context):
         text, _ = traced_call(service, "Metricz", service.metricz,
-                              trace_id=_meta_of(context, TRACE_ID_HEADER))
+                              trace_id=_meta_of(context, TRACE_ID_HEADER),
+                              sample=False)
+        return text.encode()
+
+    def _statusz(request: bytes, context):
+        text, _ = traced_call(service, "Statusz", service.statusz,
+                              trace_id=_meta_of(context, TRACE_ID_HEADER),
+                              sample=False)
         return text.encode()
 
     ident = lambda b: b
 
     method_handlers = {
         "ApplyDelta": grpc.unary_unary_rpc_method_handler(
-            _json_method("ApplyDelta", service.apply_delta, False),
+            _json_method("ApplyDelta", service.apply_delta, False,
+                         sample=False),
             request_deserializer=ident, response_serializer=ident),
         "ScaleUpSim": grpc.unary_unary_rpc_method_handler(
             _json_method("ScaleUpSim", service.scale_up_sim, True),
@@ -709,10 +1105,12 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
             request_deserializer=ident, response_serializer=ident),
         "Health": grpc.unary_unary_rpc_method_handler(
             _json_method("Health", lambda _b, tenant="": service.health(),
-                         False),
+                         False, sample=False),
             request_deserializer=ident, response_serializer=ident),
         "Metricz": grpc.unary_unary_rpc_method_handler(
             _metricz, request_deserializer=ident, response_serializer=ident),
+        "Statusz": grpc.unary_unary_rpc_method_handler(
+            _statusz, request_deserializer=ident, response_serializer=ident),
     }
     from concurrent.futures import ThreadPoolExecutor
 
@@ -766,13 +1164,22 @@ class SimulatorClient:
                  tenant: str = "",
                  rpc_timeout_s: float = 30.0,
                  retry_budget_s: float = 10.0,
-                 retry_attempts: int = 5):
+                 retry_attempts: int = 5,
+                 slo_budget_ms: float = 0.0):
         import grpc
 
         self.tenant = tenant
         self.rpc_timeout_s = rpc_timeout_s
         self.retry_budget_s = retry_budget_s
         self.retry_attempts = retry_attempts
+        # declared per-tenant latency budget (wire.SLO_BUDGET_MS_HEADER):
+        # the server counts tenant_slo_breaches_total against it and keeps
+        # tenant-scoped breach dumps
+        self.slo_budget_ms = float(slo_budget_ms)
+        # server-side lifecycle block of the most recent sim RPC (queue vs
+        # dispatch vs harvest decomposition; RunOnce consumers read it to
+        # separate server time from network time)
+        self.last_lifecycle: dict | None = None
         if cert_file:
             with open(cert_file, "rb") as f:
                 root = f.read()
@@ -822,6 +1229,8 @@ class SimulatorClient:
             md.append((TRACE_ID_HEADER, tracer.trace_id))
         if self.tenant:
             md.append((TENANT_ID_HEADER, self.tenant))
+        if self.slo_budget_ms > 0:
+            md.append((SLO_BUDGET_MS_HEADER, str(self.slo_budget_ms)))
 
         def invoke():
             deadline = _time.monotonic() + self.retry_budget_s
@@ -847,13 +1256,28 @@ class SimulatorClient:
             return invoke()
 
     def _call_json(self, method: str, payload: bytes) -> dict:
+        t0 = _time.perf_counter()
         resp = json.loads(self._call(method, payload))
+        rpc_wall_ms = (_time.perf_counter() - t0) * 1000.0
         # the server reports its child spans back in the response; merge
         # them so ONE trace covers both processes
         tracer = trace.current_tracer()
         group = resp.pop("trace", None) if isinstance(resp, dict) else None
         if tracer is not None and group is not None:
             tracer.add_remote_spans(group)
+        # the server's lifecycle decomposition: annotate the caller's trace
+        # so a RunOnce timeline shows server-side queue time DISTINCT from
+        # network time (client rpc wall minus server e2e ≈ wire +
+        # serialization). Kept off the returned payload — consumers read
+        # `last_lifecycle`, response dicts stay sim results only.
+        lc = resp.pop("lifecycle", None) if isinstance(resp, dict) else None
+        if lc is not None:
+            lc["net_ms"] = round(max(rpc_wall_ms - lc.get("e2e_ms", 0.0), 0.0), 4)
+            self.last_lifecycle = lc
+            if tracer is not None:
+                tracer.annotate(
+                    server_e2e_ms=lc.get("e2e_ms"), net_ms=lc["net_ms"],
+                    server_queue_ms=lc.get("phases_ms", {}).get("queue"))
         return resp
 
     def apply_delta(self, writer: DeltaWriter) -> dict:
@@ -871,6 +1295,11 @@ class SimulatorClient:
     def metricz(self) -> str:
         """Prometheus text of the sidecar's Registry (rpc counters etc.)."""
         return self._call("Metricz", b"").decode()
+
+    def statusz(self) -> str:
+        """Human-readable serving snapshot (tenant table, queue, shape
+        classes, dispatch gaps, tail-sampler budget)."""
+        return self._call("Statusz", b"").decode()
 
 
 def main(argv=None):

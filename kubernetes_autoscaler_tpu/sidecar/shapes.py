@@ -69,11 +69,17 @@ class ShapeLadder:
         self.hits = 0
         self.misses = 0
 
-    def classify(self, n_nodes: int, n_groups: int, n_pods: int) -> ShapeClass:
+    def classify(self, n_nodes: int, n_groups: int, n_pods: int,
+                 tenant: str = "") -> ShapeClass:
         """Assign counts to a class and account the hit/miss. Counts within
         a rung re-classify to the SAME class — count churn (pods added or
         removed inside the rung) is always a hit, never a recompile, the
-        same stability contract as the delta-scatter buckets."""
+        same stability contract as the delta-scatter buckets.
+
+        `tenant` additionally labels the registry series so a departed
+        tenant's classification history can be stale-zeroed by
+        `drop_tenant` (the rpc_total convention); the default tenant keeps
+        label-free series (it is never dropped)."""
         sc = ShapeClass(
             nodes=rung(n_nodes, self.node_bucket),
             groups=rung(max(n_groups, 1), self.group_bucket),
@@ -89,12 +95,15 @@ class ShapeLadder:
         if self._registry is not None:
             name = ("shape_class_hit_total" if hit
                     else "shape_class_miss_total")
+            labels = {"shape_class": sc.key}
+            if tenant:
+                labels["tenant"] = tenant
             self._registry.counter(
                 name,
                 help="World classifications landing in an already-seen "
                      "(hit) vs a brand-new (miss) padded shape class — a "
                      "miss precedes exactly one batched-program compile",
-            ).inc(shape_class=sc.key)
+            ).inc(**labels)
         return sc
 
     def seen(self) -> frozenset[ShapeClass]:

@@ -44,6 +44,13 @@ TENANT_ID_HEADER = "katpu-tenant-id"
 # trailing metadata under this key (milliseconds, decimal string).
 RETRY_AFTER_MS_HEADER = "katpu-retry-after-ms"
 
+# Per-tenant SLO budget declaration (milliseconds, decimal string): a client
+# that knows its own loop deadline stamps it here; the server registers it
+# as the tenant's latency budget (sidecar/lifecycle.SloBudgets) and counts
+# `tenant_slo_breaches_total{tenant}` against it — metadata only, the KAD1
+# bytes stay SLO-free like trace/tenant identity above.
+SLO_BUDGET_MS_HEADER = "katpu-slo-budget-ms"
+
 UPSERT_NODE, DELETE_NODE, UPSERT_POD, DELETE_POD = 1, 2, 3, 4
 
 _EFFECTS = {NO_SCHEDULE: 0, NO_EXECUTE: 1}

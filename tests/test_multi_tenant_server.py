@@ -82,6 +82,12 @@ def serial_reference(seed: int, params_up=None, params_down=None):
         "max_new_nodes": 16, "node_groups": NGS})))
     down = svc.scale_down_sim(SimParams(**(params_down or {
         "threshold": 0.5})))
+    svc.close()
+    # the lifecycle block is observability metadata, not a sim result —
+    # the client strips it off responses (SimulatorClient.last_lifecycle);
+    # direct service calls carry it, so strip for the bit-identity compare
+    up.pop("lifecycle", None)
+    down.pop("lifecycle", None)
     return up, down
 
 

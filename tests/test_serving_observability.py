@@ -1,0 +1,614 @@
+"""Serving-grade observability for the multi-tenant sidecar (ISSUE 8):
+request-lifecycle decomposition (phases sum to e2e on both the serial and
+the batched path, on all three surfaces), tail-based trace sampling with
+histogram-bucket exemplars, per-tenant SLO budgets with tenant-scoped
+breach dumps, device-utilization accounting (dispatch gaps, occupancy,
+transfer bytes), admission-reject reason split, drop_tenant stale-label
+sweeps over every serving family, Metricz ≡ /metrics parity, and the
+multi-tenant writer-vs-scraper race the per-metric locks must survive."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kubernetes_autoscaler_tpu.metrics import metrics as m
+from kubernetes_autoscaler_tpu.metrics import trace
+from kubernetes_autoscaler_tpu.sidecar import native_api
+from kubernetes_autoscaler_tpu.sidecar.lifecycle import (
+    LIFECYCLE_PHASES,
+    SloBudgets,
+    Stamps,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_api.available(), reason="native codec not buildable"
+)
+
+MIB = 1024 * 1024
+
+NGS = [
+    {"id": "ng-big",
+     "template": {"name": "t", "capacity": {"cpu": 4.0,
+                                            "memory": 8192 * MIB,
+                                            "pods": 110}},
+     "max_new": 10, "price": 1.0},
+]
+
+
+def tenant_delta(seed: int, n_nodes: int = 2, n_pods: int = 6):
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    w = DeltaWriter()
+    for i in range(n_nodes):
+        w.upsert_node(build_test_node(
+            f"n{seed}-{i}", cpu_milli=2000 + 1000 * (i % 2), mem_mib=4096))
+    for i in range(n_pods):
+        w.upsert_pod(build_test_pod(
+            f"p{seed}-{i}", cpu_milli=400 + 100 * (seed % 3), mem_mib=256,
+            owner_name=f"rs{seed}"))
+    return w
+
+
+# ---- TailSampler --------------------------------------------------------
+
+
+def test_tail_sampler_warmup_guard_and_slow_tail():
+    """Nothing classifies as slow before min_observations (a cold server
+    must not squat the retention budget on warmup compiles); after the
+    reservoir fills, only the slow quantile retains."""
+    ts = trace.TailSampler(capacity=8, slow_quantile=0.9,
+                           min_observations=10)
+    for i in range(9):
+        assert ts.offer({"trace_id": f"w{i}"}, 10.0 + i) is None
+    # reservoir holds 9 fast-ish, all ≈10s; a 10th far-tail observation
+    # classifies as slow and is retained with its reason recorded
+    tid = ts.offer({"trace_id": "slowpoke"}, 100.0)
+    assert tid == "slowpoke"
+    # a clearly-fast request against the now-warm reservoir is dropped
+    assert ts.offer({"trace_id": "fast"}, 0.001) is None
+    st = ts.stats()
+    assert st["offered"] == 11 and st["retained"] == 1
+    assert st["reasons"] == {"slow": 1}
+    assert [s["retain_reason"] for s in ts.traces()] == ["slow"]
+
+
+def test_tail_sampler_always_keep_eviction_and_tenant_filter(tmp_path):
+    """failed/backpressure/slo_breach retain regardless of latency; the
+    ring is bounded with eviction accounting; tenant_traces filters to one
+    tenant's spans (the tenant-scoped SLO dump content); the dump parses
+    as a Chrome trace carrying only retained ids + reasons."""
+    ts = trace.TailSampler(capacity=2, min_observations=10_000)
+    for i, reason in enumerate(["failed", "backpressure", "slo_breach"]):
+        tid = ts.offer({"trace_id": f"r{i}", "tenant": f"t{i % 2}",
+                        "spans": [], "wall0_us": 0}, 0.001, reason)
+        assert tid == f"r{i}"
+    st = ts.stats()
+    assert st["retained"] == 3 and st["evicted"] == 1 and st["held"] == 2
+    assert set(st["reasons"]) == {"failed", "backpressure", "slo_breach"}
+    # capacity 2: r0 evicted, r1 (t1) + r2 (t0) held
+    assert [s["trace_id"] for s in ts.tenant_traces("t0")] == ["r2"]
+    path = str(tmp_path / "tail.trace.json")
+    ts.dump(path)
+    doc = json.load(open(path))
+    assert set(doc["otherData"]["trace_ids"]) == {"r1", "r2"}
+    assert doc["otherData"]["retain_reasons"]["r2"] == "slo_breach"
+    assert doc["otherData"]["sampler"]["evicted"] == 1
+
+
+# ---- histogram exemplars ------------------------------------------------
+
+
+def test_histogram_exemplars_exposed_and_stale_zeroed():
+    """An observation carrying an exemplar lands it on its bucket line in
+    OpenMetheus-style `# {trace_id="..."} v` form; plain observations leave
+    no exemplar (every exposed id resolves to a RETAINED trace); and
+    zero_matching sweeps exemplars with the counts."""
+    reg = m.Registry(prefix="t")
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05, tenant="a")                        # no exemplar
+    h.observe(0.5, exemplar="abc123", tenant="a")      # bucket le=1.0
+    ex = h.exemplars(tenant="a")
+    assert ex == {1: ("abc123", 0.5)}
+    text = reg.expose_text()
+    line = [l for l in text.splitlines() if 'le="1.0"' in l][0]
+    assert '# {trace_id="abc123"} 0.5' in line
+    assert 'le="0.1"' in text and "abc123" not in \
+        [l for l in text.splitlines() if 'le="0.1"' in l][0]
+    h.zero_matching(tenant="a")
+    assert h.exemplars(tenant="a") == {}
+    assert "abc123" not in reg.expose_text()
+
+
+# ---- lifecycle decomposition -------------------------------------------
+
+
+def _phase_sum_ratio(lc: dict) -> float:
+    return sum(lc["phases_ms"].values()) / lc["e2e_ms"] if lc["e2e_ms"] \
+        else 1.0
+
+
+@pytest.fixture(scope="module")
+def serving(tmp_path_factory):
+    """One batched gRPC server shared by the lifecycle / SLO-breach / race
+    tests (per-tenant labels keep them order-independent; one compile of
+    the lanes=2 batched programs instead of three)."""
+    pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimulatorClient,
+        SimulatorService,
+        make_grpc_server,
+    )
+
+    dump_dir = str(tmp_path_factory.mktemp("slo"))
+    svc = SimulatorService(node_bucket=16, group_bucket=16,
+                           batch_lanes=2, batch_window_ms=5.0,
+                           slo_dump_dir=dump_dir)
+    server, port = make_grpc_server(svc, port=0)
+    server.start()
+    client = lambda t, **kw: SimulatorClient(port, tenant=t, **kw)  # noqa: E731
+    yield svc, client, dump_dir
+    server.stop(None)
+    svc.close()
+
+
+def test_lifecycle_serial_path_phases_sum_to_e2e():
+    """The serial (non-batched) path stamps the subset that exists there —
+    encode, dispatch, harvest — still contiguous, still summing to e2e."""
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimParams,
+        SimulatorService,
+    )
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16)
+    try:
+        assert svc.apply_delta(tenant_delta(0).payload())["error"] == ""
+        up = svc.scale_up_sim(SimParams(max_new_nodes=16, node_groups=NGS))
+        lc = up["lifecycle"]
+        assert set(lc["phases_ms"]) == {"encode", "dispatch", "harvest"}
+        assert lc["e2e_ms"] > 0
+        assert abs(_phase_sum_ratio(lc) - 1.0) <= 0.05, lc
+        # the per-tenant histogram surface saw the same phases (default
+        # tenant ⇒ label-free series)
+        h = svc.registry.histogram("request_phase_seconds")
+        for ph in ("encode", "dispatch", "harvest"):
+            assert h.count(phase=ph) == 1, ph
+    finally:
+        svc.close()
+
+
+def test_lifecycle_batched_path_all_phases_on_three_surfaces(serving):
+    """Batched requests decompose into the full 8-phase chain; the sum
+    matches e2e within tolerance; the histograms are tenant-labelled; and
+    the client's trace gains the closed `lifecycle` span tree (the third
+    surface — the response block — is what we read the phases from)."""
+    svc, client, _ = serving
+    c = client("lc")
+    assert c.apply_delta(tenant_delta(0))["error"] == ""
+    tracer = trace.Tracer(process="client")
+    prev = trace.activate(tracer)
+    try:
+        idx = tracer.begin("loop", cat="loop")
+        resp = c.scale_up_sim(max_new_nodes=16, node_groups=NGS)
+        tracer.end(idx)
+    finally:
+        trace.activate(prev)
+    assert "lifecycle" not in resp          # stripped off sim results
+    lc = c.last_lifecycle
+    assert lc is not None
+    assert set(lc["phases_ms"]) <= set(LIFECYCLE_PHASES)
+    assert {"queue", "stack", "dispatch", "harvest"} <= \
+        set(lc["phases_ms"])
+    assert abs(_phase_sum_ratio(lc) - 1.0) <= 0.05, lc
+    assert lc["net_ms"] >= 0                # client-derived wire time
+    # surface 2: tenant-labelled phase histograms
+    h = svc.registry.histogram("request_phase_seconds")
+    assert h.count(phase="queue", tenant="lc") == 1
+    # surface 3: the server's lifecycle span tree merged into the
+    # client trace (one parent + per-phase children)
+    snap = tracer.snapshot()
+    remote = [s["name"] for g in snap["remote"] for s in g["spans"]]
+    assert "lifecycle" in remote
+    assert any(n.startswith("lifecycle/") for n in remote)
+
+
+# ---- SLO budgets + tenant-scoped breach dumps + exemplars ---------------
+
+
+def test_slo_breach_counts_dumps_tenant_scoped_and_links_exemplar(serving):
+    """A forced breach (impossible budget, declared via the wire header)
+    bumps tenant_slo_breaches_total{tenant}, persists a dump holding ONLY
+    that tenant's retained traces, and the rpc latency histogram carries
+    the retained trace id as its bucket exemplar — /metrics links straight
+    to the Perfetto evidence."""
+    svc, client, dump_dir = serving
+    # tenant b serves happily within budget; tenant a declares an
+    # impossible one via SLO_BUDGET_MS_HEADER
+    cb = client("b", slo_budget_ms=60_000.0)
+    ca = client("a", slo_budget_ms=1e-6)
+    assert cb.apply_delta(tenant_delta(1))["error"] == ""
+    assert ca.apply_delta(tenant_delta(0))["error"] == ""
+    cb.scale_up_sim(max_new_nodes=16, node_groups=NGS)
+    ca.scale_up_sim(max_new_nodes=16, node_groups=NGS)
+    assert svc.slo.get("a") == pytest.approx(1e-6)
+    breaches = svc.registry.counter("tenant_slo_breaches_total")
+    assert breaches.value(tenant="a") == 1
+    assert breaches.value(tenant="b") == 0
+    # the breach retained the trace and exposed it as the exemplar on
+    # tenant a's latency bucket
+    retained = {s["trace_id"]: s for s in svc.tail.traces()}
+    st = svc.tenant_stats("a")
+    assert st["slo_breaches"] == 1
+    assert st["last_breach_trace"] in retained
+    assert retained[st["last_breach_trace"]]["retain_reason"] == \
+        "slo_breach"
+    ex = svc.registry.histogram("rpc_duration_seconds").exemplars(
+        method="ScaleUpSim", tenant="a")
+    assert any(tid == st["last_breach_trace"] for tid, _ in ex.values())
+    # the dump is TENANT-SCOPED: only tenant a's member traces
+    dumps = sorted(d for d in os.listdir(dump_dir) if d.startswith("slo-"))
+    assert len(dumps) == 1 and "slo-a-" in dumps[0]
+    doc = json.load(open(os.path.join(dump_dir, dumps[0])))
+    assert doc["otherData"]["trace_ids"] == [st["last_breach_trace"]]
+    for tid in doc["otherData"]["trace_ids"]:
+        assert retained[tid]["tenant"] == "a"
+    # statusz shows the breach row with its exemplar id
+    sz = svc.statusz()
+    assert st["last_breach_trace"] in sz and "breaches" in sz
+
+
+def test_slo_budgets_default_and_drop():
+    b = SloBudgets(default_ms=100.0, budgets={"a": 5.0})
+    assert b.breached("a", 0.006) and not b.breached("a", 0.004)
+    assert b.breached("unknown", 0.2) and not b.breached("unknown", 0.05)
+    b.drop("a")
+    assert b.get("a") == 100.0          # back to the default
+    assert SloBudgets(0.0).breached("x", 1e9) is False   # 0 disables
+
+
+# ---- drop_tenant stale-label sweep over every serving family ------------
+
+
+def test_drop_tenant_zeroes_all_serving_series():
+    """ISSUE 8 satellite: the sweep covers shape_class_hit/miss_total (these
+    lingered forever before), request_phase_seconds, and
+    tenant_slo_breaches_total — while other tenants' series survive."""
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimParams,
+        SimulatorService,
+        traced_call,
+    )
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16)
+    try:
+        for t, seed in (("a", 0), ("b", 1)):
+            assert svc.apply_delta(tenant_delta(seed).payload(),
+                                   tenant=t)["error"] == ""
+            traced_call(svc, "ScaleUpSim",
+                        lambda t=t: svc.scale_up_sim(
+                            SimParams(max_new_nodes=16, node_groups=NGS),
+                            tenant=t),
+                        tenant=t)
+        svc.slo.set("a", 1e-6)
+        traced_call(svc, "ScaleUpSim",
+                    lambda: svc.scale_up_sim(
+                        SimParams(max_new_nodes=16, node_groups=NGS),
+                        tenant="a"),
+                    tenant="a")
+        hits = svc.registry.counter("shape_class_hit_total")
+        phases = svc.registry.histogram("request_phase_seconds")
+        breaches = svc.registry.counter("tenant_slo_breaches_total")
+        sc = svc._tenant_peek("a").shape_class.key
+        assert hits.value(tenant="a", shape_class=sc) > 0
+        assert phases.count(phase="encode", tenant="a") == 2
+        assert breaches.value(tenant="a") == 1
+        assert svc.drop_tenant("a")
+        text = svc.registry.expose_text()
+        for family in ("shape_class_hit_total", "shape_class_miss_total",
+                       "request_phase_seconds", "tenant_slo_breaches_total",
+                       "rpc_total", "rpc_duration_seconds"):
+            for line in text.splitlines():
+                if line.startswith(f"katpu_sidecar_{family}") and \
+                        'tenant="a"' in line:
+                    assert float(line.rsplit(" ", 1)[1]) == 0.0, line
+        assert svc.slo.get("a") == 0.0           # budget dropped too
+        # tenant b untouched
+        assert hits.value(tenant="b", shape_class=sc) > 0
+        assert phases.count(phase="encode", tenant="b") == 1
+    finally:
+        svc.close()
+
+
+# ---- admission reject reason split --------------------------------------
+
+
+def test_reject_reason_tenant_cap_metric_and_event():
+    from kubernetes_autoscaler_tpu.sidecar.admission import QueueFull
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorService
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16, max_tenants=2)
+    try:
+        assert svc.apply_delta(tenant_delta(0).payload(),
+                               tenant="a")["error"] == ""   # + default = 2
+        with pytest.raises(QueueFull) as e:
+            svc.apply_delta(tenant_delta(1).payload(), tenant="c")
+        assert e.value.reason == "tenant-cap"
+        rej = svc.registry.counter("admission_rejects_total")
+        assert rej.value(reason="tenant-cap") == 1
+        assert rej.value(reason="queue-full") == 0
+        evs = [ev for ev in svc.events.snapshot()
+               if ev["kind"] == "AdmissionReject"]
+        assert evs and evs[0]["reason"] == "tenant-cap"
+        assert evs[0]["object"] == "c"
+    finally:
+        svc.close()
+
+
+def test_reject_reason_queue_full_metric_and_event():
+    pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar.admission import QueueFull
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimulatorClient,
+        SimulatorService,
+        make_grpc_server,
+    )
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16,
+                           batch_lanes=1, batch_window_ms=1.0,
+                           queue_depth=1)
+    server, port = make_grpc_server(svc, port=0)
+    server.start()
+    try:
+        c = SimulatorClient(port, tenant="t0")
+        assert c.apply_delta(tenant_delta(0))["error"] == ""
+        gate = threading.Event()
+        orig = svc._scheduler.dispatch
+        svc._scheduler.dispatch = lambda batch: (gate.wait(30),
+                                                 orig(batch))[1]
+        done = []
+        threads = [threading.Thread(
+            target=lambda: done.append(c.scale_down_sim(threshold=0.5)))
+            for _ in range(2)]
+        for th in threads:
+            th.start()
+            time.sleep(0.3)   # 1st gated in dispatch, 2nd fills the queue
+        try:
+            with pytest.raises(QueueFull) as e:
+                c.scale_down_sim(threshold=0.5)
+            assert e.value.reason == "queue-full"
+        finally:
+            gate.set()
+            for th in threads:
+                th.join(60)
+        assert len(done) == 2
+        rej = svc.registry.counter("admission_rejects_total")
+        assert rej.value(reason="queue-full") == 1
+        evs = [ev for ev in svc.events.snapshot()
+               if ev["kind"] == "AdmissionReject"]
+        assert evs and evs[0]["reason"] == "queue-full"
+    finally:
+        server.stop(None)
+        svc.close()
+
+
+# ---- device-utilization accounting --------------------------------------
+
+
+def test_dispatch_gap_causes_and_stats():
+    """pipelined/stall feed the dispatch_gap_seconds histogram (the ≈0
+    contract population); idle feeds device_idle_seconds_total — an idle
+    fleet must not read as a pipeline failure."""
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorService
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16)
+    try:
+        svc._note_gap(0.0, "pipelined")
+        svc._note_gap(0.004, "stall")
+        svc._note_gap(3.0, "idle")
+        gs = svc.gap_stats()
+        assert gs["dispatches"] == 3 and gs["stalls"] == 1
+        assert gs["p50_ms"] == pytest.approx(2.0, abs=0.1)   # busy pop only
+        assert gs["idle_s_total"] == pytest.approx(3.0)
+        h = svc.registry.histogram("dispatch_gap_seconds")
+        assert h.count(cause="pipelined") == 1
+        assert h.count(cause="stall") == 1
+        assert h.count(cause="idle") == 0
+        idle = svc.registry.counter("device_idle_seconds_total")
+        assert idle.value() == pytest.approx(3.0)
+    finally:
+        svc.close()
+
+
+def test_scheduler_reports_zero_gap_when_pipelined():
+    """Through the real BatchScheduler: when an unharvested batch is in
+    flight at dispatch time, the gap callback reports (0.0, "pipelined") —
+    the pipelining contract CI asserts ≈0 on the bench."""
+    from kubernetes_autoscaler_tpu.sidecar.admission import (
+        AdmissionQueue,
+        BatchScheduler,
+        Ticket,
+    )
+
+    gaps = []
+
+    class FakeInflight:
+        def harvest(self):
+            for t in self.tickets:
+                t.resolve(result={}, batch_info=None)
+
+    def dispatch(batch):
+        f = FakeInflight()
+        f.tickets = batch
+        return f
+
+    q = AdmissionQueue(max_depth=64)
+    # tickets queued BEFORE the scheduler wakes: the first window collects
+    # several, so in-window chunks dispatch with a fetch already in flight
+    tickets = [Ticket(tenant=f"t{i}", kind="up", key=("k",), lane=None,
+                      fp=(i,)) for i in range(6)]
+    for t in tickets:
+        q.submit(t)
+    sched = BatchScheduler(q, dispatch, lanes=1, window_s=0.001,
+                           gap_cb=lambda g, c: gaps.append((g, c)))
+    sched.start()
+    try:
+        for t in tickets:
+            t.wait(10)
+    finally:
+        sched.stop()
+    assert gaps, "gap callback never fired"
+    pipelined = [g for g, c in gaps if c == "pipelined"]
+    assert pipelined and all(g == 0.0 for g in pipelined)
+    assert not any(c == "stall" for _, c in gaps)
+
+
+# ---- Metricz ≡ /metrics parity + the scrape race ------------------------
+
+
+def test_metricz_and_process_metrics_expose_identical_series():
+    """An in-process sidecar registers its Registry with the /metrics mux
+    exposition: both surfaces serve the same family set and byte-identical
+    katpu_sidecar_* series rows; close() unregisters."""
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimParams,
+        SimulatorService,
+        traced_call,
+    )
+
+    before = set(m.expose_all_text().splitlines())
+    svc = SimulatorService(node_bucket=16, group_bucket=16)
+    try:
+        assert svc.apply_delta(tenant_delta(0).payload(),
+                               tenant="a")["error"] == ""
+        traced_call(svc, "ScaleUpSim",
+                    lambda: svc.scale_up_sim(
+                        SimParams(max_new_nodes=16, node_groups=NGS),
+                        tenant="a"),
+                    tenant="a")
+        metricz = svc.metricz()
+        mux = m.expose_all_text()
+
+        def families(text):
+            return {l.split()[2] for l in text.splitlines()
+                    if l.startswith("# TYPE")}
+
+        assert families(metricz) <= families(mux)   # mux may hold leaked
+        ours = set(svc.registry.expose_text().splitlines())
+        assert families(svc.registry.expose_text()) <= families(metricz)
+        # every series row of THIS registry appears verbatim on BOTH
+        # surfaces (other live registries may add rows of their own)
+        assert ours <= set(metricz.splitlines())
+        assert ours <= set(mux.splitlines())
+        assert any("rpc_total" in r and 'tenant="a"' in r for r in ours)
+    finally:
+        svc.close()
+    # close() unregistered THIS registry: the mux exposition is back to
+    # (at most) what it served before, minus nothing of ours
+    after = set(m.expose_all_text().splitlines())
+    assert not any('tenant="a"' in l and "request_phase_seconds" in l
+                   for l in after - before)
+
+
+def test_concurrent_scrape_vs_batched_writers_race(serving):
+    """ISSUE 8 satellite: batched dispatches mutate tenant-labelled
+    histograms (phase observations, exemplars, occupancy) while Metricz
+    and the /metrics mux scrape concurrently — the per-metric locks from
+    PR 3 must yield exception-free, parseable expositions throughout."""
+    svc, client, _ = serving
+    errors: list = []
+    stop = threading.Event()
+    try:
+        clients = {t: client(t) for t in ("r1", "r2", "r3")}
+        for i, (t, c) in enumerate(sorted(clients.items())):
+            assert c.apply_delta(tenant_delta(i))["error"] == ""
+
+        def writer(t):
+            try:
+                for _ in range(10):
+                    clients[t].scale_up_sim(max_new_nodes=16,
+                                            node_groups=NGS)
+                    clients[t].scale_down_sim(threshold=0.5)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def parse(text):
+            for line in text.splitlines():
+                if not line or line.startswith("#"):
+                    continue
+                body = line.split(" # ")[0]      # strip exemplar suffix
+                float(body.rsplit(" ", 1)[1])    # value must parse
+
+        def scraper(fn):
+            try:
+                while not stop.is_set():
+                    parse(fn())
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in clients]
+        scrapers = [threading.Thread(target=scraper, args=(fn,))
+                    for fn in (svc.metricz, m.expose_all_text,
+                               clients["r1"].metricz)]
+        for th in threads + scrapers:
+            th.start()
+        for th in threads:
+            th.join(120)
+        stop.set()
+        for th in scrapers:
+            th.join(30)
+        assert not errors, errors
+        # final exposition is consistent: every tenant's rpc_total shows
+        # all its sim RPCs (no lost increments under the race)
+        rpc = svc.registry.counter("rpc_total")
+        for t in clients:
+            assert rpc.value(method="ScaleUpSim", tenant=t) == 10, t
+            assert rpc.value(method="ScaleDownSim", tenant=t) == 10, t
+        parse(svc.metricz())
+    finally:
+        stop.set()
+
+
+# ---- statusz ------------------------------------------------------------
+
+
+def test_statusz_renders_tenant_table_queue_and_device_lines():
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimParams,
+        SimulatorService,
+        traced_call,
+    )
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16)
+    try:
+        assert svc.apply_delta(tenant_delta(0).payload(),
+                               tenant="acme")["error"] == ""
+        traced_call(svc, "ScaleUpSim",
+                    lambda: svc.scale_up_sim(
+                        SimParams(max_new_nodes=16, node_groups=NGS),
+                        tenant="acme"),
+                    tenant="acme")
+        sz = svc.statusz()
+        assert "acme" in sz
+        assert "queue:" in sz and "rejected=[queue-full=0 tenant-cap=0]" in sz
+        assert "shape classes:" in sz and "hit_rate=" in sz
+        assert "tail sampler:" in sz and "offered=1" in sz
+        assert "device: compiles=" in sz
+    finally:
+        svc.close()
+
+
+def test_stamps_partial_chain_stays_contiguous():
+    """A missing upstream stamp (serial path) charges from the last stamped
+    mark — the chain never gaps, so the sum-to-e2e contract holds on every
+    path shape."""
+    s = Stamps(entry=1000, enqueue=3000, dispatched=8000, harvested=9500)
+    ph = s.phases_ns()
+    assert ph == {"encode": 2000, "dispatch": 5000, "harvest": 1500}
+    assert sum(ph.values()) == s.e2e_ns() == 8500

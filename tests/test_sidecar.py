@@ -189,9 +189,15 @@ def test_trace_id_round_trip_through_sidecar():
         assert len(snap["remote"]) == 2
         for group in snap["remote"]:
             assert group["process"] == "sidecar"
-            (span,) = group["spans"]
+            names = [s["name"] for s in group["spans"]]
+            span = group["spans"][0]
             assert span["name"].startswith("sidecar/")
             assert span["args"]["version"] == 1
+            if span["name"] == "sidecar/ScaleDownSim":
+                # sim RPCs additionally report their lifecycle span tree
+                # (ISSUE 8): a `lifecycle` parent + per-phase children
+                assert "lifecycle" in names
+                assert any(n.startswith("lifecycle/") for n in names)
         # the merged export shows both processes under ONE trace id
         events = trace.chrome_trace_events([snap])
         pids = {e["pid"] for e in events if e.get("ph") == "X"}
