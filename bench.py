@@ -230,6 +230,10 @@ def run_floor_child(metric: str, args) -> int:
             cmd += ["--tail-dump", args.tail_dump]
     if args.no_batching:
         cmd += ["--no-batching"]
+    if args.journal:
+        # the record→replay round trip is host-side — it degrades WITH the
+        # floor instead of silently disappearing from the evidence
+        cmd += ["--journal", args.journal]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     print(f"[bench] degrading to CPU floor metric: {' '.join(cmd[1:])}",
@@ -399,6 +403,15 @@ def main() -> None:
                     help="with --tenants: write the tail sampler's retained "
                          "request traces (slow/breached/failed only) as one "
                          "Perfetto file here")
+    ap.add_argument("--journal", default="", metavar="DIR",
+                    help="record a short RunOnce sequence into a "
+                         "deterministic flight journal under DIR, measure "
+                         "the journaling overhead against loop walltime, "
+                         "then REPLAY the journal in-process and print a "
+                         "journal_record_replay_smoke JSON line with the "
+                         "drift report (never-null on the CPU floor — "
+                         "journaling and replay are host-side; "
+                         "docs/REPLAY.md)")
     ap.add_argument("--require-tpu", action="store_true",
                     help="disable the CPU-floor degradation: a missing/hung "
                          "TPU backend emits the null-value error JSON and "
@@ -846,6 +859,19 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
                 "error": f"{type(e).__name__}: {e}",
             }), flush=True)
 
+    if args.journal:
+        try:
+            with_timeout(lambda: bench_journal(args), seconds=600)()
+        except Exception as e:
+            print(f"[bench] journal phase failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "journal_record_replay_smoke", "value": None,
+                "unit": "percent_overhead",
+                "error": f"{type(e).__name__}: {e}",
+            }), flush=True)
+
     if args.trace:
         try:
             with_timeout(lambda: bench_trace(args, args.trace), seconds=600)()
@@ -855,7 +881,7 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
             print(f"[bench] trace phase failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
 
-    if args.scaledown or args.e2e or args.trace or args.tenants:
+    if args.scaledown or args.e2e or args.trace or args.tenants or args.journal:
         print(primary_line, flush=True)
 
 
@@ -1540,6 +1566,130 @@ def bench_runonce_e2e(args) -> None:
         "event_sink": {"emitted": a.event_sink.emitted,
                        "deduped": a.event_sink.deduped,
                        "dropped": a.event_sink.dropped},
+    }), flush=True)
+
+
+def bench_journal(args) -> None:
+    """--journal DIR: the record→replay round trip as bench-evidenced
+    contract. Records a short RunOnce sequence (mixed deltas: pod churn, a
+    taint flip, a node add, an unfittable burst that fires real scale-up)
+    into a flight journal, measures journaling overhead against steady loop
+    walltime (the ≤2% acceptance bound CI asserts), then replays the
+    journal in-process and reports the drift — zero on a healthy build.
+    Everything here is host-side, so the numbers exist on the CPU floor."""
+    import numpy as np
+
+    from kubernetes_autoscaler_tpu.config.options import (
+        AutoscalingOptions,
+        NodeGroupDefaults,
+    )
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+    from kubernetes_autoscaler_tpu.models.api import Node, Taint
+    from kubernetes_autoscaler_tpu.replay.harness import replay_journal
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    jdir = args.journal
+    os.makedirs(jdir, exist_ok=True)
+    for f in os.listdir(jdir):   # stale records would replay another world
+        if f.startswith("journal-") and f.endswith(".jsonl"):
+            os.remove(os.path.join(jdir, f))
+
+    n_nodes, loops = min(args.nodes, 48), 8
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384, pods=64)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=4 * n_nodes)
+    fake.add_node_group("ng2", build_test_node(
+        "tmpl2", cpu_milli=16000, mem_mib=32768, pods=64),
+        min_size=0, max_size=n_nodes, price_per_node=2.0)
+    for i in range(n_nodes):
+        nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384, pods=64)
+        fake.add_existing_node("ng1", nd)
+        fake.add_pod(build_test_pod(f"r{i}", cpu_milli=5000, mem_mib=2048,
+                                    owner_name=f"rs{i % 5}",
+                                    node_name=nd.name))
+    for i in range(min(args.pods, 200)):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=400, mem_mib=256,
+                                    owner_name=f"prs{i % 4}"))
+    holder = {"now": 1000.0}
+    opts = AutoscalingOptions(
+        journal_dir=jdir, journal_max_mb=16.0,
+        node_shape_bucket=64, group_shape_bucket=16,
+        max_new_nodes_static=64, max_pods_per_node=16,
+        enable_dynamic_resource_allocation=False,
+        enable_csi_node_aware_scheduling=False,
+        scale_down_delay_after_add_s=0.0,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=3600.0),
+    )
+    a = StaticAutoscaler(fake.provider, fake, options=opts,
+                         eviction_sink=fake, walltime=lambda: holder["now"])
+    seq = 0
+    loop_ms, journal_ms = [], []
+    for k in range(loops):
+        # mixed deltas: churn replaces objects (the replace-on-update
+        # contract the incremental encoder and the journal both ride)
+        for j in range(8):
+            fake.remove_pod(f"p{seq + j}")
+            fake.add_pod(build_test_pod(
+                f"p{200 + seq + j}", cpu_milli=400, mem_mib=256,
+                owner_name=f"prs{(seq + j) % 4}"))
+        seq += 8
+        if k == 2:   # taint flip (fresh Node object, same name)
+            old = fake.nodes["n1"]
+            fake.nodes["n1"] = Node(
+                name=old.name, labels=dict(old.labels),
+                capacity=dict(old.capacity),
+                allocatable=dict(old.allocatable),
+                taints=[Taint("bench/flip", "1", "NoSchedule")], ready=True)
+        if k == 3:   # unfittable burst → real scale-up → node-add churn
+            for j in range(6):
+                fake.add_pod(build_test_pod(
+                    f"burst{j}", cpu_milli=7000, mem_mib=4096,
+                    owner_name="burst-rs"))
+        if k == 5:
+            for j in range(6):
+                fake.remove_pod(f"burst{j}")
+        holder["now"] = 1000.0 + 10.0 * k
+        j0 = a.journal.overhead_ns
+        t0 = time.perf_counter()
+        a.run_once(now=holder["now"])
+        loop_ms.append((time.perf_counter() - t0) * 1000.0)
+        journal_ms.append((a.journal.overhead_ns - j0) / 1e6)
+    # steady-state overhead: the cold loop pays compiles in the denominator
+    # and first-snapshot serialization in the numerator — exclude both
+    steady_loop = sum(loop_ms[1:])
+    steady_journal = sum(journal_ms[1:])
+    frac = steady_journal / steady_loop if steady_loop > 0 else 0.0
+    jstats = a.journal.stats()
+    cursor = a.journal.cursor()
+
+    t0 = time.perf_counter()
+    report = replay_journal(jdir)
+    replay_ms = (time.perf_counter() - t0) * 1000.0
+    print(json.dumps({
+        "metric": "journal_record_replay_smoke",
+        "value": round(frac * 100.0, 4),
+        "unit": "percent_overhead",
+        # the ACTUAL jax platform both legs ran on (journal + replay are
+        # host-side either way, but the replayed sim dispatches are not)
+        "backend": report["backend"]["replayed"].get("platform", "cpu"),
+        "loops": loops,
+        "journal_overhead_ms": round(steady_journal, 3),
+        "journal_overhead_frac": round(frac, 5),
+        "loop_p50_ms": round(float(np.percentile(loop_ms[1:], 50)), 3),
+        "journal": {**jstats, "cursor": list(cursor) if cursor else None},
+        "replay": {
+            "loops": report["loops"],
+            "zero_drift": report["zeroDrift"],
+            "drift_loops": report["driftLoops"],
+            "problems": report["problems"],
+            "replay_ms": round(replay_ms, 1),
+            "backend": report["backend"],
+        },
     }), flush=True)
 
 

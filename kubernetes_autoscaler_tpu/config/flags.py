@@ -182,6 +182,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--loop-wallclock-budget", type=dur, default=0.0,
                    help="per-RunOnce wall-clock SLO; a breach dumps the "
                         "flight recorder (0 = no budget)")
+    p.add_argument("--journal-dir", default="",
+                   help="record every RunOnce into a deterministic flight "
+                        "journal under this directory (snapshot+delta "
+                        "records; replay with `python -m "
+                        "kubernetes_autoscaler_tpu.replay`); empty = off")
+    p.add_argument("--journal-max-mb", type=float, default=64.0,
+                   help="size bound for the retained journal; older files "
+                        "rotate out with drop accounting")
 
     # TPU data plane (no reference analog — Go has no tracing/compile cache)
     p.add_argument("--node-shape-bucket", type=int, default=256)
@@ -315,6 +323,8 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         flight_recorder_capacity=args.flight_recorder_capacity,
         flight_recorder_dir=args.flight_recorder_dir,
         loop_wallclock_budget_s=args.loop_wallclock_budget,
+        journal_dir=args.journal_dir,
+        journal_max_mb=args.journal_max_mb,
     )
 
 

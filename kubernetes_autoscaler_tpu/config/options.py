@@ -144,6 +144,12 @@ class AutoscalingOptions:
     # per-loop wall-clock budget; a slower RunOnce counts as an SLO breach
     # and dumps the flight recorder (0 = no budget)
     loop_wallclock_budget_s: float = 0.0           # --loop-wallclock-budget
+    # deterministic flight journal (replay/journal.py): record every RunOnce
+    # as a self-contained snapshot/delta record replayable bit-for-bit by
+    # `python -m kubernetes_autoscaler_tpu.replay`; "" = off
+    journal_dir: str = ""                          # --journal-dir
+    # size bound for the RETAINED journal (rotation + drop accounting)
+    journal_max_mb: float = 64.0                   # --journal-max-mb
     write_status_configmap: bool = True            # --write-status-configmap
     status_config_map_name: str = "cluster-autoscaler-status"
     max_inactivity_s: float = 10 * 60.0            # --max-inactivity (liveness)

@@ -128,6 +128,16 @@ class DebuggingSnapshotter:
                 return
             self._data["traceId"] = trace_id
 
+    def set_journal_cursor(self, loop: int, digest: str) -> None:
+        """The flight-journal cursor covering this loop (replay/journal.py)
+        — the snapshot resolves to the exact record
+        `python -m kubernetes_autoscaler_tpu.replay` re-executes."""
+        with self._lock:
+            if self._armed is None:
+                return
+            self._data["journalLoop"] = int(loop)
+            self._data["journalDigest"] = digest
+
     def set_reason_plane(self, payload: dict[str, Any]) -> None:
         """The loop's explainable verdicts: refused pod groups with their
         constraint bits, unremovable nodes with reasons + drain-failure

@@ -123,8 +123,17 @@ class EventSink:
     # ---- export ----
 
     def snapshot(self) -> list[dict]:
-        """Newest-last list of stored events (rides /snapshotz payloads)."""
-        return [ev.to_dict() for ev in self.events.values()]
+        """Newest-last list of stored events (rides /snapshotz payloads).
+
+        Ordered by lastTimestamp on EXPORT, not by ring position: the ring
+        orders by update sequence, but emitters stamp `now` from their own
+        clock domains (planner loop time vs orchestrator wall time), so a
+        dedup-aggregated event can hold a fresher timestamp than entries
+        updated after it — exporting ring order interleaved stale and fresh
+        reasons in /snapshotz event tails. The sort is stable, so equal
+        timestamps keep their update order."""
+        return [ev.to_dict() for ev in
+                sorted(self.events.values(), key=lambda e: e.last_ts)]
 
     def find(self, kind: str | None = None, obj: str | None = None,
              reason: str | None = None) -> list[Event]:

@@ -148,6 +148,12 @@ SERVING_FAMILIES = {
         "the sidecar Statusz RPC — tenant table with latency percentiles, "
         "SLO budgets/breaches and last-breach exemplar trace ids, queue and "
         "shape-class state, in one human-readable page"),
+    "(no reference analog: decision provenance)": (
+        "journal_records_total{tenant} / journal_bytes_total{tenant} / "
+        "journal_dropped_total{reason,tenant} — the flight journal "
+        "(replay/): every world delta and sim verdict as a chained, "
+        "digest-sealed record; breach/backpressure persists the ring "
+        "(docs/REPLAY.md)"),
 }
 
 # The reference UnremovableReason enum values our planner actually produces,

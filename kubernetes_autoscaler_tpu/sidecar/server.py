@@ -62,6 +62,7 @@ from kubernetes_autoscaler_tpu.sidecar.lifecycle import (
 )
 from kubernetes_autoscaler_tpu.sidecar.native_api import NativeSnapshotState
 from kubernetes_autoscaler_tpu.sidecar.shapes import ShapeClass, ShapeLadder, rung
+from kubernetes_autoscaler_tpu.replay.journal import TenantJournal
 from kubernetes_autoscaler_tpu.sidecar.wire import (
     RETRY_AFTER_MS_HEADER,
     SLO_BUDGET_MS_HEADER,
@@ -105,6 +106,9 @@ class _Tenant:
     lat_ms: deque = field(default_factory=lambda: deque(maxlen=512))
     slo_breaches: int = 0
     last_breach_trace: str = ""
+    # per-tenant flight journal (replay/journal.TenantJournal): bounded
+    # in-memory provenance ring, persisted on breach/backpressure
+    journal: TenantJournal | None = None
 
 
 class SimulatorService:
@@ -121,7 +125,8 @@ class SimulatorService:
                  slo_budgets: dict | None = None,
                  slo_dump_dir: str = "",
                  tail_sample_capacity: int = 64,
-                 tail_slow_quantile: float = 0.95):
+                 tail_slow_quantile: float = 0.95,
+                 journal_capacity: int = 256):
         self.dims = dims
         self.max_tenants = int(max_tenants)
         self.node_bucket = node_bucket
@@ -143,6 +148,9 @@ class SimulatorService:
         self.slo_dump_dir = slo_dump_dir
         self.tail = trace.TailSampler(capacity=tail_sample_capacity,
                                       slow_quantile=tail_slow_quantile)
+        # per-tenant journal ring size; the tenant table cap bounds how many
+        # rings exist, this bounds each ring's records
+        self.journal_capacity = int(journal_capacity)
         self.events = EventSink(registry=self.registry)
         self._events_lock = threading.Lock()   # EventSink isn't thread-safe
         self._tenants: dict[str, _Tenant] = {}
@@ -221,6 +229,9 @@ class SimulatorService:
                     self._note_reject(tid, e)
                     raise e
                 ts = _Tenant(tid=tid, state=NativeSnapshotState(self.dims))
+                ts.journal = TenantJournal(tenant=tid,
+                                           capacity=self.journal_capacity,
+                                           registry=self.registry)
                 self._tenants[tid] = ts
                 self.registry.gauge(
                     "tenants_active",
@@ -258,6 +269,14 @@ class SimulatorService:
         self._phase_hist().zero_matching(tenant=tid)
         self.registry.counter("tenant_slo_breaches_total").zero_matching(
             tenant=tid)
+        # journal families are tenant-labelled too (TenantJournal); its ring
+        # died with the _Tenant object, so its series must zero as well
+        jt = tid or "default"
+        self.registry.counter("journal_records_total").zero_matching(
+            tenant=jt)
+        self.registry.counter("journal_bytes_total").zero_matching(tenant=jt)
+        self.registry.counter("journal_dropped_total").zero_matching(
+            tenant=jt)
         self.slo.drop(tid)
         return True
 
@@ -291,6 +310,12 @@ class SimulatorService:
                     for uid in aux.get("del", []):
                         ts.aux.pop(uid, None)
                 self._classify(ts)
+                # provenance: the KAD1 payload IS the tenant's world delta —
+                # journal its digest against the post-apply version
+                if ts.journal is not None:
+                    ts.journal.record(
+                        "delta", ts.state.version, nbytes=len(payload),
+                        digest=hashlib.sha256(payload).hexdigest()[:16])
                 return {"version": ts.state.version, "error": ""}
             except (ValueError, TypeError) as e:
                 return {"version": ts.state.version, "error": str(e)}
@@ -546,6 +571,13 @@ class SimulatorService:
         for name, dur_ns in stamps.phases_ns().items():
             self._phase_hist().observe(dur_ns / 1e9, phase=name, **labels)
         ts.lat_ms.append(stamps.e2e_ns() / 1e6)
+        # verdict provenance: digest the response BEFORE the lifecycle block
+        # rides in (timings are observation, not decision)
+        from kubernetes_autoscaler_tpu.replay.journal import digest_of
+
+        if ts.journal is not None:
+            ts.journal.record("verdict", ts.state.version,
+                              digest=digest_of(resp))
         tracer = trace.current_tracer()
         if isinstance(resp, dict):
             resp["lifecycle"] = lifecycle_block(
@@ -794,6 +826,7 @@ class SimulatorService:
             "slo_budget_ms": self.slo.get(tid) or None,
             "slo_breaches": ts.slo_breaches,
             "last_breach_trace": ts.last_breach_trace or None,
+            "journal": ts.journal.stats() if ts.journal is not None else None,
         }
 
     def statusz(self) -> str:
@@ -846,6 +879,26 @@ class SimulatorService:
             f"tail sampler: offered={tstats['offered']} "
             f"retained={tstats['retained']} evicted={tstats['evicted']} "
             f"held={tstats['held']} reasons={json.dumps(tstats['reasons'], sort_keys=True)}")
+        # flight-journal section: per-tenant provenance ring accounting
+        # (records/bytes/held/drops/persists), capped like the tenant table
+        jrows = []
+        jtot = {"records": 0, "bytes": 0, "dropped": 0, "persisted": 0}
+        for tid in tids:
+            ts = self._tenant_peek(tid)
+            if ts is None or ts.journal is None:
+                continue
+            js = ts.journal.stats()
+            for k in jtot:
+                jtot[k] += js[k]
+            jrows.append(
+                f"  {js['tenant']:<15} records={js['records']:>6} "
+                f"bytes={js['bytes']:>8} held={js['held']:>4} "
+                f"dropped={js['dropped']} persisted={js['persisted']}")
+        lines.append(
+            f"journal: tenants={len(jrows)} cap={self.journal_capacity}/tenant "
+            f"records={jtot['records']} bytes={jtot['bytes']} "
+            f"dropped={jtot['dropped']} persisted={jtot['persisted']}")
+        lines.extend(jrows)
         comp = self.registry.counter("sim_compiles_total")
         xfer = self.registry.counter("device_transfer_bytes_total")
         lines.append(
@@ -853,7 +906,10 @@ class SimulatorService:
             f"compile_s={self.registry.counter('sim_compile_seconds_total').value():.3f} "
             f"h2d_bytes={xfer.value(direction='h2d'):.0f} "
             f"d2h_bytes={xfer.value(direction='d2h'):.0f}")
-        events = self.events.snapshot()
+        # EventSink isn't thread-safe: the reject path emits under
+        # _events_lock on handler threads, so the statusz read takes it too
+        with self._events_lock:
+            events = self.events.snapshot()
         if events:
             lines.append(f"events ({len(events)} stored, newest last):")
             for ev in events[-8:]:
@@ -885,9 +941,30 @@ class SimulatorService:
             snap = tracer.snapshot()
             snap["tenant"] = tenant
             snap["method"] = method
+            # a retained trace names its replayable provenance: the
+            # tenant-journal cursor at completion time
+            if ts is not None and ts.journal is not None:
+                cur = ts.journal.cursor()
+                if cur is not None:
+                    snap["journal_seq"], snap["journal_digest"] = cur
             exemplar = self.tail.offer(snap, dt_s, reason)
         else:
             self.tail.observe_latency(dt_s)
+        if reason in ("slo_breach", "backpressure") and self.slo_dump_dir \
+                and ts is not None and ts.journal is not None:
+            # breach/backpressure-triggered retention (the TailSampler
+            # pattern): the in-memory provenance ring hits disk only now —
+            # deduped by ring watermark, because backpressure fires exactly
+            # when the server is saturated and the reject path must stay a
+            # cheap fast-reject (maybe_persist writes once per NEW history,
+            # an overload storm re-persists nothing)
+            try:
+                import os
+
+                os.makedirs(self.slo_dump_dir, exist_ok=True)
+                ts.journal.maybe_persist(self.slo_dump_dir, reason=reason)
+            except OSError:
+                pass   # a full disk must never sink the RPC
         if breached:
             self.registry.counter(
                 "tenant_slo_breaches_total",

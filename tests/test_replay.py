@@ -1,0 +1,490 @@
+"""Deterministic flight journal (ISSUE 9): record→replay round trips,
+drift localization, rotation/drop accounting, and the cross-backend
+divergence oracle.
+
+The core contract: a journaled RunOnce sequence replays bit-for-bit — the
+verdict plane, the chosen expansion option, the reason plane and the drain
+decisions all reproduce digest-identical from the journal alone. A
+perturbed record drifts, and the drift report names the exact pod-group ×
+node and reason bit."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.models.api import Node, Taint
+from kubernetes_autoscaler_tpu.replay import journal as rj
+from kubernetes_autoscaler_tpu.replay.harness import (
+    JournalError,
+    load_journal,
+    reconstruct_worlds,
+    replay_journal,
+)
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import (
+    build_test_node,
+    build_test_pod,
+)
+
+
+def _opts(jdir: str, **kw) -> AutoscalingOptions:
+    base = dict(
+        journal_dir=jdir,
+        node_shape_bucket=32, group_shape_bucket=8, max_new_nodes_static=32,
+        max_pods_per_node=16,
+        enable_dynamic_resource_allocation=False,
+        enable_csi_node_aware_scheduling=False,
+        scale_down_delay_after_add_s=0.0,
+    )
+    base.update(kw)
+    return AutoscalingOptions(**base)
+
+
+def _autoscaler(fake, opts, holder):
+    return StaticAutoscaler(fake.provider, fake, options=opts,
+                            eviction_sink=fake,
+                            walltime=lambda: holder["now"])
+
+
+def _flip_taint(fake: FakeCluster, name: str, key: str) -> None:
+    """Replace-on-update taint flip (in-place mutation would violate the
+    incremental encoder's contract AND serialize the wrong world)."""
+    old = fake.nodes[name]
+    fake.nodes[name] = Node(
+        name=old.name, labels=dict(old.labels), capacity=dict(old.capacity),
+        allocatable=dict(old.allocatable),
+        taints=[Taint(key, "1", "NoSchedule")], ready=True)
+
+
+@pytest.fixture(scope="module")
+def recorded(tmp_path_factory):
+    """One journaled 5-loop run with mixed deltas — pod churn, a taint
+    flip, an unfittable burst that fires real scale-up (the provider
+    materializes nodes the next loop sees), a pod delete — shared by the
+    read-only replay tests."""
+    jdir = str(tmp_path_factory.mktemp("journal"))
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192, pods=32)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=20)
+    fake.add_node_group("ng2", build_test_node(
+        "tmpl2", cpu_milli=8000, mem_mib=16384, pods=32),
+        min_size=0, max_size=8, price_per_node=2.0)
+    for i in range(6):
+        nd = build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192, pods=32)
+        fake.add_existing_node("ng1", nd)
+        fake.add_pod(build_test_pod(f"r{i}", cpu_milli=3000, mem_mib=1024,
+                                    owner_name="rs1", node_name=nd.name))
+    for i in range(8):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=500, mem_mib=256,
+                                    owner_name="prs"))
+    holder = {"now": 1000.0}
+    a = _autoscaler(fake, _opts(jdir, node_group_defaults=NodeGroupDefaults(
+        scale_down_unneeded_time_s=15.0)), holder)
+    for k in range(5):
+        holder["now"] = 1000.0 + 10.0 * k
+        if k == 1:
+            fake.remove_pod("p0")
+            fake.add_pod(build_test_pod("p8", cpu_milli=500, mem_mib=256,
+                                        owner_name="prs"))
+        if k == 2:
+            _flip_taint(fake, "n1", "test/flip")
+        if k == 3:
+            fake.add_pod(build_test_pod("burst", cpu_milli=3500,
+                                        mem_mib=512, owner_name="bb"))
+        a.run_once(now=holder["now"])
+    return jdir, a
+
+
+# ---- record format + round trip -----------------------------------------
+
+
+def test_journal_kinds_seals_and_round_trip(recorded):
+    jdir, a = recorded
+    meta, records, problems = load_journal(jdir)
+    assert not problems
+    assert meta["options"]["node_shape_bucket"] == 32
+    assert meta["config"] == records[0]["config"]
+    assert [r["kind"] for r in records] == ["snapshot"] + ["delta"] * 4
+    assert [r["loop"] for r in records] == list(range(5))
+    # parent chain
+    for prev, rec in zip(records, records[1:]):
+        assert rec["parent"] == prev["digest"]
+    # every record carries backend identity + the four surface digests
+    for rec in records:
+        assert rec["backend"]["platform"]
+        assert set(rec["digests"]) == {"verdict", "scaleUp", "reasons",
+                                       "drain"}
+    # reconstruct_worlds digest-verifies every step (raises on mismatch);
+    # the taint flip lands at loop 2 as a nodesMod delta
+    worlds = list(reconstruct_worlds(records))
+    assert len(worlds) == 5
+    d2 = records[2]["delta"]
+    assert any(n["name"] == "n1" and n["taints"] for n
+               in d2.get("nodesMod", []))
+    # the loop-3 burst fired a real scale-up; loop 4's world carries the
+    # materialized node and a group-target change
+    su = records[3]["outputs"]["scaleUp"]
+    assert su and su["scaledUp"] and su["best"]["nodes"] >= 1
+    d4 = records[4]["delta"]
+    assert d4.get("nodesAdd") and d4.get("groupsMod")
+
+
+def test_replay_is_digest_identical(recorded):
+    jdir, a = recorded
+    rep = replay_journal(jdir)
+    assert rep["zeroDrift"] is True
+    assert rep["driftLoops"] == []
+    assert rep["loops"] == 5
+    assert "stateHorizon" not in rep
+    # the report's replayed surface digests equal the recorded ones
+    _, records, _ = load_journal(jdir)
+    for rec, entry in zip(records, rep["records"]):
+        assert entry["surfaces"] == rec["digests"]
+
+
+def test_replay_cli_exit_codes(recorded, capsys, tmp_path):
+    from kubernetes_autoscaler_tpu.replay.__main__ import main
+
+    jdir, _ = recorded
+    out = str(tmp_path / "report.json")
+    assert main([jdir, "--out", out]) == 0
+    rep = json.loads(open(out).read())
+    assert rep["zeroDrift"] is True
+    capsys.readouterr()
+    assert main([str(tmp_path)]) == 1        # no journal there → structural
+
+
+def test_journal_cursor_stamped_on_trace_and_snapshotz(recorded):
+    """Provenance stitching: the trace root span and /snapshotz both name
+    the exact replayable record (journal cursor = loop + record digest)."""
+    jdir, a = recorded
+    from kubernetes_autoscaler_tpu.debuggingsnapshot.snapshotter import (
+        DebuggingSnapshotter,
+    )
+
+    dbg = DebuggingSnapshotter()
+    a.debugging_snapshotter = dbg
+    handle = dbg.request_snapshot()
+    a.run_once(now=1100.0)
+    cur = a.journal.cursor()
+    payload = json.loads(handle.wait(timeout=5))
+    assert payload["journalLoop"] == cur[0]
+    assert payload["journalDigest"] == cur[1]
+    # flight-recorder ring: the loop's root span carries the same cursor,
+    # so an SLO-breach Perfetto dump resolves to the record too
+    snap = a.flight_recorder.traces()[-1]
+    roots = [s for s in snap["spans"] if s["name"] == "RunOnce"]
+    root_args = roots[0].get("args") or {}
+    assert root_args["journal_loop"] == cur[0]
+    assert root_args["journal_digest"] == cur[1]
+    a.debugging_snapshotter = None
+
+
+# ---- property: fuzzed worlds, mixed deltas ------------------------------
+
+
+@pytest.mark.parametrize(
+    "seed", [7, pytest.param(23, marks=pytest.mark.slow)])
+def test_record_replay_property_fuzzed_mixed_deltas(tmp_path, seed):
+    """Record→replay of fuzzed worlds is digest-identical for L consecutive
+    loops with mixed deltas (pod adds/deletes, taint flips, node
+    add/remove)."""
+    rng = np.random.RandomState(seed)
+    jdir = str(tmp_path / "j")
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192, pods=32)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=30)
+    for i in range(5):
+        nd = build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192, pods=32)
+        fake.add_existing_node("ng1", nd)
+        fake.add_pod(build_test_pod(
+            f"r{i}", cpu_milli=int(rng.randint(1000, 3500)), mem_mib=512,
+            owner_name=f"rs{i % 2}", node_name=nd.name))
+    holder = {"now": 1000.0}
+    a = _autoscaler(fake, _opts(jdir, node_group_defaults=NodeGroupDefaults(
+        scale_down_unneeded_time_s=25.0)), holder)
+    pod_seq = node_seq = 0
+    L = 4
+    for k in range(L):
+        for _ in range(int(rng.randint(1, 4))):   # pod churn
+            op = rng.randint(0, 3)
+            if op == 0:
+                fake.add_pod(build_test_pod(
+                    f"f{pod_seq}", cpu_milli=int(rng.randint(200, 900)),
+                    mem_mib=256, owner_name=f"prs{pod_seq % 3}"))
+                pod_seq += 1
+            elif op == 1 and pod_seq > 0:
+                fake.remove_pod(f"f{rng.randint(0, pod_seq)}")
+            else:
+                _flip_taint(fake, f"n{rng.randint(0, 5)}",
+                            f"fuzz/{rng.randint(0, 2)}")
+        if k == 1:
+            nd = build_test_node(f"x{node_seq}", cpu_milli=4000,
+                                 mem_mib=8192, pods=32)
+            fake.add_existing_node("ng1", nd)
+            node_seq += 1
+        if k == 2 and f"n{4}" in fake.nodes:
+            fake.nodes.pop("n4")
+            fake.provider.remove_node("ng1", "n4")
+        holder["now"] = 1000.0 + 10.0 * k
+        a.run_once(now=holder["now"])
+    rep = replay_journal(jdir)
+    assert rep["zeroDrift"] is True, rep["records"]
+    assert rep["loops"] == L
+
+
+# ---- drift localization -------------------------------------------------
+
+
+def test_drift_report_names_pod_group_node_and_reason_bit(tmp_path):
+    """Flip one taint inside a recorded world: the report must localize the
+    drift to the exact pod-group × node and name the flipped uint16 bit."""
+    jdir = str(tmp_path / "j")
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192, pods=32)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=0)  # no scale-up
+    nd = build_test_node("n0", cpu_milli=4000, mem_mib=8192, pods=32)
+    fake.add_existing_node("ng1", nd)
+    # resident keeps utilization high → no soft-taint churn rewrites n0
+    fake.add_pod(build_test_pod("r0", cpu_milli=3000, mem_mib=1024,
+                                owner_name="rs", node_name="n0"))
+    fake.add_pod(build_test_pod("p0", cpu_milli=500, mem_mib=256,
+                                owner_name="prs"))
+    holder = {"now": 1000.0}
+    a = _autoscaler(fake, _opts(jdir), holder)
+    for k in range(2):
+        holder["now"] = 1000.0 + 10.0 * k
+        a.run_once(now=holder["now"])
+    # recorded: p0 schedules on n0 both loops (exactly one group scheduled)
+    _, records, _ = load_journal(jdir)
+    assert rj.decode_verdict_plane(
+        records[0]["outputs"]["verdict"]).sum() == 1
+
+    # perturb the snapshot record: NoSchedule-taint n0, re-seal, re-chain
+    path = os.path.join(jdir, "journal-000000.jsonl")
+    lines = [json.loads(ln) for ln in open(path)]
+    idx = prev_digest = None
+    with open(path, "w") as f:
+        for rec in lines:
+            if rec.get("kind") == "snapshot":
+                rec["world"]["nodes"][0]["taints"] = [
+                    {"key": "drift/flip", "value": "1",
+                     "effect": "NoSchedule"}]
+                idx = rj.index_from_snapshot(rec["world"])
+                rec["worldDigest"] = idx.digest()
+                rj.seal_record(rec)
+            elif rec.get("kind") == "delta":
+                rec["parent"] = prev_digest
+                idx = rj.apply_world_delta(idx, rec.get("delta", {}))
+                rec["worldDigest"] = idx.digest()
+                rj.seal_record(rec)
+            if rec.get("kind") in ("snapshot", "delta"):
+                prev_digest = rec["digest"]
+            f.write(rj.canonical(rec) + "\n")
+
+    rep = replay_journal(jdir)
+    assert rep["zeroDrift"] is False
+    assert rep["driftLoops"] == [0, 1]
+    e0 = rep["records"][0]
+    assert "verdict" in e0["drift"] and "reasons" in e0["drift"]
+    # byte-level verdict comparison localizes the pod group (p0's
+    # equivalence row — the resident r0 holds an earlier spec row)
+    assert len(e0["verdictDiff"]) == 1
+    gi = e0["verdictDiff"][0]["group"]
+    assert e0["verdictDiff"] == [{"group": gi, "recorded": 1,
+                                  "replayed": 0}]
+    # reason-plane diff names the exact pod-group × node and the bit
+    hits = [d for d in e0["reasonDiff"]
+            if d["group"] == gi and d["node"] == "n0"]
+    assert hits, e0["reasonDiff"]
+    assert hits[0]["exemplarPod"] == "p0"
+    assert hits[0]["flipped"] == ["taint"]
+    assert hits[0]["replayedBits"] == ["taint"]
+    assert hits[0]["recordedBits"] == []
+
+
+def test_torn_trailing_line_is_tolerated_and_surfaced(recorded, tmp_path):
+    """A writer killed mid-append leaves a torn final line: the intact
+    records before it must still replay, with a `torn-tail` problem —
+    destroying the whole journal under disk pressure would defeat its
+    purpose."""
+    jdir, _ = recorded
+    src = os.path.join(jdir, "journal-000000.jsonl")
+    dst_dir = tmp_path / "jt"
+    dst_dir.mkdir()
+    intact = sum(1 for ln in open(src)
+                 if ln.strip() and '"kind":"meta"' not in ln)
+    text = open(src).read().rstrip("\n")
+    (dst_dir / "journal-000000.jsonl").write_text(text[:-40] + "\n")
+    meta, records, problems = load_journal(str(dst_dir))
+    assert any(p["kind"] == "torn-tail" for p in problems)
+    assert len(records) == intact - 1          # only the torn record lost
+    rep = replay_journal(str(dst_dir))
+    assert rep["zeroDrift"] is True
+    assert rep["loops"] == intact - 1
+
+
+def test_corrupt_record_is_a_structural_error(recorded, tmp_path):
+    """A tampered record that is NOT re-sealed must fail loudly as
+    corruption, never masquerade as drift."""
+    jdir, _ = recorded
+    src = os.path.join(jdir, "journal-000000.jsonl")
+    dst_dir = tmp_path / "jc"
+    dst_dir.mkdir()
+    lines = open(src).read().splitlines()
+    doc = json.loads(lines[1])
+    doc["now"] += 1.0                     # perturb without re-sealing
+    lines[1] = rj.canonical(doc)
+    (dst_dir / "journal-000000.jsonl").write_text("\n".join(lines) + "\n")
+    with pytest.raises(JournalError, match="seal"):
+        load_journal(str(dst_dir))
+
+
+# ---- rotation, drops, aborted loops -------------------------------------
+
+
+def test_rotation_drop_accounting_and_state_horizon(tmp_path):
+    from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+
+    jdir = str(tmp_path / "j")
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192, pods=32)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=4)
+    for i in range(4):
+        nd = build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192, pods=32)
+        fake.add_existing_node("ng1", nd)
+        fake.add_pod(build_test_pod(f"r{i}", cpu_milli=3200, mem_mib=1024,
+                                    owner_name="rs", node_name=nd.name))
+    reg = Registry()
+    holder = {"now": 1000.0}
+    a = StaticAutoscaler(fake.provider, fake,
+                         options=_opts(jdir, journal_max_mb=0.02),
+                         registry=reg, eviction_sink=fake,
+                         walltime=lambda: holder["now"])
+    for k in range(12):
+        holder["now"] = 1000.0 + 10.0 * k
+        a.run_once(now=holder["now"])
+    w = a.journal
+    assert w.rotations > 0
+    assert w.drops.get("rotated", 0) > 0
+    assert reg.counter("journal_records_total").value() == 12
+    assert reg.counter("journal_rotations_total").value() == w.rotations
+    assert reg.counter("journal_dropped_total").value(reason="rotated") == \
+        w.drops["rotated"]
+    assert reg.counter("journal_bytes_total").value() == w.bytes
+    # the RETAINED files still replay: each rotated-into file starts with a
+    # fresh snapshot; the report flags the lost state horizon
+    rep = replay_journal(jdir)
+    assert rep["zeroDrift"] is True
+    assert rep["firstLoop"] > 0
+    assert rep["stateHorizon"] == rep["firstLoop"]
+    assert rep["loops"] == 12 - rep["firstLoop"]
+
+
+def test_aborted_loop_drops_staged_record(tmp_path):
+    jdir = str(tmp_path / "j")
+    fake = FakeCluster()
+    fake.add_node_group("ng1", build_test_node("tmpl"), min_size=0,
+                        max_size=4)
+    # only an unready node + --scale-up-from-zero=false → the loop aborts
+    # AFTER the journal staged its record
+    nd = build_test_node("n0", ready=False)
+    fake.add_existing_node("ng1", nd)
+    holder = {"now": 1000.0}
+    a = _autoscaler(fake, _opts(jdir, scale_up_from_zero=False), holder)
+    status = a.run_once(now=1000.0)
+    assert status.ran is False
+    assert a.journal.records == 0
+    assert a.journal.drops == {"aborted-loop": 1}
+    assert a.journal.cursor() is None
+
+
+def test_reused_journal_dir_replays_last_run_only(tmp_path):
+    """A production --journal-dir survives restarts: a fresh process
+    starts a new chain (snapshot, parent="", loop 0) WITHOUT deleting its
+    predecessor's evidence. The harness must replay only the last run —
+    stitching runs would replay run 2 under run 1's accumulated cross-loop
+    state and report spurious drift."""
+    jdir = str(tmp_path / "j")
+
+    def one_run(loops):
+        fake = FakeCluster()
+        tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192,
+                               pods=32)
+        fake.add_node_group("ng1", tmpl, min_size=0, max_size=8)
+        for i in range(3):
+            nd = build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192,
+                                 pods=32)
+            fake.add_existing_node("ng1", nd)
+            fake.add_pod(build_test_pod(
+                f"r{i}", cpu_milli=3000, mem_mib=1024, owner_name="rs",
+                node_name=nd.name))
+        holder = {"now": 1000.0}
+        a = _autoscaler(fake, _opts(jdir), holder)
+        for k in range(loops):
+            holder["now"] = 1000.0 + 10.0 * k
+            a.run_once(now=holder["now"])
+
+    one_run(3)    # run 1: its journal files stay behind
+    one_run(2)    # run 2: same dir, fresh writer, fresh chain
+    rep = replay_journal(jdir)
+    assert rep["zeroDrift"] is True, rep["records"]
+    assert rep["loops"] == 2                       # only the LAST run
+    assert rep["firstLoop"] == 0
+    prev = [p for p in rep["problems"] if p["kind"] == "previous-runs"]
+    assert prev and prev[0]["count"] == 1 and prev[0]["loops"] == 3
+    # a faithful same-version replay matches the recorded config
+    assert rep["config"]["replayed"] == rep["config"]["recorded"]
+
+
+# ---- cross-backend divergence oracle ------------------------------------
+
+
+@pytest.mark.slow
+def test_cross_backend_pallas_interpret_zero_drift(tmp_path, monkeypatch):
+    """Record under the XLA scan pack, replay under KA_TPU_PACK=pallas
+    (interpret mode on CPU) with cold jit caches: the first real
+    TPU-kernel-vs-CPU-floor correctness oracle must report zero drift.
+    Both legs force their own pack backend, so the test is meaningful
+    regardless of the job's ambient KA_TPU_PACK (the pallas CI job runs
+    this file with it set)."""
+    import jax
+
+    jdir = str(tmp_path / "j")
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192, pods=32)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=20)
+    for i in range(4):
+        nd = build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192, pods=32)
+        fake.add_existing_node("ng1", nd)
+        fake.add_pod(build_test_pod(f"r{i}", cpu_milli=3000, mem_mib=1024,
+                                    owner_name="rs", node_name=nd.name))
+    for i in range(5):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=700, mem_mib=256,
+                                    owner_name="prs"))
+    holder = {"now": 1000.0}
+    monkeypatch.setenv("KA_TPU_PACK", "xla")
+    jax.clear_caches()             # pack_backend() is read at trace time
+    try:
+        a = _autoscaler(fake, _opts(jdir), holder)
+        for k in range(3):
+            holder["now"] = 1000.0 + 10.0 * k
+            if k == 1:
+                fake.add_pod(build_test_pod("b0", cpu_milli=3500,
+                                            mem_mib=512, owner_name="bb"))
+            a.run_once(now=holder["now"])
+        monkeypatch.setenv("KA_TPU_PACK", "pallas")
+        jax.clear_caches()
+        rep = replay_journal(jdir)
+    finally:
+        jax.clear_caches()         # leave no pallas executables behind
+    assert rep["zeroDrift"] is True, rep["driftLoops"]
+    assert rep["backend"]["replayed"]["pack"] == "pallas"
+    assert rep["backend"]["recorded"]["pack"] == "xla"

@@ -506,6 +506,11 @@ def test_metricz_and_process_metrics_expose_identical_series():
         assert ours <= set(metricz.splitlines())
         assert ours <= set(mux.splitlines())
         assert any("rpc_total" in r and 'tenant="a"' in r for r in ours)
+        # ISSUE 9: the per-tenant flight-journal families ride the same
+        # registry, so the `ours <= both surfaces` containment above
+        # already proves Metricz ≡ /metrics for them — assert they exist
+        for fam in ("journal_records_total", "journal_bytes_total"):
+            assert any(fam in r and 'tenant="a"' in r for r in ours), fam
     finally:
         svc.close()
     # close() unregistered THIS registry: the mux exposition is back to
@@ -600,8 +605,61 @@ def test_statusz_renders_tenant_table_queue_and_device_lines():
         assert "shape classes:" in sz and "hit_rate=" in sz
         assert "tail sampler:" in sz and "offered=1" in sz
         assert "device: compiles=" in sz
+        # ISSUE 9: the journal section — per-tenant provenance accounting
+        assert "journal:" in sz and "cap=256/tenant" in sz
+        assert any(l.strip().startswith("acme") and "records=" in l
+                   for l in sz.splitlines()), sz
     finally:
         svc.close()
+
+
+def test_tenant_journal_provenance_breach_persist_and_sweep(serving):
+    """ISSUE 9: every ApplyDelta and sim verdict lands in the tenant's
+    bounded journal ring (chained seals); a forced SLO breach persists the
+    ring next to the trace dump (TailSampler-style retention — nothing on
+    disk before the breach); retained traces carry the journal cursor; and
+    drop_tenant zeroes the tenant's journal series."""
+    from kubernetes_autoscaler_tpu.replay.journal import seal_record
+
+    svc, client, dump_dir = serving
+    c = client("jt", slo_budget_ms=1e-6)      # every request breaches
+    assert c.apply_delta(tenant_delta(2))["error"] == ""
+    ts = svc._tenant_peek("jt")
+    assert ts.journal.stats()["records"] == 1   # the delta, pre-breach
+    assert not [f for f in os.listdir(dump_dir)
+                if f.startswith("journal-jt-")]  # nothing persisted yet
+    c.scale_up_sim(max_new_nodes=16, node_groups=NGS)
+    recs = ts.journal.snapshot()
+    assert [r["kind"] for r in recs] == ["delta", "verdict"]
+    assert recs[0]["bytes"] > 0 and recs[0]["payload"]
+    # chained seals verify end to end
+    prev = None
+    for rec in recs:
+        assert seal_record(dict(rec))["digest"] == rec["digest"]
+        if prev is not None:
+            assert rec["parent"] == prev["digest"]
+        prev = rec
+    # the breach persisted the ring (meta line + records, breach reason)
+    jfiles = [f for f in os.listdir(dump_dir) if f.startswith("journal-jt-")]
+    assert len(jfiles) == 1
+    lines = [json.loads(l)
+             for l in open(os.path.join(dump_dir, jfiles[0]))]
+    assert lines[0]["kind"] == "meta" and lines[0]["tenant"] == "jt"
+    assert lines[0]["reason"] == "slo_breach"
+    assert [l["kind"] for l in lines[1:]] == ["delta", "verdict"]
+    assert ts.journal.stats()["persisted"] == 1
+    # the retained breach trace names its replayable record
+    snaps = [s for s in svc.tail.traces() if s.get("tenant") == "jt"]
+    assert snaps
+    assert snaps[-1]["journal_seq"] == ts.journal.cursor()[0]
+    assert snaps[-1]["journal_digest"] == ts.journal.cursor()[1]
+    # journal families are tenant-labelled; drop_tenant sweeps them
+    assert svc.registry.counter("journal_records_total").value(
+        tenant="jt") == 2
+    assert svc.drop_tenant("jt") is True
+    assert svc.registry.counter("journal_records_total").value(
+        tenant="jt") == 0
+    assert svc.registry.counter("journal_bytes_total").value(tenant="jt") == 0
 
 
 def test_stamps_partial_chain_stays_contiguous():

@@ -221,6 +221,59 @@ def test_armed_snapshotz_includes_trace_id_and_dumps(tmp_path):
     assert len(dumps) == 1           # the armed loop persisted the ring
 
 
+def test_snapshotz_and_breach_dump_carry_journal_cursor(tmp_path):
+    """ISSUE 9 satellite: with the flight journal on, an armed /snapshotz
+    payload carries `journalLoop`/`journalDigest`, and an SLO-breach
+    flight-recorder dump's RunOnce span carries the same cursor — either
+    piece of evidence resolves to the exact replayable record."""
+    fake = _world(pending=0)
+    dbg = DebuggingSnapshotter()
+    a = StaticAutoscaler(
+        fake.provider, fake,
+        options=_opts(journal_dir=str(tmp_path / "journal"),
+                      loop_wallclock_budget_s=1e-9,
+                      flight_recorder_dir=str(tmp_path)),
+        eviction_sink=fake, debugging_snapshotter=dbg)
+    handle = dbg.request_snapshot()
+    a.run_once(now=1000.0)           # breaches AND serves the snapshot
+    cur = a.journal.cursor()
+    assert cur is not None
+    payload = json.loads(handle.wait(timeout=5.0))
+    assert payload["journalLoop"] == cur[0]
+    assert payload["journalDigest"] == cur[1]
+    # the breach dump names the same record on its RunOnce span
+    doc = json.loads(max(tmp_path.glob("flight-*.trace.json")).read_text())
+    roots = [e for e in doc["traceEvents"] if e.get("name") == "RunOnce"]
+    assert roots
+    assert roots[-1]["args"]["journal_loop"] == cur[0]
+    assert roots[-1]["args"]["journal_digest"] == cur[1]
+
+
+def test_event_sink_export_is_timestamp_ordered():
+    """ISSUE 9 satellite fix: a dedup-aggregated event refreshes its
+    lastTimestamp, but emitters stamp `now` from different clock domains —
+    ring (update) order is not timestamp order. The /snapshotz export must
+    sort by lastTimestamp so event tails never interleave stale and fresh
+    reasons."""
+    from kubernetes_autoscaler_tpu.events import EventSink
+
+    sink = EventSink()
+    sink.begin_loop()
+    sink.emit("NoScaleUp", obj="p1", reason="cpu", now=100.0)
+    sink.emit("NoScaleDown", obj="n1", reason="NotUnneededLongEnough",
+              now=200.0)
+    # p1's verdict repeats with an EARLIER timestamp (another emitter's
+    # clock domain): it aggregates (count 2) and moves to the ring's end,
+    # but its lastTimestamp (150) is older than n1's (200)
+    sink.emit("NoScaleUp", obj="p1", reason="cpu", now=150.0)
+    sink.end_loop()
+    assert [e.obj for e in sink.events.values()] == ["n1", "p1"]  # ring order
+    snap = sink.snapshot()
+    assert [e["object"] for e in snap] == ["p1", "n1"]   # timestamp order
+    assert [e["lastTimestamp"] for e in snap] == [150.0, 200.0]
+    assert snap[0]["count"] == 2
+
+
 def test_concurrent_snapshotz_arm_during_breach_dumps(tmp_path):
     """Arming /snapshotz from another thread while breaching loops dump the
     recorder must neither deadlock nor leave a handle unresolved."""
