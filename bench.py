@@ -234,6 +234,10 @@ def run_floor_child(metric: str, args) -> int:
         # the record→replay round trip is host-side — it degrades WITH the
         # floor instead of silently disappearing from the evidence
         cmd += ["--journal", args.journal]
+    if args.world_store:
+        # same contract: the delta-vs-full churn evidence survives a dead
+        # tunnel on the CPU floor
+        cmd += ["--world-store"]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     print(f"[bench] degrading to CPU floor metric: {' '.join(cmd[1:])}",
@@ -403,6 +407,17 @@ def main() -> None:
                     help="with --tenants: write the tail sampler's retained "
                          "request traces (slow/breached/failed only) as one "
                          "Perfetto file here")
+    ap.add_argument("--world-store", action="store_true",
+                    help="device-resident world-state smoke (ISSUE 11 / "
+                         "docs/WORLD_STORE.md): drive an N-loop churn "
+                         "sequence through two identical autoscalers — "
+                         "WorldStore delta path vs per-loop full encode — "
+                         "assert decision/verdict byte-identity, and print "
+                         "a world_store_churn JSON line with encode_p50_ms "
+                         "(both paths), h2d bytes per loop, full_encodes "
+                         "and steady-state jit-cache growth (never-null on "
+                         "the CPU floor — the store is host+device "
+                         "bookkeeping, backend-independent)")
     ap.add_argument("--journal", default="", metavar="DIR",
                     help="record a short RunOnce sequence into a "
                          "deterministic flight journal under DIR, measure "
@@ -859,6 +874,18 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
                 "error": f"{type(e).__name__}: {e}",
             }), flush=True)
 
+    if args.world_store:
+        try:
+            with_timeout(lambda: bench_world_store(args), seconds=600)()
+        except Exception as e:
+            print(f"[bench] world-store phase failed: {type(e).__name__}: "
+                  f"{e}", file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "world_store_churn", "value": None, "unit": "ms",
+                "error": f"{type(e).__name__}: {e}",
+            }), flush=True)
+
     if args.journal:
         try:
             with_timeout(lambda: bench_journal(args), seconds=600)()
@@ -881,7 +908,8 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
             print(f"[bench] trace phase failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
 
-    if args.scaledown or args.e2e or args.trace or args.tenants or args.journal:
+    if args.scaledown or args.e2e or args.trace or args.tenants \
+            or args.journal or args.world_store:
         print(primary_line, flush=True)
 
 
@@ -1169,9 +1197,19 @@ def bench_multi_tenant(args) -> None:
                 v.clear()
             hits0, misses0 = svc.ladder.hits, svc.ladder.misses
             cache0 = svc._sim_cache_size()
+
+            def world_h2d() -> float:
+                # sum over every tenant-labelled series: the resident-lane
+                # upload meter (ISSUE 11 — zero on a steady window, because
+                # ApplyDelta-clean tenants stack their device arrays as-is)
+                return svc.registry.counter(
+                    "world_store_h2d_bytes_total").total()
+
+            h2d0 = world_h2d()
             t0 = time.perf_counter()
             storm(rounds)
             wall = time.perf_counter() - t0
+            steady_world_h2d = world_h2d() - h2d0
             steady_recompiles = svc._sim_cache_size() - cache0
             d_hits = svc.ladder.hits - hits0
             d_misses = svc.ladder.misses - misses0
@@ -1264,6 +1302,7 @@ def bench_multi_tenant(args) -> None:
                                   if occ else None),
                 "hit_rate": hit_rate,
                 "steady_recompiles": steady_recompiles,
+                "steady_world_h2d_bytes": steady_world_h2d,
                 "recompiles_per_new_tenant": new_tenant_recompiles,
                 "stats": svc.batch_stats(),
                 "per_tenant": per_tenant,
@@ -1311,6 +1350,9 @@ def bench_multi_tenant(args) -> None:
         "shape_class_hit_rate": round(primary["hit_rate"], 4),
         "recompiles_per_new_tenant": primary["recompiles_per_new_tenant"],
         "steady_state_recompiles": primary["steady_recompiles"],
+        # world residency (ISSUE 11): a steady window re-uses every
+        # tenant's resident device lanes — zero world bytes host→device
+        "steady_world_h2d_bytes": primary["steady_world_h2d_bytes"],
         # serving-grade observability (ISSUE 8): WHERE the serving time
         # goes, per tenant — never-null on the CPU floor (the decomposition
         # is host-side stamping, backend-independent)
@@ -1566,6 +1608,193 @@ def bench_runonce_e2e(args) -> None:
         "event_sink": {"emitted": a.event_sink.emitted,
                        "deduped": a.event_sink.deduped,
                        "dropped": a.event_sink.dropped},
+    }), flush=True)
+
+
+def bench_world_store(args) -> None:
+    """--world-store: delta-applied device residency as bench-evidenced
+    contract (ISSUE 11 / docs/WORLD_STORE.md). Two identical worlds under
+    identical churn drive two autoscalers — WorldStore (incremental) vs
+    per-loop full encode — and every loop's decisions AND verdict plane
+    must match byte-for-byte while the store's encode cost and h2d traffic
+    sit far below the full-encode baseline. Host+device bookkeeping only:
+    the numbers exist on the CPU floor."""
+    import numpy as np
+
+    from kubernetes_autoscaler_tpu.config.options import (
+        AutoscalingOptions,
+        NodeGroupDefaults,
+    )
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+    from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    from kubernetes_autoscaler_tpu.models.api import Toleration
+
+    n_nodes = min(args.nodes, 192)
+    # the win scales with the standing world (full lowering is O(pods),
+    # the delta program O(churn)) — floor the pending set so the smoke
+    # shape measures the contrast, cap it so CI stays cheap
+    n_pend = min(max(args.pods * 2, 4000), 8000)
+    loops = 12
+    churn = 8
+
+    def mk_pending(i: int):
+        # constraint diversity matters: the full-encode baseline pays the
+        # string→tensor lowering (selector/toleration hashing) per pod per
+        # loop, the delta path only for churned pods — the realistic shape
+        # of the win (build_world uses the same mix)
+        g = i % 12
+        return build_test_pod(
+            f"p{i}", cpu_milli=500, mem_mib=512, owner_name=f"prs{g}",
+            labels={"app": f"a{g % 3}"},
+            node_selector={"disk": "ssd"} if g % 4 == 0 else None,
+            tolerations=[Toleration(key="dedicated", operator="Equal",
+                                    value="infra", effect="NoSchedule")]
+            if g % 5 == 0 else None,
+        )
+
+    def build():
+        fake = FakeCluster()
+        tmpl = build_test_node("tmpl", cpu_milli=16000, mem_mib=65536,
+                               pods=110,
+                               labels={"pool": "a", "disk": "ssd"})
+        fake.add_node_group("ng1", tmpl, min_size=0, max_size=4 * n_nodes)
+        for i in range(n_nodes):
+            nd = build_test_node(
+                f"n{i}", cpu_milli=16000, mem_mib=65536, pods=110,
+                labels={"pool": "a" if i % 2 else "b",
+                        "disk": "ssd" if i % 3 else "hdd"})
+            fake.add_existing_node("ng1", nd)
+            for j in range(2):
+                fake.add_pod(build_test_pod(
+                    f"r{i}-{j}", cpu_milli=3200, mem_mib=1024,
+                    owner_name=f"rs{i % 17}", node_name=nd.name))
+        for i in range(n_pend):
+            fake.add_pod(mk_pending(i))
+        return fake
+
+    def opts(inc: bool) -> AutoscalingOptions:
+        return AutoscalingOptions(
+            incremental_encode=inc,
+            node_shape_bucket=64, group_shape_bucket=16,
+            max_new_nodes_static=64, max_pods_per_node=16, drain_chunk=64,
+            node_group_defaults=NodeGroupDefaults(
+                scale_down_unneeded_time_s=3600.0,   # plan, never actuate
+                scale_down_unready_time_s=3600.0),
+        )
+
+    def _jit_cache_sizes() -> int:
+        """Module-level jit caches the RunOnce hot path dispatches into —
+        growth across the steady window means a shape/plan leak, exactly
+        PR 2's steady_state_recompiles, with the store enabled."""
+        import kubernetes_autoscaler_tpu.ops.autoscale_step as a_mod
+        import kubernetes_autoscaler_tpu.ops.binpack as bp
+        import kubernetes_autoscaler_tpu.ops.drain as dr
+        import kubernetes_autoscaler_tpu.ops.pack as pk
+        import kubernetes_autoscaler_tpu.ops.predicates as pr
+        import kubernetes_autoscaler_tpu.ops.scoring as sc
+
+        total = 0
+        for mod in (a_mod, bp, dr, pk, pr, sc):
+            for v in vars(mod).values():
+                if hasattr(v, "_cache_size"):
+                    total += v._cache_size()
+        return total
+
+    worlds = [build(), build()]
+    regs = [Registry(), Registry()]
+    autos = [StaticAutoscaler(w.provider, w, options=opts(inc),
+                              registry=reg, eviction_sink=w)
+             for w, reg, inc in zip(worlds, regs, (True, False))]
+    for a in autos:
+        a.capture_verdicts = True
+
+    def encode_sum(a) -> float:
+        h = a.metrics.histogram("function_duration_seconds")
+        return h._sums.get((("function", "snapshot_build"),), 0.0)
+
+    encode_ms = [[], []]          # per-loop snapshot_build wall, both paths
+    h2d_per_loop: list[int] = []  # store path
+    identical = True
+    seq = 0
+    cache0 = None
+    for loop in range(loops):
+        for w in worlds:
+            for k in range(churn):
+                w.remove_pod(f"p{seq + k}")
+                w.add_pod(mk_pending(n_pend + seq + k))
+            for k in range(2):
+                w.bind(f"p{n_pend + seq + k}", f"n{(seq + k) % n_nodes}")
+        seq += churn
+        now = 1000.0 + 10.0 * loop
+        stats = []
+        for idx, (w, a) in enumerate(zip(worlds, autos)):
+            e0 = encode_sum(a)
+            st = a.run_once(now=now)
+            encode_ms[idx].append((encode_sum(a) - e0) * 1000.0)
+            # verdict plane keyed by equivalence group: row NUMBERING is
+            # encode-path-dependent (the store keeps historical rows, a
+            # full encode renumbers per listing) — identity must hold on
+            # the group-keyed view, byte-for-byte
+            verdict = tuple(sorted(
+                (key, int(cnt)) for key, cnt in zip(
+                    a.last_verdict_keys or [],
+                    a.last_verdict_plane
+                    if a.last_verdict_plane is not None else [])
+                if key is not None))
+            stats.append((
+                sorted(st.scale_up.increases.items())
+                if st.scale_up else None,
+                sorted(st.unneeded_nodes), sorted(st.scale_down_deleted),
+                st.pending_pods,
+                verdict,
+            ))
+        identical = identical and stats[0] == stats[1]
+        h2d_per_loop.append(autos[0]._world_store.last_h2d_bytes)
+        if loop == 0:
+            cache0 = _jit_cache_sizes()
+    steady_recompiles = _jit_cache_sizes() - cache0
+
+    store = autos[0]._world_store
+    enc_inc = encode_ms[0][1:]     # steady: skip the seed/compile loop
+    enc_full = encode_ms[1][1:]
+    p50_inc = float(np.percentile(enc_inc, 50))
+    p50_full = float(np.percentile(enc_full, 50))
+    h2d_full = h2d_per_loop[0]
+    h2d_delta_p50 = float(np.percentile(h2d_per_loop[1:], 50))
+    print(f"[bench-world-store] nodes={n_nodes} resident={2 * n_nodes} "
+          f"pending={n_pend} loops={loops} "
+          f"encode_p50_ms delta={p50_inc:.2f} full={p50_full:.2f} "
+          f"({p50_full / max(p50_inc, 1e-9):.1f}x) "
+          f"h2d full={h2d_full}B delta_p50={h2d_delta_p50:.0f}B "
+          f"({h2d_full / max(h2d_delta_p50, 1e-9):.1f}x) "
+          f"modes={json.dumps(store.stats()['modes'])} "
+          f"identical={identical}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "world_store_churn",
+        "value": round(p50_inc, 3),
+        "unit": "ms",
+        "backend": ("cpu-floor" if args.smoke or args.floor_for
+                    else __import__("jax").default_backend()),
+        "loops": loops,
+        "churn_per_loop": churn,
+        "nodes": n_nodes,
+        "encode_p50_ms": round(p50_inc, 3),
+        "full_encode_p50_ms": round(p50_full, 3),
+        "encode_speedup_vs_full": round(p50_full / max(p50_inc, 1e-9), 2),
+        "full_encodes": store.encoder.full_encodes,
+        "h2d_bytes_full_loop": h2d_full,
+        "h2d_bytes_per_loop_p50": h2d_delta_p50,
+        "h2d_reduction_vs_full": round(
+            h2d_full / max(h2d_delta_p50, 1e-9), 2),
+        "modes": store.stats()["modes"],
+        "verdicts_identical": identical,
+        "steady_state_recompiles": steady_recompiles,
     }), flush=True)
 
 

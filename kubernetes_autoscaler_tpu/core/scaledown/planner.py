@@ -190,6 +190,14 @@ class Planner:
         # constrained-tier marshal cache + the cached eligibility plane
         self._marshal_cache: _MarshalArtifacts | None = None
         self._elig_cache: tuple | None = None   # (key arrays, elig u8[G, N])
+        # composition-fingerprint memo (utils/canonical.IdentityMemo): the
+        # marshal-cache key walks every exemplar's constraint spec each
+        # loop; memoizing per-object identity makes the fingerprint itself
+        # O(churn) — the WorldStore discipline extended to this encode-path
+        # cache (docs/WORLD_STORE.md)
+        from kubernetes_autoscaler_tpu.utils.canonical import IdentityMemo
+
+        self._exemplar_sig_memo = IdentityMemo(self._exemplar_sig)
         self.marshal_cache_hits = 0
         self.marshal_cache_misses = 0
         self.elig_cache_hits = 0
@@ -647,9 +655,11 @@ class Planner:
         ns_sig = (None if enc.namespaces is None else
                   tuple(sorted((ns, tuple(sorted(lbls.items())))
                                for ns, lbls in enc.namespaces.items())))
+        rows = sorted(exemplars)
+        sigs = self._exemplar_sig_memo.refresh(
+            [exemplars[r] for r in rows])
         fp = (g_total,
-              tuple(sorted((row, self._exemplar_sig(p))
-                           for row, p in exemplars.items())),
+              tuple(sorted(zip(rows, sigs))),
               ns_sig)
         return exemplars, fp
 

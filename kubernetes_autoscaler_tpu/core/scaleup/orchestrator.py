@@ -113,6 +113,16 @@ class ScaleUpOrchestrator:
         # accepted scale-up and are refreshed as two small arrays instead of
         # re-encoding + re-uploading the whole NodeGroupTensors per loop
         self._group_tensor_cache: tuple | None = None
+        # composition-fingerprint memos (utils/canonical.IdentityMemo): the
+        # template-tensor cache key used to re-walk every template's labels/
+        # taints/capacity and every DaemonSet's spec each loop; per-object
+        # identity memoization makes the key O(churn) under the repo-wide
+        # replace-on-update contract (the WorldStore discipline extended to
+        # this encode-path cache — docs/WORLD_STORE.md)
+        from kubernetes_autoscaler_tpu.utils.canonical import IdentityMemo
+
+        self._template_sig_memo = IdentityMemo(self._template_sig)
+        self._workload_sig_memo = IdentityMemo(self._workload_sig)
         # DaemonSet workloads for template DS-overhead charging (set per
         # loop by StaticAutoscaler; reference: node_info_utils.go:45 threads
         # the daemonset lister into every sanitized template)
@@ -445,6 +455,34 @@ class ScaleUpOrchestrator:
         return [resolved[id(o)] for o in options
                 if resolved.get(id(o)) is not None]
 
+    @staticmethod
+    def _template_sig(tmpl) -> tuple:
+        """Content signature of one template node for the group-tensor cache
+        key (memoized by object identity via IdentityMemo — providers that
+        return a cached template object pay O(1) per loop; providers that
+        mint a fresh Node per call recompute, exactly the old behavior)."""
+        return (tmpl.name, tuple(sorted(tmpl.labels.items())),
+                tuple((t.key, t.value, t.effect) for t in tmpl.taints),
+                tuple(sorted((k, float(v))
+                             for k, v in tmpl.alloc_or_cap().items())))
+
+    @staticmethod
+    def _workload_sig(w) -> tuple:
+        """DS churn changes the charged capacity rows — every field
+        daemonset_overhead consults: requests + overhead (the charge),
+        selector/affinity/tolerations (the node match)."""
+        return (w.namespace, w.name, w.uid,
+                (tuple(sorted((k, float(v))
+                              for k, v in w.template.requests.items())),
+                 tuple(sorted((k, float(v))
+                              for k, v in w.template.overhead.items())),
+                 tuple(sorted(w.template.node_selector.items())),
+                 tuple(tuple((r.key, r.operator, r.values) for r in term)
+                       for term in w.template.affinity_node_terms()),
+                 tuple((t.key, t.value, t.effect, t.operator)
+                       for t in w.template.tolerations))
+                if w.template is not None else None)
+
     def _group_tensors(self, templates, enc):
         """encode_node_groups with the static planes cached across loops."""
         import jax.numpy as jnp
@@ -452,36 +490,15 @@ class ScaleUpOrchestrator:
         from kubernetes_autoscaler_tpu.models.cluster_state import pad_to
 
         fp = (
-            tuple(
-                (tmpl.name, tuple(sorted(tmpl.labels.items())),
-                 tuple((t.key, t.value, t.effect) for t in tmpl.taints),
-                 tuple(sorted((k, float(v))
-                              for k, v in tmpl.alloc_or_cap().items())))
-                for tmpl, _mx, _pr in templates
-            ),
+            tuple(self._template_sig_memo.refresh(
+                [tmpl for tmpl, _mx, _pr in templates])),
             # the full MAPPINGS, not their sizes: a rebuild can reassign
             # the same number of slot/zone ids in a different first-seen
             # order
             tuple(sorted(enc.registry.slots.items())),
             tuple(sorted(enc.zone_table.ids.items())),
             enc.dims,
-            # DS churn changes the charged capacity rows — every field
-            # daemonset_overhead consults: requests + overhead (the charge),
-            # selector/affinity/tolerations (the node match)
-            tuple(
-                (w.namespace, w.name, w.uid,
-                 (tuple(sorted((k, float(v))
-                               for k, v in w.template.requests.items())),
-                  tuple(sorted((k, float(v))
-                               for k, v in w.template.overhead.items())),
-                  tuple(sorted(w.template.node_selector.items())),
-                  tuple(tuple((r.key, r.operator, r.values) for r in term)
-                        for term in w.template.affinity_node_terms()),
-                  tuple((t.key, t.value, t.effect, t.operator)
-                        for t in w.template.tolerations))
-                 if w.template is not None else None)
-                for w in self.daemonsets
-            ),
+            tuple(self._workload_sig_memo.refresh(self.daemonsets)),
         )
         cached = self._group_tensor_cache
         if cached is not None and cached[0] == fp:

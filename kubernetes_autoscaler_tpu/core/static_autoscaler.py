@@ -205,9 +205,14 @@ class StaticAutoscaler:
                 max_mb=self.options.journal_max_mb,
                 registry=self.metrics, options=self.options)
         # replay harness sets this to capture the verdict plane without a
-        # writer; the plane fetch is one tiny int32[G] device read
+        # writer; the plane fetch is one tiny int32[G] device read.
+        # last_verdict_keys maps plane rows to equivalence keys — row
+        # NUMBERING is encode-path-dependent (the incremental encoder keeps
+        # historical rows, a full encode renumbers per listing), so
+        # cross-encode-mode byte comparison must key rows by group identity
         self.capture_verdicts = False
         self.last_verdict_plane = None
+        self.last_verdict_keys = None
         self._journal_cursor: tuple[int, str] | None = None
         self._async_group_of: dict[str, str] = {}
         self.actuator = Actuator(provider, self.options, eviction_sink,
@@ -229,8 +234,12 @@ class StaticAutoscaler:
         # one-time crash recovery on the first loop (reference:
         # cleanUpIfRequired static_autoscaler.go:258 + planner.go:91-93)
         self._startup_recovery_done = False
-        # incremental snapshot maintenance (models/incremental.py); created
-        # lazily so DrainOptions reflect the live flag values
+        # device-resident world state (models/world_store.py wrapping the
+        # incremental encoder, models/incremental.py); created lazily so
+        # DrainOptions reflect the live flag values. `_encoder` stays the
+        # underlying IncrementalEncoder for compatibility and the
+        # DRA/CSI invalidate path.
+        self._world_store = None
         self._encoder = None
         self._last_lowering_key = None
 
@@ -494,35 +503,41 @@ class StaticAutoscaler:
             # sources without Namespace objects leave it None
             list_ns = getattr(self.source, "list_namespaces", None)
             ns_labels = list_ns() if list_ns is not None else None
+            from kubernetes_autoscaler_tpu.models.world_store import (
+                ENCODES_HELP,
+                H2D_HELP,
+            )
+
             with self.metrics.time_function("snapshot_build"), \
                     self.planner.phases.phase("encode"):
                 if self.options.incremental_encode:
-                    if self._encoder is None or \
-                            self._encoder.drain_opts != drain_opts:
-                        from kubernetes_autoscaler_tpu.models.incremental import (
-                            IncrementalEncoder,
+                    if self._world_store is None or \
+                            self._world_store.drain_opts != drain_opts:
+                        from kubernetes_autoscaler_tpu.models.world_store import (
+                            WorldStore,
                         )
 
-                        self._encoder = IncrementalEncoder(
+                        self._world_store = WorldStore(
+                            registry=self.metrics,
                             node_bucket=self.options.node_shape_bucket,
                             group_bucket=self.options.group_shape_bucket,
                             drain_opts=drain_opts,
                             resync_loops=self.options.incremental_resync_loops,
                             verify_loops=self.options.incremental_verify_loops,
                         )
+                        self._encoder = self._world_store.encoder
                     fails_before = self._encoder.verify_failures
-                    full_before = self._encoder.full_encodes
-                    enc = self._encoder.encode(
+                    enc = self._world_store.encode(
                         nodes, pods, node_group_ids=node_group_ids,
                         now=now, pdb_namespaced_names=frozenset(pdb_names),
                         namespaces=ns_labels)
-                    if self._encoder.full_encodes > full_before:
+                    if self._world_store.last_mode == "full":
                         # a full re-encode rebuilds device tensors from
                         # scratch — the loop-level recompile-risk event the
-                        # trace/registry counters track
-                        self.planner.phases.bump(
-                            "encoder_full_encodes",
-                            self._encoder.full_encodes - full_before)
+                        # trace/registry counters track (the REASONED
+                        # breakdown rides encoder_encodes_total{mode,cause},
+                        # emitted by the store itself)
+                        self.planner.phases.bump("encoder_full_encodes")
                     if self._encoder.verify_failures > fails_before:
                         self.metrics.counter(
                             "incremental_verify_failures_total").inc(
@@ -537,6 +552,15 @@ class StaticAutoscaler:
                     )
                     apply_drainability(enc, drain_opts, now=now,
                                        pdb_namespaced_names=pdb_names)
+                    # counter parity with the store-enabled path: every
+                    # loop here is a full re-encode + full re-upload
+                    self.metrics.counter(
+                        "encoder_encodes_total", help=ENCODES_HELP).inc(
+                        mode="full", cause="forced")
+                    self.metrics.counter(
+                        "world_store_h2d_bytes_total", help=H2D_HELP).inc(
+                        sum(int(v.nbytes)
+                            for v in (enc.host_arrays or {}).values()))
             if self.quota is not None:
                 self.quota.registry = enc.registry
             self.scale_up_orchestrator.quota = self.quota
@@ -582,6 +606,17 @@ class StaticAutoscaler:
                     packed.scheduled).astype(np.int32)
                 if self.journal is not None:
                     self.journal.overhead_ns += time.perf_counter_ns() - jt0
+                if self.capture_verdicts:
+                    from kubernetes_autoscaler_tpu.models.encode import (
+                        equivalence_key,
+                    )
+
+                    keys = [None] * int(self.last_verdict_plane.shape[0])
+                    for row, idxs in enumerate(enc.group_pods):
+                        if idxs and row < len(keys):
+                            keys[row] = equivalence_key(
+                                enc.pending_pods[idxs[0]])
+                    self.last_verdict_keys = keys
             remaining = int(np.asarray(snapshot.state.specs.count).sum())
             if dbg is not None and dbg.is_data_collection_allowed():
                 scheduled_counts = np.asarray(packed.scheduled)

@@ -35,6 +35,13 @@ class Counter:
         with self._lock:
             return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def total(self) -> float:
+        """Sum over EVERY label combination — e.g. the whole-process h2d
+        byte total across tenant-labelled series (value() reads exactly one
+        series and misses the labelled ones)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def zero_matching(self, **labels) -> None:
         """Stale-label zeroing (the reason-plane convention from the status
         layer): every series whose label set CONTAINS `labels` resets to 0 —

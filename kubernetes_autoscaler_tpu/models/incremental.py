@@ -65,6 +65,7 @@ from kubernetes_autoscaler_tpu.models.encode import (
     node_capacity_vector,
     resident_plane_hits,
 )
+from kubernetes_autoscaler_tpu.models.world_store import DevicePlaneStore
 from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
     DrainOptions,
     Verdict,
@@ -72,6 +73,7 @@ from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
     classify_pod,
     owner_replica_counts,
 )
+from kubernetes_autoscaler_tpu.utils.canonical import node_fp as _node_fp
 from kubernetes_autoscaler_tpu.utils.hashing import fold32
 
 _TERMINAL = ("Succeeded", "Failed")
@@ -111,19 +113,6 @@ class _NodeRec:
     idx: int
     fp: tuple
     gid: int
-
-
-def _node_fp(nd: Node) -> tuple:
-    """Cheap change fingerprint for a Node. Catches the in-place mutations the
-    control plane itself performs (ready flips, cordons, taint sync); label/
-    capacity map REPLACEMENT is caught via id() — in-place mutation of those
-    dicts is outside the source contract (k8s replaces objects on update)."""
-    return (
-        nd.ready, nd.unschedulable,
-        tuple((t.key, t.value, t.effect) for t in nd.taints),
-        id(nd.labels), id(nd.allocatable), id(nd.capacity),
-        id(nd.annotations),
-    )
 
 
 _STD_RES = {0: "cpu", 1: "memory", 2: "ephemeral", 3: "pods"}
@@ -279,6 +268,16 @@ class IncrementalEncoder:
         self.last_verify_error: str | None = None
         self.loops = 0
         self.full_encodes = 0       # observability: forced/initial full builds
+        # device residency layer (models/world_store.DevicePlaneStore): the
+        # per-plane device shadow + dirty tracking + scatter/replace upload
+        # path, with h2d byte accounting — the WorldStore wrapper reads its
+        # per-loop delta-program record to classify the encode mode
+        self.device_store = DevicePlaneStore()
+        # why the last full encode ran (WorldStore's `cause` label):
+        # initial | fingerprint_miss | shape_overflow | forced
+        self.last_full_cause: str | None = None
+        self.grew_this_loop = False    # any plane crossed its padded bucket
+        self._invalidated = False
         self._seeded = False
         self._seq = 0
 
@@ -290,6 +289,7 @@ class IncrementalEncoder:
         Node/Pod objects in place — a change object-identity diffing cannot
         see (the snapshots' content_key comparison drives this)."""
         self._seeded = False
+        self._invalidated = True   # cause label: fingerprint_miss
 
     def encode(
         self,
@@ -301,18 +301,22 @@ class IncrementalEncoder:
         namespaces: dict[str, dict[str, str]] | None = None,
     ) -> EncodedCluster:
         self.loops += 1
+        self.grew_this_loop = False
         node_group_ids = node_group_ids or {}
         self._namespaces = namespaces
         if (not self._seeded
                 or (self.resync_loops and self.loops % self.resync_loops == 0)):
+            cause = ("initial" if self.full_encodes == 0
+                     else "fingerprint_miss" if self._invalidated
+                     else "forced")
             return self._full(nodes, pods, node_group_ids, now,
-                              pdb_namespaced_names)
+                              pdb_namespaced_names, cause=cause)
         try:
             self._apply_diff(nodes, pods, node_group_ids, now,
                              pdb_namespaced_names)
-        except _ResyncNeeded:
+        except _ResyncNeeded as e:
             return self._full(nodes, pods, node_group_ids, now,
-                              pdb_namespaced_names)
+                              pdb_namespaced_names, cause=e.reason)
         except Exception:
             # an exception mid-diff (e.g. hostPort/dims overflow) leaves the
             # mirrors half-mutated — poison the state so the NEXT loop full-
@@ -352,13 +356,16 @@ class IncrementalEncoder:
             "incremental-encode contract violation (source mutated objects "
             "in place?) — forcing resync: %s", diff)
         self._seeded = False
-        return self._full(nodes, pods, node_group_ids, now, pdb_names)
+        return self._full(nodes, pods, node_group_ids, now, pdb_names,
+                          cause="fingerprint_miss")
 
     # ----------------------------------------------------------- full build
 
-    def _full(self, nodes, pods, node_group_ids, now, pdb_names
-              ) -> EncodedCluster:
+    def _full(self, nodes, pods, node_group_ids, now, pdb_names,
+              cause: str = "forced") -> EncodedCluster:
         self.full_encodes += 1
+        self.last_full_cause = cause
+        self._invalidated = False
         enc = encode_cluster(
             nodes, pods, registry=self.registry, dims=self.dims,
             node_group_ids=node_group_ids, node_bucket=self.node_bucket,
@@ -367,20 +374,28 @@ class IncrementalEncoder:
         )
         # mirrors: own copies (device arrays must never alias a mutating mirror)
         self._m = {k: v.copy() for k, v in enc.host_arrays.items()}
-        # seed the device cache from the arrays encode_cluster ALREADY
+        # seed the device store from the arrays encode_cluster ALREADY
         # uploaded (identical content) — re-uploading the multi-MB planes a
         # second time would double the seed-loop tunnel cost. Only the
-        # drainability verdicts (classified below, after this seed) differ.
-        self._dev: dict[str, object] = {}
+        # drainability verdicts (classified below, after this seed) differ:
+        # they stay UNseeded so the handout replaces them wholesale.
+        devs: dict[str, object] = {}
         for section, tree in (("nodes", enc.nodes), ("specs", enc.specs),
                               ("scheduled", enc.scheduled),
                               ("planes", enc.planes)):
             for f in {"nodes": _NODE_FIELDS, "specs": _SPEC_FIELDS,
                       "scheduled": _SCHED_FIELDS,
                       "planes": _PLANE_FIELDS}[section]:
-                self._dev[f"{section}.{f}"] = getattr(tree, f)
-        self._dirty: set[str] = {"scheduled.movable", "scheduled.blocks"}
-        self._dirty_rows: dict[str, set[int] | None] = {}
+                devs[f"{section}.{f}"] = getattr(tree, f)
+        unseeded = ("scheduled.movable", "scheduled.blocks")
+        for key in unseeded:
+            devs.pop(key, None)
+        self.device_store.seed(
+            devs,
+            seed_bytes=sum(int(v.nbytes) for k, v in self._m.items()
+                           if k not in unseeded))
+        for key in unseeded:
+            self.device_store.mark_all(key)
 
         self.zone_table = enc.zone_table
         self._zones_fit = (len(self.zone_table.ids) + 1 <= self.dims.max_zones)
@@ -887,7 +902,8 @@ class IncrementalEncoder:
         row = encode_node_row(nd, self.registry, self.zone_table, self.dims)
         if len(self.zone_table.ids) + 1 > self.dims.max_zones \
                 and self._zones_fit:
-            raise _ResyncNeeded  # zone overflow flips encoding mode
+            raise _ResyncNeeded("shape_overflow")  # zone overflow flips
+            # the encoding mode (apply_zone_overflow drops zone coupling)
         m = self._m
         m["nodes.cap"][idx] = row["cap"]
         m["nodes.alloc"][idx] = 0
@@ -912,7 +928,7 @@ class IncrementalEncoder:
         row = encode_node_row(nd, self.registry, self.zone_table, self.dims)
         if len(self.zone_table.ids) + 1 > self.dims.max_zones \
                 and self._zones_fit:
-            raise _ResyncNeeded
+            raise _ResyncNeeded("shape_overflow")
         m = self._m
         for f, v in (("cap", row["cap"]), ("label_hash", row["label_hash"]),
                      ("taint_exact", row["taint_exact"]),
@@ -964,8 +980,7 @@ class IncrementalEncoder:
             if len(perm):
                 new[:len(perm)] = old[perm]
             m[k] = new
-            self._dirty.add(k)
-            self._dirty_rows[k] = None
+            self.device_store.mark_all(k)
         for f in _PLANE_FIELDS:
             k = f"planes.{f}"
             old = m[k]
@@ -973,15 +988,13 @@ class IncrementalEncoder:
             if len(perm):
                 new[:, :len(perm)] = old[:, perm]
             m[k] = new
-            self._dirty.add(k)
-            self._dirty_rows[k] = None
+            self.device_store.mark_all(k)
         remap = np.full((old_n,), -1, np.int64)
         remap[perm] = np.arange(len(perm))
         ni = m["scheduled.node_idx"]
         m["scheduled.node_idx"] = np.where(
             ni >= 0, remap[np.clip(ni, 0, old_n - 1)], -1).astype(ni.dtype)
-        self._dirty.add("scheduled.node_idx")
-        self._dirty_rows["scheduled.node_idx"] = None
+        self.device_store.mark_all("scheduled.node_idx")
         self._slots_by_node = {
             int(remap[i]): s for i, s in self._slots_by_node.items()
             if remap[i] >= 0}
@@ -997,80 +1010,49 @@ class IncrementalEncoder:
     # --------------------------------------------------------------- growth
 
     def _grow_nodes(self, new_n: int) -> None:
+        self.grew_this_loop = True
         for f in _NODE_FIELDS:
             k = f"nodes.{f}"
             self._m[k] = _grow_axis0(self._m[k], new_n,
                                      fill=-1 if f == "group_id" else 0)
-            self._dirty_rows[k] = None
-            self._dirty.add(k)
+            self.device_store.mark_all(k)
         for f in _PLANE_FIELDS:
             k = f"planes.{f}"
             old = self._m[k]
             grown = np.zeros((old.shape[0], new_n), old.dtype)
             grown[:, :old.shape[1]] = old
             self._m[k] = grown
-            self._dirty_rows[k] = None
-            self._dirty.add(k)
+            self.device_store.mark_all(k)
 
     def _grow_specs(self, new_g: int) -> None:
+        self.grew_this_loop = True
         for f in _SPEC_FIELDS:
             k = f"specs.{f}"
             self._m[k] = _grow_axis0(self._m[k], new_g)
-            self._dirty_rows[k] = None
-            self._dirty.add(k)
+            self.device_store.mark_all(k)
         for f in _PLANE_FIELDS:
             k = f"planes.{f}"
             self._m[k] = _grow_axis0(self._m[k], new_g)
-            self._dirty_rows[k] = None
-            self._dirty.add(k)
+            self.device_store.mark_all(k)
 
     def _grow_scheduled(self, new_p: int) -> None:
+        self.grew_this_loop = True
         for f in _SCHED_FIELDS:
             k = f"scheduled.{f}"
             self._m[k] = _grow_axis0(self._m[k], new_p,
                                      fill=-1 if f == "node_idx" else 0)
-            self._dirty_rows[k] = None
-            self._dirty.add(k)
+            self.device_store.mark_all(k)
         self._slot_recs.extend([None] * (new_p - len(self._slot_recs)))
 
     # -------------------------------------------------------------- handout
 
     def _mark(self, key: str, row: int) -> None:
-        self._dirty.add(key)
-        rows = self._dirty_rows.get(key, _UNSET)
-        if rows is _UNSET:
-            self._dirty_rows[key] = {row}
-        elif rows is not None:
-            rows.add(row)
+        self.device_store.mark(key, row)
 
     def _upload(self, key: str):
-        import jax.numpy as jnp
-
-        mirror = self._m[key]
-        if key not in self._dirty:
-            cached = self._dev.get(key)
-            if cached is not None:
-                return cached
-        rows = self._dirty_rows.get(key)
-        cached = self._dev.get(key)
-        if (cached is not None and rows is not None
-                and cached.shape == mirror.shape
-                and 0 < len(rows) <= max(64, mirror.shape[0] // 16)):
-            idx = np.fromiter(rows, np.int64, len(rows))
-            # pad the delta batch to a shape bucket so the XLA scatter stays
-            # compile-cached across loops (idx length varies per loop; a
-            # fresh shape would recompile ~50 ms each — the same trap the
-            # sim kernels avoid with bucketed padding). Duplicate trailing
-            # indices write the same value twice: harmless.
-            bucket = 64
-            while bucket < len(idx):
-                bucket *= 4
-            idx = np.concatenate([idx, np.full(bucket - len(idx), idx[0])])
-            dev = cached.at[jnp.asarray(idx)].set(jnp.asarray(mirror[idx]))
-        else:
-            dev = jnp.asarray(mirror)
-        self._dev[key] = dev
-        return dev
+        # scatter-vs-replace choice, byte accounting and the delta-program
+        # record live in the residency layer (world_store.DevicePlaneStore)
+        return self.device_store.upload(key, self._m[key])
 
     def _handout(self) -> EncodedCluster:
         if self._pending_lists_dirty:
@@ -1093,9 +1075,10 @@ class IncrementalEncoder:
                                            for f in _SCHED_FIELDS})
         planes = AffinityPlanes(**{f: self._upload(f"planes.{f}")
                                    for f in _PLANE_FIELDS})
-        self._dirty.clear()
-        self._dirty_rows.clear()
-        token = dict(self._dev)  # array objects, compared with `is`
+        # close the loop's delta program (publishes last_actions +
+        # last_h2d_bytes for the WorldStore's mode classification)
+        self.device_store.finish_loop()
+        token = self.device_store.token()  # array objects, compared with `is`
         return EncodedCluster(
             nodes=nodes, specs=specs, scheduled=scheduled,
             node_names=list(self._node_names),
@@ -1118,10 +1101,15 @@ class IncrementalEncoder:
 
 class _ResyncNeeded(Exception):
     """Internal: structural change the delta path does not model — fall back
-    to a full encode (same result, just slower this one loop)."""
+    to a full encode (same result, just slower this one loop). `reason` is
+    the WorldStore's cause label: "shape_overflow" when the encoding's
+    static shape assumptions broke (zone-table overflow flips the encoding
+    mode), "forced" for malformed-source structural falls (duplicate names,
+    ghost-row reuse)."""
 
-
-_UNSET = object()
+    def __init__(self, reason: str = "forced"):
+        super().__init__(reason)
+        self.reason = reason
 
 
 def _grow_axis0(a: np.ndarray, new_n: int, fill=0) -> np.ndarray:

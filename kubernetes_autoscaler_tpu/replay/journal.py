@@ -38,7 +38,6 @@ tenant table.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import json
 import os
 import time
@@ -68,24 +67,18 @@ _DROPPED_HELP = "Flight-journal records dropped, by reason"
 
 
 # ---- canonical encoding + digests ----
+#
+# One shared implementation (utils/canonical.py) for the journal AND the
+# device-resident WorldStore (models/world_store.py): both must agree on
+# what "changed" means, by construction — re-exported here because the
+# journal is the historical home these names are imported from.
 
-def canonical(obj) -> str:
-    """Deterministic JSON: sorted keys, no whitespace, default=str for the
-    rare non-JSON leaf. Tuples and lists both serialize as arrays, so a
-    live-object encoding and its JSON round trip share one canonical form."""
-    return json.dumps(obj, sort_keys=True, separators=(",", ":"), default=str)
-
-
-def digest_of(obj) -> str:
-    return hashlib.sha256(canonical(obj).encode()).hexdigest()[:16]
-
-
-def _digest_strs(parts: list[str]) -> str:
-    h = hashlib.sha256()
-    for p in parts:
-        h.update(p.encode())
-        h.update(b"\n")
-    return h.hexdigest()[:16]
+from kubernetes_autoscaler_tpu.utils.canonical import (  # noqa: F401
+    canon_map as _canon_map,
+    canonical,
+    digest_of,
+    digest_strs as _digest_strs,
+)
 
 
 def backend_identity(node_bucket: int | None = None,
@@ -291,22 +284,6 @@ class _WorldIndex:
         return world_digest(list(self.nodes.values()),
                             list(self.pods.values()),
                             list(self.groups.values()))
-
-
-def _canon_map(objs, key_of, to_dict, cache: dict
-               ) -> tuple[dict, dict[str, str]]:
-    """Ordered key → canonical map, reusing cached canonical forms for
-    objects whose IDENTITY is unchanged (replace-on-update contract).
-    Returns (new cache holding only live objects, the map)."""
-    new_cache: dict[int, tuple] = {}
-    out: dict[str, str] = {}
-    for obj in objs:
-        hit = cache.get(id(obj))
-        canon = hit[1] if hit is not None and hit[0] is obj \
-            else canonical(to_dict(obj))
-        new_cache[id(obj)] = (obj, canon)
-        out[key_of(obj)] = canon
-    return new_cache, out
 
 
 def _section_delta(prev: dict[str, str], cur: dict[str, str]

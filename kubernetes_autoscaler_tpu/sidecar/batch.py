@@ -43,8 +43,10 @@ from kubernetes_autoscaler_tpu.models.cluster_state import (
 
 @dataclass
 class UpLane:
-    """Prepared scale-up input for one tenant: class-shaped numpy world
-    (export cache) + the request's encoded node-group templates."""
+    """Prepared scale-up input for one tenant: class-shaped world sections
+    (the tenant's RESIDENT device arrays from server._export_dev — numpy
+    also accepted for tests/tools) + the request's encoded node-group
+    template fields."""
 
     nodes: dict
     groups: dict
@@ -59,6 +61,10 @@ class DownLane:
     groups: dict
     pods: dict
     threshold: float
+    # host copy of the nodes-section valid mask for response assembly —
+    # device lanes must not force a d2h round trip per member just to
+    # index the fetched results
+    valid_np: np.ndarray | None = None
 
 
 # ---- numpy export → tensor structs (single or lane-stacked) ----
@@ -136,23 +142,17 @@ def nodegroup_np(t: NodeGroupTensors) -> dict:
 
 
 def stack_fields(dicts: list[dict]) -> dict:
-    """np.stack each field over a new leading lane axis."""
-    return {k: np.stack([d[k] for d in dicts]) for k in dicts[0]}
+    """Stack each field over a new leading lane axis. Device lanes (the
+    resident per-tenant arrays) stack ON-DEVICE via jnp.stack — zero h2d
+    world bytes per window; numpy lanes keep the host np.stack (uploaded
+    once by the tensor-struct casts), preserving the legacy path for
+    tests/tools that build lanes from numpy exports."""
+    first = dicts[0]
+    if any(not isinstance(v, np.ndarray) for v in first.values()):
+        import jax.numpy as jnp
 
-
-def stacked_nbytes(lanes_list: list) -> int:
-    """Host→device upload size of a stacked batch: the summed nbytes of
-    every numpy field across lanes (the tensor-struct casts upload exactly
-    these buffers). Feeds `device_transfer_bytes_total{direction="h2d"}` —
-    charged only on stack-cache MISSES, since a hit re-uses the resident
-    device pytree and moves nothing."""
-    total = 0
-    for ln in lanes_list:
-        for d in (ln.nodes, ln.groups, ln.pods) + (
-                (ln.ng,) if isinstance(ln, UpLane) else ()):
-            total += sum(int(a.nbytes) for a in d.values()
-                         if hasattr(a, "nbytes"))
-    return total
+        return {k: jnp.stack([d[k] for d in dicts]) for k in first}
+    return {k: np.stack([d[k] for d in dicts]) for k in first}
 
 
 def pad_lanes(items: list, lanes: int) -> list:
@@ -272,7 +272,10 @@ def assemble_up(host: dict, members: list[UpLane]) -> list[dict]:
 def assemble_down(host: dict, members: list[DownLane]) -> list[dict]:
     out = []
     for i, ln in enumerate(members):
-        valid = ln.nodes["valid"].astype(bool)
+        # device lanes carry a host copy of the valid mask (valid_np) so
+        # assembly never round-trips to the device
+        valid_src = ln.valid_np if ln.valid_np is not None else ln.nodes["valid"]
+        valid = np.asarray(valid_src).astype(bool)
         out.append({
             "eligible": np.nonzero(host["eligible"][i] & valid)[0].tolist(),
             "drainable": np.nonzero(host["drainable"][i] & valid)[0].tolist(),

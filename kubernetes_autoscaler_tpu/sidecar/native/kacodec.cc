@@ -98,6 +98,16 @@ struct PodRow {
   bool movable = false, blocks = false, valid = true;
 };
 
+// Export-section dirtiness bits: which of the three export surfaces
+// (ka_export_nodes / ka_export_groups / ka_export_pods) a delta op can
+// change. Node ops touch the node tensors only; a PENDING pod touches the
+// group tensors only (its row spec + the pending count); a RESIDENT pod
+// touches the scheduled-pod tensors AND the node tensors (alloc/used_ports
+// are derived from resident pods at export time).
+constexpr unsigned kSecNodes = 1u << 0;
+constexpr unsigned kSecGroups = 1u << 1;
+constexpr unsigned kSecPods = 1u << 2;
+
 struct State {
   Dims dims;
   std::vector<NodeRow> nodes;
@@ -109,6 +119,12 @@ struct State {
   std::unordered_map<std::string, int32_t> zone_ids;
   std::vector<int> free_node_rows, free_pod_rows;
   uint64_t version = 0;
+  // per-export-section versions (0 = nodes, 1 = groups, 2 = pods): bumped
+  // once per apply_delta for each section the delta's ops could change —
+  // the python sidecar keys its plane-granular export/device caches on
+  // these so a single-pod delta never re-materializes untouched planes
+  // (ISSUE 11 satellite; ka_section_version).
+  uint64_t section_versions[3] = {0, 0, 0};
   std::string error;
 };
 
@@ -228,7 +244,7 @@ bool parse_node(State* st, Reader* r) {
   return true;
 }
 
-bool parse_pod(State* st, Reader* r) {
+bool parse_pod(State* st, Reader* r, unsigned* mask) {
   PodRow pod;
   GroupRow g;
   pod.uid = r->str();
@@ -307,6 +323,7 @@ bool parse_pod(State* st, Reader* r) {
 
   auto git = st->group_index.find(eqkey);
   if (git == st->group_index.end()) {
+    *mask |= kSecGroups;  // fresh equivalence row enters the group export
     st->group_index[eqkey] = static_cast<int>(st->groups.size());
     st->groups.push_back(std::move(g));
     git = st->group_index.find(eqkey);
@@ -321,9 +338,15 @@ bool parse_pod(State* st, Reader* r) {
     }
     pod.node_idx = nit->second;
   }
+  // new residency decides the sections this op changes; a replaced pod's
+  // OLD residency changes them too (a bind moves a pod from the pending
+  // count into alloc/scheduled rows: groups AND pods+nodes are dirty)
+  *mask |= pod.node_idx >= 0 ? (kSecPods | kSecNodes) : kSecGroups;
 
   auto pit = st->pod_index.find(pod.uid);
   if (pit != st->pod_index.end()) {
+    const PodRow& old = st->pods[pit->second];
+    *mask |= old.node_idx >= 0 ? (kSecPods | kSecNodes) : kSecGroups;
     st->pods[pit->second] = pod;
   } else if (!st->free_pod_rows.empty()) {
     int slot = st->free_pod_rows.back();
@@ -369,6 +392,7 @@ int ka_apply_delta(void* handle, const uint8_t* buf, uint64_t len) {
     return -1;
   }
   uint32_t count = r.u32();
+  unsigned mask = 0;
   for (uint32_t i = 0; i < count; i++) {
     uint8_t op = r.u8();
     if (!r.ok()) {
@@ -378,6 +402,7 @@ int ka_apply_delta(void* handle, const uint8_t* buf, uint64_t len) {
     switch (op) {
       case 1:
         if (!parse_node(st, &r)) return -3;
+        mask |= kSecNodes;
         break;
       case 2: {
         std::string name = r.str();
@@ -386,16 +411,22 @@ int ka_apply_delta(void* handle, const uint8_t* buf, uint64_t len) {
           st->nodes[it->second].valid = false;
           st->free_node_rows.push_back(it->second);
           st->node_index.erase(it);
+          mask |= kSecNodes;
         }
         break;
       }
       case 3:
-        if (!parse_pod(st, &r)) return -4;
+        if (!parse_pod(st, &r, &mask)) return -4;
         break;
       case 4: {
         std::string uid = r.str();
         auto it = st->pod_index.find(uid);
         if (it != st->pod_index.end()) {
+          // a removed RESIDENT pod uncharges alloc/ports and drops a
+          // scheduled row; a removed PENDING pod drops a group count
+          mask |= st->pods[it->second].node_idx >= 0
+                      ? (kSecPods | kSecNodes)
+                      : kSecGroups;
           st->pods[it->second].valid = false;
           st->free_pod_rows.push_back(it->second);
           st->pod_index.erase(it);
@@ -408,10 +439,22 @@ int ka_apply_delta(void* handle, const uint8_t* buf, uint64_t len) {
     }
   }
   st->version++;
+  if (mask & kSecNodes) st->section_versions[0]++;
+  if (mask & kSecGroups) st->section_versions[1]++;
+  if (mask & kSecPods) st->section_versions[2]++;
   return 0;
 }
 
 uint64_t ka_version(void* handle) { return static_cast<State*>(handle)->version; }
+
+// Per-export-section version (0 = nodes, 1 = groups, 2 = pods) — the
+// python sidecar's plane-granular export/device caches key on these
+// (ISSUE 11: a single-pod delta must not re-materialize untouched planes).
+uint64_t ka_section_version(void* handle, int section) {
+  State* st = static_cast<State*>(handle);
+  if (section < 0 || section > 2) return 0;
+  return st->section_versions[section];
+}
 
 // Group row -> its equivalence key (for the python-side constraint
 // side-channel to map aux pod records onto exported rows). Returns the key

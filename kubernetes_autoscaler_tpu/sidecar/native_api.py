@@ -48,6 +48,14 @@ def load() -> ctypes.CDLL:
                                    ctypes.c_uint64]
     lib.ka_version.restype = ctypes.c_uint64
     lib.ka_version.argtypes = [ctypes.c_void_p]
+    try:
+        # per-export-section versions (plane-granular cache keys); absent
+        # on a stale pre-ISSUE-11 binary — section_versions() degrades to
+        # the whole-state version, which only costs cache granularity
+        lib.ka_section_version.restype = ctypes.c_uint64
+        lib.ka_section_version.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    except AttributeError:  # pragma: no cover — repo ships the new binary
+        pass
     for f in (lib.ka_num_nodes, lib.ka_num_pods, lib.ka_num_groups):
         f.restype = ctypes.c_int
         f.argtypes = [ctypes.c_void_p]
@@ -171,19 +179,27 @@ class NativeSnapshotState:
                 next_id += 1
         return ZoneTable(ids=ids)
 
-    def export(self, node_bucket: int = 64, group_bucket: int = 64,
-               pod_bucket: int = 256):
-        """Materialize tensors (numpy; caller ships to device). Mirrors the
-        EncodedCluster tensor layout exactly."""
+    def section_versions(self) -> tuple[int, int, int]:
+        """(nodes, groups, pods) export-section versions — the codec bumps
+        exactly the sections a delta's ops could change, so these are the
+        plane-granular cache keys (server._Tenant export/device caches). A
+        stale binary without the symbol degrades to the whole-state version
+        on every axis (correct, just coarser caching)."""
+        fn = getattr(self.lib, "ka_section_version", None)
+        if fn is None:  # pragma: no cover — repo ships the new binary
+            v = self.version
+            return (v, v, v)
+        return (int(fn(self.handle, 0)), int(fn(self.handle, 1)),
+                int(fn(self.handle, 2)))
+
+    def export_nodes(self, node_bucket: int = 64) -> dict:
+        """Node tensor section at `pad_to(n, node_bucket)` rows (numpy)."""
         from kubernetes_autoscaler_tpu.models.cluster_state import pad_to
 
         d = self.dims
-        n, p, g = self.counts()
+        n, _, _ = self.counts()
         n_pad = pad_to(n, node_bucket)
-        g_pad = pad_to(max(g, 1), group_bucket)
-        p_pad = pad_to(p, pod_bucket)
         r = res.NUM_RESOURCES
-
         nodes = {
             "cap": np.zeros((n_pad, r), np.int32),
             "alloc": np.zeros((n_pad, r), np.int32),
@@ -206,7 +222,16 @@ class NativeSnapshotState:
             _ptr(nodes["valid"]))
         if rc < 0:
             raise ValueError(f"export_nodes failed rc={rc}")
+        return nodes
 
+    def export_groups(self, group_bucket: int = 64) -> dict:
+        """Pod-group tensor section at `pad_to(max(g, 1), group_bucket)`."""
+        from kubernetes_autoscaler_tpu.models.cluster_state import pad_to
+
+        d = self.dims
+        _, _, g = self.counts()
+        g_pad = pad_to(max(g, 1), group_bucket)
+        r = res.NUM_RESOURCES
         groups = {
             "req": np.zeros((g_pad, r), np.int32),
             "count": np.zeros((g_pad,), np.int32),
@@ -229,7 +254,15 @@ class NativeSnapshotState:
             _ptr(groups["lossy"]))
         if rc < 0:
             raise ValueError(f"export_groups failed rc={rc}")
+        return groups
 
+    def export_pods(self, pod_bucket: int = 256) -> dict:
+        """Scheduled-pod tensor section at `pad_to(p, pod_bucket)`."""
+        from kubernetes_autoscaler_tpu.models.cluster_state import pad_to
+
+        _, p, _ = self.counts()
+        p_pad = pad_to(p, pod_bucket)
+        r = res.NUM_RESOURCES
         pods = {
             "req": np.zeros((p_pad, r), np.int32),
             "node_idx": np.full((p_pad,), -1, np.int32),
@@ -244,7 +277,17 @@ class NativeSnapshotState:
             _ptr(pods["blocks"]), _ptr(pods["valid"]))
         if rc < 0:
             raise ValueError(f"export_pods failed rc={rc}")
-        return nodes, groups, pods
+        return pods
+
+    def export(self, node_bucket: int = 64, group_bucket: int = 64,
+               pod_bucket: int = 256):
+        """Materialize tensors (numpy; caller ships to device). Mirrors the
+        EncodedCluster tensor layout exactly. Per-section callers (the
+        plane-granular export cache) use export_nodes/export_groups/
+        export_pods directly."""
+        return (self.export_nodes(node_bucket),
+                self.export_groups(group_bucket),
+                self.export_pods(pod_bucket))
 
     def to_tensors(self, node_bucket: int = 64, group_bucket: int = 64,
                    pod_bucket: int = 256):

@@ -95,9 +95,22 @@ class _Tenant:
     aux: dict = field(default_factory=dict)
     lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     shape_class: ShapeClass | None = None
-    # (version, class) -> numpy export at class shape
-    export_key: tuple | None = None
-    export_np: tuple | None = None
+    # plane-granular export caches (ISSUE 11): keyed PER SECTION by the
+    # codec's section version + the class axis rung — a single-pod delta
+    # bumps only the sections its ops touched, so untouched planes are
+    # never re-materialized (numpy) or re-uploaded (device). The device
+    # tier is the tenant's RESIDENT world: steady windows stack these
+    # arrays on-device and move zero world h2d bytes.
+    export_keys: dict = field(default_factory=dict)  # section -> (sv, rung)
+    export_np: dict = field(default_factory=dict)    # section -> numpy dict
+    dev_keys: dict = field(default_factory=dict)     # section -> (sv, rung)
+    dev_np: dict = field(default_factory=dict)       # section -> device dict
+    # serial-path residency: version-keyed cache of the fully-assembled
+    # (tensors + constraint overlay) world, so constrained/serial tenants
+    # stop re-uploading per RPC too
+    serial_cache: tuple | None = None
+    # encode-mode accounting mirrored per tenant for Statusz
+    encode_modes: dict = field(default_factory=dict)
     # request node-group digest -> (ng numpy tensors, ids, ng_rung, digest)
     ng_cache: OrderedDict = field(default_factory=OrderedDict)
     dispatched: bool = False     # has served ≥1 sim (new-tenant accounting)
@@ -269,6 +282,13 @@ class SimulatorService:
         self._phase_hist().zero_matching(tenant=tid)
         self.registry.counter("tenant_slo_breaches_total").zero_matching(
             tenant=tid)
+        # world-store families are tenant-labelled too: a dropped tenant's
+        # resident lanes died with the _Tenant object, so its encode-mode
+        # history and h2d byte series must not linger in the exposition
+        self.registry.counter("encoder_encodes_total").zero_matching(
+            tenant=tid)
+        self.registry.counter("world_store_h2d_bytes_total").zero_matching(
+            tenant=tid)
         # journal families are tenant-labelled too (TenantJournal); its ring
         # died with the _Tenant object, so its series must zero as well
         jt = tid or "default"
@@ -340,13 +360,22 @@ class SimulatorService:
 
         if ts is None:
             ts = self._tenant("")
+        # serial-path residency: the assembled world is immutable once
+        # built, and every ApplyDelta bumps the codec version (aux rides
+        # the same payload) — so (version, buckets) keys a safe cache and
+        # steady serial/constrained tenants stop re-uploading per RPC
+        key = (ts.state.version, self.node_bucket, self.group_bucket)
+        if ts.serial_cache is not None and ts.serial_cache[0] == key:
+            return ts.serial_cache[1]
         nt, gt, pt = ts.state.to_tensors(self.node_bucket, self.group_bucket)
         planes, has_c = None, False
         if ts.aux:
             gt, planes, has_c = attach_constraints(
                 ts.state, gt, nt.n, ts.aux,
                 max_zones=self.dims.max_zones)
-        return nt, gt, pt, planes, has_c
+        out = (nt, gt, pt, planes, has_c)
+        ts.serial_cache = (key, out)
+        return out
 
     def _encode_groups(self, ts: _Tenant, params: SimParams, bucket: int = 8):
         """Lower a request's node-group templates against the tenant's zone
@@ -469,20 +498,94 @@ class SimulatorService:
         return self._scheduler is not None and not ts.aux
 
     def _export_np(self, ts: _Tenant):
-        """Class-shaped numpy export, cached per (version, class); caller
+        """Class-shaped numpy export, cached PLANE-GRANULARLY: each section
+        (nodes/groups/pods) is keyed by its own codec section version + its
+        class axis rung, so a delta that touched one section re-exports
+        exactly that section (ISSUE 11 fix — the old (version, class) key
+        re-materialized the whole export on any single-pod delta). Caller
         holds ts.lock. The geometric rungs make `pad_to(n, rung) == rung`,
         so every tenant of a class exports identical tensor shapes."""
         sc = self._classify(ts)
-        key = (ts.state.version, sc)
-        if ts.export_key != key:
-            ts.export_np = ts.state.export(sc.nodes, sc.groups, sc.pods)
-            ts.export_key = key
-        return ts.export_np
+        sv = ts.state.section_versions()
+        refreshed = []
+        grew = False
+        for section, svi, rung_n, exporter in (
+                ("nodes", sv[0], sc.nodes, ts.state.export_nodes),
+                ("groups", sv[1], sc.groups, ts.state.export_groups),
+                ("pods", sv[2], sc.pods, ts.state.export_pods)):
+            key = (svi, rung_n)
+            prev = ts.export_keys.get(section)
+            if prev != key:
+                ts.export_np[section] = exporter(rung_n)
+                ts.export_keys[section] = key
+                refreshed.append(section)
+                grew = grew or (prev is not None and prev[1] != rung_n)
+        if refreshed:
+            self._note_encode(ts, refreshed, grew)
+        return ts.export_np["nodes"], ts.export_np["groups"], \
+            ts.export_np["pods"]
+
+    def _note_encode(self, ts: _Tenant, refreshed: list[str],
+                     grew: bool) -> None:
+        """The reasoned encode counter, sidecar edition: mode=delta when
+        the plane-granular cache reused ≥1 resident section, mode=full when
+        every section re-materialized (cause=initial on the first export,
+        shape_overflow when an axis crossed its rung — a new padded shape —
+        churn otherwise). Tenant-labelled; stale-zeroed by drop_tenant."""
+        from kubernetes_autoscaler_tpu.models.world_store import ENCODES_HELP
+
+        first = len(ts.encode_modes) == 0
+        mode = "full" if len(refreshed) == 3 else "delta"
+        cause = ("initial" if first
+                 else "shape_overflow" if grew else "churn")
+        key = f"{mode}/{cause}"
+        ts.encode_modes[key] = ts.encode_modes.get(key, 0) + 1
+        labels = {"tenant": ts.tid} if ts.tid else {}
+        self.registry.counter("encoder_encodes_total",
+                              help=ENCODES_HELP).inc(mode=mode, cause=cause,
+                                                     **labels)
+
+    def _export_dev(self, ts: _Tenant):
+        """The tenant's RESIDENT device lanes: per-section device arrays
+        refreshed only when that section's numpy export refreshed. The
+        upload is the ONLY h2d movement on the batched path — stacking
+        happens on-device (batch.stack_fields uses jnp.stack for device
+        lanes) — so a steady window moves zero world bytes, and a one-pod
+        delta uploads one tenant's dirty sections, not the whole stack.
+        Caller holds ts.lock."""
+        import jax.numpy as jnp
+
+        from kubernetes_autoscaler_tpu.models.world_store import H2D_HELP
+
+        self._export_np(ts)
+        uploaded = 0
+        for section in ("nodes", "groups", "pods"):
+            key = ts.export_keys[section]
+            if ts.dev_keys.get(section) != key:
+                np_dict = ts.export_np[section]
+                ts.dev_np[section] = {k: jnp.asarray(v)
+                                      for k, v in np_dict.items()}
+                ts.dev_keys[section] = key
+                uploaded += sum(int(v.nbytes) for v in np_dict.values())
+        if uploaded:
+            labels = {"tenant": ts.tid} if ts.tid else {}
+            self.registry.counter("world_store_h2d_bytes_total",
+                                  help=H2D_HELP).inc(uploaded, **labels)
+            self.registry.counter(
+                "device_transfer_bytes_total",
+                help="Host↔device bytes moved by the serving path, by "
+                     "direction (h2d = resident-lane section uploads; "
+                     "d2h = batched result fetches)",
+            ).inc(uploaded, direction="h2d")
+        return ts.dev_np["nodes"], ts.dev_np["groups"], ts.dev_np["pods"]
 
     def _ng_np(self, ts: _Tenant, params: SimParams):
-        """Per-tenant cache of lowered request templates (ids + numpy
-        NodeGroupTensors at the NG rung): steady-state tenants re-send the
-        same node-group ladder every loop."""
+        """Per-tenant cache of lowered request templates (ids + numpy AND
+        device NodeGroupTensors fields at the NG rung): steady-state
+        tenants re-send the same node-group ladder every loop, and the
+        device field map lets the batched path stack template lanes
+        on-device with zero re-upload (encode_node_groups already uploaded
+        them once — the map just re-exposes those arrays per field)."""
         from kubernetes_autoscaler_tpu.sidecar.batch import nodegroup_np
 
         ng_rung = rung(max(len(params.node_groups or []), 1), _NG_RUNG_BASE)
@@ -494,7 +597,13 @@ class SimulatorService:
             ts.ng_cache.move_to_end(key)
             return hit
         groups, ids = self._encode_groups(ts, params, bucket=ng_rung)
-        val = (nodegroup_np(groups), ids, ng_rung, digest)
+        ng_dev = {
+            "cap": groups.cap, "label_hash": groups.label_hash,
+            "taint_exact": groups.taint_exact, "taint_key": groups.taint_key,
+            "zone_id": groups.zone_id, "max_new": groups.max_new,
+            "price_per_node": groups.price_per_node, "valid": groups.valid,
+        }
+        val = (nodegroup_np(groups), ids, ng_rung, digest, ng_dev)
         ts.ng_cache[key] = val
         while len(ts.ng_cache) > 8:
             ts.ng_cache.popitem(last=False)
@@ -506,18 +615,22 @@ class SimulatorService:
 
         stamps = Stamps(entry=entry_ns or _time.perf_counter_ns())
         with ts.lock:
-            nodes, groups, pods = self._export_np(ts)
+            # the RESIDENT device lanes: dirty sections upload here (the
+            # only world h2d on the batched path); untouched sections and
+            # steady tenants reuse their device arrays as-is
+            nodes, groups, pods = self._export_dev(ts)
             sc = ts.shape_class
             if kind == "up":
-                ng_np, ids, ng_rung, ng_digest = self._ng_np(ts, params)
+                _ng, ids, ng_rung, ng_digest, ng_dev = self._ng_np(ts, params)
                 lane = b.UpLane(nodes=nodes, groups=groups, pods=pods,
-                                ng=ng_np, ids=ids)
+                                ng=ng_dev, ids=ids)
                 fp = (ts.tid, ts.state.version, ng_rung, ng_digest)
                 key = ("up", sc, ng_rung, params.max_new_nodes,
                        params.strategy)
             else:
                 lane = b.DownLane(nodes=nodes, groups=groups, pods=pods,
-                                  threshold=float(params.threshold))
+                                  threshold=float(params.threshold),
+                                  valid_np=ts.export_np["nodes"]["valid"])
                 fp = (ts.tid, ts.state.version)
                 key = ("down", sc, self.dims.max_zones)
         tracer = trace.current_tracer()
@@ -682,23 +795,15 @@ class SimulatorService:
         lanes_list = b.pad_lanes(members, self.batch_lanes)
         stack_key = (key, tuple(t.fp for t in tickets))
 
-        def _stack(build):
-            # h2d byte accounting rides the cache-miss path only: a hit
-            # re-uses the resident device pytree and uploads nothing
-            self.registry.counter(
-                "device_transfer_bytes_total",
-                help="Host↔device bytes moved by the serving path, by "
-                     "direction (h2d = stacked-world uploads on stack-cache "
-                     "misses; d2h = batched result fetches)",
-            ).inc(b.stacked_nbytes(lanes_list), direction="h2d")
-            return build()
-
+        # NOTE on h2d accounting: the lanes are the tenants' RESIDENT
+        # device arrays (_export_dev), so a stack-cache miss re-stacks
+        # on-device and moves no world bytes — uploads were already
+        # charged, per dirty section, when the lanes refreshed.
         with self._recompile_charge([self._tenant(t.tenant)
                                      for t in tickets]):
             if kind == "up":
                 nt, gt, pt, gr = self._stack_cache.get(
-                    stack_key, lambda: _stack(
-                        lambda: b.stack_up_lanes(lanes_list)))
+                    stack_key, lambda: b.stack_up_lanes(lanes_list))
                 stack1 = _time.perf_counter_ns()
                 _, _, _, max_new_nodes, strategy = key
                 out = self._timed_sim(
@@ -717,8 +822,7 @@ class SimulatorService:
                 assemble = lambda host: b.assemble_up(host, members)  # noqa: E731
             else:
                 nt, gt, pt = self._stack_cache.get(
-                    stack_key, lambda: _stack(
-                        lambda: b.stack_down_lanes(lanes_list)[:3]))
+                    stack_key, lambda: b.stack_down_lanes(lanes_list)[:3])
                 stack1 = _time.perf_counter_ns()
                 th = jnp.asarray(
                     [ln.threshold for ln in lanes_list], jnp.float32)
@@ -827,7 +931,17 @@ class SimulatorService:
             "slo_breaches": ts.slo_breaches,
             "last_breach_trace": ts.last_breach_trace or None,
             "journal": ts.journal.stats() if ts.journal is not None else None,
+            # plane-granular export accounting (ISSUE 11): how this
+            # tenant's world reached the device, by mode/cause. Copied
+            # under ts.lock — _note_encode inserts keys under it on
+            # handler threads, and iterating a mutating dict RuntimeErrors
+            "encodes": self._encode_modes(ts),
         }
+
+    @staticmethod
+    def _encode_modes(ts: _Tenant) -> dict:
+        with ts.lock:
+            return dict(ts.encode_modes)
 
     def statusz(self) -> str:
         """Human-readable serving snapshot (the sidecar's /statusz analog,
@@ -906,6 +1020,20 @@ class SimulatorService:
             f"compile_s={self.registry.counter('sim_compile_seconds_total').value():.3f} "
             f"h2d_bytes={xfer.value(direction='h2d'):.0f} "
             f"d2h_bytes={xfer.value(direction='d2h'):.0f}")
+        # world-store section: encode modes aggregated across resident
+        # tenants (delta = plane-granular refresh reused resident sections)
+        emodes: dict[str, int] = {}
+        for tid in tids:
+            ets = self._tenant_peek(tid)
+            if ets is not None:
+                for k, v in self._encode_modes(ets).items():
+                    emodes[k] = emodes.get(k, 0) + v
+        wsb_total = self.registry.counter(
+            "world_store_h2d_bytes_total").total()
+        lines.append(
+            "world store: encodes="
+            + json.dumps(emodes, sort_keys=True)
+            + f" h2d_world_bytes={wsb_total:.0f}")
         # EventSink isn't thread-safe: the reject path emits under
         # _events_lock on handler threads, so the statusz read takes it too
         with self._events_lock:

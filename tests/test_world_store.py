@@ -1,0 +1,455 @@
+"""Device-resident WorldStore: bit-identity, mode/cause accounting, h2d
+byte discipline, and the sidecar's plane-granular resident lanes.
+
+The store's contract (models/world_store.py, docs/WORLD_STORE.md):
+
+  * after EVERY loop of a fuzzed churn sequence, each resident device plane
+    is bit-identical to its host mirror, and the maintained encoding is
+    semantically identical to a cold full encode (node planes positionally
+    bit-identical — node row i IS nodes[i]);
+  * every loop classifies as delta / row_refresh / full with a cause, and
+    the reasoned counter + h2d byte meter reflect it;
+  * shape overflow (zone-table overflow flips the encoding mode) degrades
+    to a FULL encode instead of corrupting resident planes;
+  * the journal's decision digests are identical whether the world was
+    encoded by the store or re-encoded from scratch every loop, and both
+    journals replay with zero drift (the cross-encode-mode oracle);
+  * the sidecar's per-tenant export/device caches are PLANE-GRANULAR: a
+    delta that touched one section never re-materializes (or re-uploads)
+    the others, and a steady window moves zero world h2d bytes.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.models.incremental import semantic_diff
+from kubernetes_autoscaler_tpu.models.world_store import WorldStore
+from kubernetes_autoscaler_tpu.simulator.drainability.rules import (
+    DrainOptions,
+    apply_drainability,
+)
+from kubernetes_autoscaler_tpu.utils.testing import (
+    build_test_node,
+    build_test_pod,
+)
+
+from tests.test_incremental_encode import _World  # the replay fuzz worlds
+
+
+def _fresh(nodes, pods, registry, pdbs, now):
+    enc = encode_cluster(nodes, pods, registry=registry,
+                         node_bucket=16, group_bucket=8, pod_bucket=16)
+    apply_drainability(enc, DrainOptions(), now=now,
+                       pdb_namespaced_names=frozenset(pdbs))
+    return enc
+
+
+def _assert_planes_resident(store, enc, nodes, pods, pdbs, now, step):
+    """The three identity layers the store guarantees every loop."""
+    # 1) every resident device plane ≡ its host mirror, bit for bit
+    devs = store.device_store.token()
+    for key, mirror in store.encoder._m.items():
+        dev = devs.get(key)
+        assert dev is not None, (step, key)
+        assert np.array_equal(np.asarray(dev), mirror), (step, key)
+    # 2) semantically ≡ a cold full encode of the same world
+    fresh = _fresh(nodes, pods, store.encoder.registry, pdbs, now)
+    diff = semantic_diff(enc, fresh)
+    assert diff is None, (step, diff)
+    # 3) node planes positionally bit-identical (row i IS nodes[i]; the
+    # mirror may be padded wider than a fresh encode after growth)
+    n = len(nodes)
+    for f in ("cap", "alloc", "label_hash", "taint_exact", "taint_key",
+              "used_ports", "zone_id", "ready", "schedulable", "valid"):
+        assert np.array_equal(enc.host_arrays[f"nodes.{f}"][:n],
+                              fresh.host_arrays[f"nodes.{f}"][:n]), (step, f)
+
+
+def test_delta_planes_bit_identical_under_fuzzed_churn():
+    """L-loop churn (pod add/del/rebind, object replacement, taint flips,
+    node add/remove, PDB churn, group growth): the delta-applied device
+    planes stay bit-identical to their mirrors and the world stays
+    equivalent to a cold encode — with exactly ONE full encode ever."""
+    for seed in (5, 6):
+        rng = random.Random(seed)
+        world = _World(rng)
+        for _ in range(6):
+            world.add_node()
+        for _ in range(12):
+            world.step()
+        reg = Registry()
+        store = WorldStore(registry=reg, node_bucket=16, group_bucket=8,
+                           pod_bucket=16, drain_opts=DrainOptions())
+        now = 1000.0
+        nodes, pods = world.lists()
+        enc = store.encode(nodes, pods, now=now,
+                           pdb_namespaced_names=frozenset(world.pdbs))
+        assert (store.last_mode, store.last_cause) == ("full", "initial")
+        _assert_planes_resident(store, enc, nodes, pods, world.pdbs, now,
+                                step=f"seed{seed}-init")
+        for step in range(25):
+            for _ in range(rng.randint(1, 4)):
+                world.step()
+            now += 10.0
+            nodes, pods = world.lists()
+            enc = store.encode(nodes, pods, now=now,
+                               pdb_namespaced_names=frozenset(world.pdbs))
+            assert store.last_mode in ("delta", "row_refresh"), (
+                step, store.last_mode, store.last_cause)
+            _assert_planes_resident(store, enc, nodes, pods, world.pdbs,
+                                    now, step=f"seed{seed}-{step}")
+        assert store.encoder.full_encodes == 1
+        # the reasoned counter saw every loop, and only one full encode
+        total = sum(store.mode_counts.values())
+        assert total == 26
+        assert reg.counter("encoder_encodes_total").value(
+            mode="full", cause="initial") == 1.0
+
+
+def test_shape_overflow_degrades_to_full_encode():
+    """Zone-table overflow past Dims.max_zones flips the encoding mode —
+    the store must FULL-encode (cause=shape_overflow), not delta onto
+    resident planes encoded under the old mode."""
+    from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS
+
+    reg = Registry()
+    store = WorldStore(registry=reg, node_bucket=16, group_bucket=8,
+                       pod_bucket=16, drain_opts=DrainOptions())
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192,
+                             zone=f"z{i % 3}") for i in range(4)]
+    pods = [build_test_pod(f"p{i}", cpu_milli=100, mem_mib=64,
+                           owner_name="rs") for i in range(3)]
+    store.encode(nodes, pods, now=1.0)
+    assert store.last_mode == "full"
+    # one node per fresh zone until the table overflows the static dim
+    for k in range(DEFAULT_DIMS.max_zones + 2):
+        nodes.append(build_test_node(f"zx{k}", cpu_milli=4000, mem_mib=8192,
+                                     zone=f"zone-{k}"))
+    enc = store.encode(nodes, pods, now=2.0)
+    assert (store.last_mode, store.last_cause) == ("full", "shape_overflow")
+    assert reg.counter("encoder_encodes_total").value(
+        mode="full", cause="shape_overflow") == 1.0
+    # resident planes were rebuilt, not corrupted: equivalent to cold
+    _assert_planes_resident(store, enc, nodes, pods, set(), 2.0,
+                            step="overflow")
+
+
+def test_mode_and_cause_accounting():
+    reg = Registry()
+    store = WorldStore(registry=reg, node_bucket=8, group_bucket=8,
+                       pod_bucket=16, drain_opts=DrainOptions(),
+                       resync_loops=5)
+    nodes = [build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192)
+             for i in range(3)]
+    pods = [build_test_pod(f"p{i}", cpu_milli=100, mem_mib=64,
+                           owner_name="rs") for i in range(4)]
+    store.encode(nodes, pods, now=1.0)                       # loop 1
+    assert (store.last_mode, store.last_cause) == ("full", "initial")
+    full_bytes = store.last_h2d_bytes
+    assert full_bytes > 0
+
+    pods = pods + [build_test_pod("p-extra", cpu_milli=100, mem_mib=64,
+                                  owner_name="rs")]
+    store.encode(nodes, pods, now=2.0)                       # loop 2: delta
+    assert (store.last_mode, store.last_cause) == ("delta", "churn")
+    assert 0 < store.last_h2d_bytes < full_bytes / 10
+
+    # node growth past the padded bucket: resident planes kept, node
+    # planes replaced wholesale — row_refresh/shape_overflow
+    nodes = nodes + [build_test_node(f"g{i}", cpu_milli=4000, mem_mib=8192)
+                     for i in range(8)]
+    store.encode(nodes, pods, now=3.0)                       # loop 3
+    assert (store.last_mode, store.last_cause) == \
+        ("row_refresh", "shape_overflow")
+
+    # out-of-band invalidation (the DRA/CSI lowering path): the identity
+    # fingerprints can no longer be trusted — full/fingerprint_miss
+    store.invalidate()
+    store.encode(nodes, pods, now=4.0)                       # loop 4
+    assert (store.last_mode, store.last_cause) == \
+        ("full", "fingerprint_miss")
+
+    store.encode(nodes, pods, now=5.0)                       # loop 5: resync
+    assert (store.last_mode, store.last_cause) == ("full", "forced")
+
+    c = reg.counter("encoder_encodes_total")
+    assert c.value(mode="full", cause="initial") == 1.0
+    assert c.value(mode="delta", cause="churn") == 1.0
+    assert c.value(mode="row_refresh", cause="shape_overflow") == 1.0
+    assert c.value(mode="full", cause="fingerprint_miss") == 1.0
+    assert c.value(mode="full", cause="forced") == 1.0
+    assert reg.counter("world_store_h2d_bytes_total").value() > 0
+
+
+def test_composition_fingerprint_is_identity_cached_and_content_true():
+    store = WorldStore(node_bucket=8, group_bucket=8, pod_bucket=16,
+                       drain_opts=DrainOptions())
+    nodes = [build_test_node("n0", cpu_milli=4000, mem_mib=8192)]
+    pods = [build_test_pod("p0", cpu_milli=100, mem_mib=64,
+                           owner_name="rs")]
+    fp1 = store.composition_fingerprint(nodes, pods)
+    assert fp1 == store.composition_fingerprint(nodes, pods)
+    # replace-on-update: a NEW object with new content changes it
+    import dataclasses
+
+    pods2 = [dataclasses.replace(pods[0], labels={"app": "x"})]
+    assert store.composition_fingerprint(nodes, pods2) != fp1
+    # and an identical-content NEW object keeps it (canonical, not id)
+    pods3 = [dataclasses.replace(pods[0])]
+    assert store.composition_fingerprint(nodes, pods3) == fp1
+
+
+def test_cross_encode_mode_journal_zero_drift(tmp_path):
+    """The PR 9 oracle across encode modes: the same churned world journaled
+    once with the WorldStore and once with per-loop full encodes must
+    produce loop-for-loop identical decision digests, and both journals
+    replay with zero drift."""
+    import json
+
+    from kubernetes_autoscaler_tpu.config.options import (
+        AutoscalingOptions,
+        NodeGroupDefaults,
+    )
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import (
+        StaticAutoscaler,
+    )
+    from kubernetes_autoscaler_tpu.replay.harness import replay_journal
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+
+    def run(inc: bool, jdir: str):
+        fake = FakeCluster()
+        tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384,
+                               pods=32)
+        fake.add_node_group("ng1", tmpl, min_size=1, max_size=30)
+        for i in range(5):
+            nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384,
+                                 pods=32)
+            fake.add_existing_node("ng1", nd)
+            fake.add_pod(build_test_pod(
+                f"r{i}", cpu_milli=2000, mem_mib=1024,
+                owner_name=f"rs{i % 3}", node_name=nd.name))
+        for i in range(8):
+            fake.add_pod(build_test_pod(
+                f"p{i}", cpu_milli=400, mem_mib=256, owner_name="prs"))
+        opts = AutoscalingOptions(
+            incremental_encode=inc, journal_dir=jdir,
+            node_shape_bucket=16, group_shape_bucket=16,
+            max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+            scale_down_delay_after_add_s=0.0,
+            node_group_defaults=NodeGroupDefaults(
+                scale_down_unneeded_time_s=3600.0))
+        a = StaticAutoscaler(fake.provider, fake, options=opts,
+                             eviction_sink=fake)
+        seq = 0
+        for loop in range(6):
+            # pure pending churn + a taint flip: deltas on every section
+            # without renumbering the equivalence rows
+            for k in range(2):
+                fake.remove_pod(f"p{seq + k}")
+                fake.add_pod(build_test_pod(
+                    f"p{8 + seq + k}", cpu_milli=400, mem_mib=256,
+                    owner_name="prs"))
+            seq += 2
+            if loop == 3:
+                from kubernetes_autoscaler_tpu.models.api import Node, Taint
+
+                old = fake.nodes["n1"]
+                fake.nodes["n1"] = Node(
+                    name=old.name, labels=dict(old.labels),
+                    capacity=dict(old.capacity),
+                    allocatable=dict(old.allocatable),
+                    taints=[Taint("ws/flip", "1", "NoSchedule")],
+                    ready=True)
+            fake.advance_to(1000.0 + 10.0 * loop)
+            a.run_once(now=1000.0 + 10.0 * loop)
+        a.journal.close()
+        recs = []
+        import os
+
+        for f in sorted(os.listdir(jdir)):
+            with open(os.path.join(jdir, f)) as fh:
+                for line in fh:
+                    d = json.loads(line)
+                    if d.get("kind") in ("snapshot", "delta"):
+                        recs.append(d)
+        return recs
+
+    recs_store = run(True, str(tmp_path / "j-store"))
+    recs_full = run(False, str(tmp_path / "j-full"))
+    assert len(recs_store) == len(recs_full) == 6
+    for k, (a, b) in enumerate(zip(recs_store, recs_full)):
+        # the decision surfaces must agree byte-for-byte, loop for loop
+        assert a["digests"] == b["digests"], (k, a["digests"], b["digests"])
+        assert a["worldDigest"] == b["worldDigest"], k
+    for d in ("j-store", "j-full"):
+        report = replay_journal(str(tmp_path / d))
+        assert report["zeroDrift"] is True, (d, report["driftLoops"],
+                                             report["problems"])
+
+
+# ---- sidecar: plane-granular resident lanes ----
+
+native_api = pytest.importorskip(
+    "kubernetes_autoscaler_tpu.sidecar.native_api")
+if not native_api.available():  # pragma: no cover
+    pytest.skip("native codec unavailable", allow_module_level=True)
+
+
+def _delta(pods=(), nodes=(), deletes=()):
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+
+    w = DeltaWriter()
+    for nd in nodes:
+        w.upsert_node(nd)
+    for p in pods:
+        w.upsert_pod(p)
+    for uid in deletes:
+        w.delete_pod(uid)
+    return w.payload()
+
+
+def test_codec_section_versions_track_touched_sections():
+    st = native_api.NativeSnapshotState()
+    assert st.section_versions() == (0, 0, 0)
+    st.apply_delta(_delta(nodes=[build_test_node("n0", cpu_milli=2000,
+                                                 mem_mib=4096)]))
+    assert st.section_versions() == (1, 0, 0)          # nodes only
+    st.apply_delta(_delta(pods=[build_test_pod(
+        "pend0", cpu_milli=100, mem_mib=64, owner_name="rs")]))
+    sv = st.section_versions()
+    assert sv == (1, 1, 0)                             # pending → groups
+    st.apply_delta(_delta(pods=[build_test_pod(
+        "res0", cpu_milli=100, mem_mib=64, owner_name="rs2",
+        node_name="n0")]))
+    # resident pod: alloc (nodes) + scheduled row (pods) + fresh eq row
+    assert st.section_versions() == (2, 2, 1)
+    # deleting the pending pod touches groups only
+    st.apply_delta(_delta(deletes=["uid-default/pend0"]))
+    assert st.section_versions() == (2, 3, 1)
+    # deleting the resident pod uncharges alloc: nodes + pods, not groups
+    st.apply_delta(_delta(deletes=["uid-default/res0"]))
+    assert st.section_versions() == (3, 3, 2)
+
+
+def test_sidecar_export_cache_is_plane_granular():
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorService
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16)
+    try:
+        ts = svc._tenant("")
+        svc.apply_delta(_delta(
+            nodes=[build_test_node(f"n{i}", cpu_milli=2000, mem_mib=4096)
+                   for i in range(3)],
+            pods=[build_test_pod(f"p{i}", cpu_milli=100, mem_mib=64,
+                                 owner_name="rs",
+                                 node_name="n0" if i == 0 else "")
+                  for i in range(4)]))
+        with ts.lock:
+            svc._export_np(ts)
+            first = {s: ts.export_np[s] for s in ("nodes", "groups", "pods")}
+        # a pending-pod-only delta re-exports ONLY the groups section
+        svc.apply_delta(_delta(pods=[build_test_pod(
+            "p9", cpu_milli=100, mem_mib=64, owner_name="rs")]))
+        with ts.lock:
+            svc._export_np(ts)
+            assert ts.export_np["nodes"] is first["nodes"]
+            assert ts.export_np["pods"] is first["pods"]
+            assert ts.export_np["groups"] is not first["groups"]
+        # a node-only delta re-exports ONLY the nodes section
+        svc.apply_delta(_delta(nodes=[build_test_node(
+            "n9", cpu_milli=2000, mem_mib=4096)]))
+        with ts.lock:
+            svc._export_np(ts)
+            assert ts.export_np["pods"] is first["pods"]
+            assert ts.export_np["nodes"] is not first["nodes"]
+        assert ts.encode_modes.get("full/initial") == 1
+        assert ts.encode_modes.get("delta/churn") == 2
+    finally:
+        svc.close()
+
+
+def test_sidecar_resident_lanes_zero_h2d_on_steady_window():
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorService
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16)
+    try:
+        ts = svc._tenant("")
+        svc.apply_delta(_delta(
+            nodes=[build_test_node(f"n{i}", cpu_milli=2000, mem_mib=4096)
+                   for i in range(3)],
+            pods=[build_test_pod(f"p{i}", cpu_milli=100, mem_mib=64,
+                                 owner_name="rs",
+                                 node_name="n0" if i == 0 else "")
+                  for i in range(4)]))
+        c = svc.registry.counter("world_store_h2d_bytes_total")
+        with ts.lock:
+            d1 = svc._export_dev(ts)
+        uploaded = c.value()
+        assert uploaded > 0
+        # steady window: same versions → the SAME device arrays, zero bytes
+        with ts.lock:
+            d2 = svc._export_dev(ts)
+        assert c.value() == uploaded
+        for a, b in zip(d1, d2):
+            assert a is b
+        # a groups-only delta re-uploads ONLY the groups section (fewer
+        # bytes than the nodes section alone)
+        svc.apply_delta(_delta(pods=[build_test_pod(
+            "p9", cpu_milli=100, mem_mib=64, owner_name="rs")]))
+        nodes_nbytes = sum(int(v.nbytes)
+                           for v in ts.export_np["nodes"].values())
+        with ts.lock:
+            d3 = svc._export_dev(ts)
+        delta_bytes = c.value() - uploaded
+        assert 0 < delta_bytes < nodes_nbytes
+        assert d3[0] is d2[0]          # nodes lanes untouched
+        assert d3[2] is d2[2]          # pods lanes untouched
+        assert d3[1] is not d2[1]      # groups refreshed
+        # drop_tenant zeroes the tenant-labelled world-store families
+        ts2 = svc._tenant("t-x")
+        svc.apply_delta(_delta(nodes=[build_test_node(
+            "nx", cpu_milli=2000, mem_mib=4096)]), tenant="t-x")
+        with ts2.lock:
+            svc._export_dev(ts2)
+        assert c.value(tenant="t-x") > 0
+        assert svc.registry.counter("encoder_encodes_total").value(
+            mode="full", cause="initial", tenant="t-x") == 1.0
+        svc.drop_tenant("t-x")
+        assert c.value(tenant="t-x") == 0.0
+        assert svc.registry.counter("encoder_encodes_total").value(
+            mode="full", cause="initial", tenant="t-x") == 0.0
+    finally:
+        svc.close()
+
+
+def test_shared_canonical_vocabulary():
+    """Journal and WorldStore must agree on "changed" BY CONSTRUCTION: the
+    journal's canonicalization IS utils/canonical's, and the incremental
+    encoder's node fingerprint IS the shared node_fp."""
+    from kubernetes_autoscaler_tpu.models import incremental
+    from kubernetes_autoscaler_tpu.replay import journal as rj
+    from kubernetes_autoscaler_tpu.utils import canonical as uc
+
+    assert rj.canonical is uc.canonical
+    assert rj.digest_of is uc.digest_of
+    assert rj._canon_map is uc.canon_map
+    assert incremental._node_fp is uc.node_fp
+
+    memo = uc.IdentityMemo(lambda o: tuple(sorted(o.labels.items())))
+    nd = build_test_node("n0", cpu_milli=1000, mem_mib=1024,
+                         labels={"a": "1"})
+    sig1 = memo.refresh([nd])
+    assert memo.misses == 1
+    assert memo.refresh([nd]) == sig1
+    assert (memo.hits, memo.misses) == (1, 1)
+    # a replaced object recomputes; the dead entry is swept
+    import dataclasses
+
+    nd2 = dataclasses.replace(nd, labels={**nd.labels, "a": "2"})
+    assert memo.refresh([nd2]) != sig1
+    assert memo.misses == 2
+    assert len(memo._cache) == 1
