@@ -34,7 +34,51 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from kubernetes_autoscaler_tpu.sidecar import faults
 from kubernetes_autoscaler_tpu.sidecar.lifecycle import Stamps
+
+
+class WorldValidationError(ValueError):
+    """A structurally invalid tenant world or request, rejected BEFORE it
+    reaches a coalescing window (docs/ROBUSTNESS.md): mapped to gRPC
+    INVALID_ARGUMENT, counted by `world_validation_rejects_total{reason}`.
+    Reasons form a small fixed taxonomy pinned by tests/test_quarantine.py:
+    `nan` (NaN/inf in request params or template capacities),
+    `negative-request` (negative resource requests in the world or params),
+    `section-version-mismatch` (a delta built against a different snapshot
+    version than the server holds — the post-restart full-resend signal),
+    `oversize-world` (counts past the configured world caps), and
+    `rehydration-pending` (a checkpoint-restored tenant hit a path that
+    needs the native world re-sent)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"invalid world/request [{reason}]"
+                         + (f": {detail}" if detail else ""))
+        self.reason = reason
+
+
+class Quarantined(Exception):
+    """The tenant is serving a quarantine sentence (a window failure was
+    bisected down to it): rejected at the admission edge with gRPC
+    FAILED_PRECONDITION + the parole time as a retry-after hint. Auto-
+    parole: the next request after the TTL elapses is admitted (and a
+    successful ApplyDelta paroles early — a new world is a new chance)."""
+
+    def __init__(self, tenant: str, reason: str, retry_after_ms: int):
+        super().__init__(
+            f"tenant {tenant or 'default'!r} quarantined [{reason}]; "
+            f"parole in {retry_after_ms}ms")
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_ms = retry_after_ms
+
+
+class SchedulerDown(RuntimeError):
+    """The batch scheduler thread is dead: nothing drains the admission
+    queue, so accepting the request would wedge it until its deadline.
+    Mapped to gRPC UNAVAILABLE — the client's retry ladder / circuit
+    breaker / local fallback takes over (the Health RPC reports
+    NOT_SERVING so orchestration restarts the sidecar)."""
 
 
 class QueueFull(Exception):
@@ -112,9 +156,22 @@ class AdmissionQueue:
         self.depth = 0
         self.submitted = 0
         self.rejected = 0
+        self._closed: Exception | None = None
+
+    def close(self, error: Exception) -> None:
+        """Fail-fast mode after a scheduler crash: every future submit
+        raises SchedulerDown instead of enqueuing into a queue nobody
+        drains (the supervision contract, tests/test_fault_injection.py)."""
+        with self._cond:
+            self._closed = error
+            self._cond.notify_all()
 
     def submit(self, t: Ticket) -> None:
         with self._cond:
+            if self._closed is not None:
+                raise SchedulerDown(
+                    f"admission queue closed: {self._closed}"
+                ) from self._closed
             if self.depth >= self.max_depth:
                 self.rejected += 1
                 raise QueueFull(self.depth, self.retry_after_ms)
@@ -215,7 +272,8 @@ class BatchScheduler:
 
     def __init__(self, queue: AdmissionQueue, dispatch, lanes: int,
                  window_s: float = 0.002, idle_wait_s: float = 0.05,
-                 window_max: int | None = None, gap_cb=None):
+                 window_max: int | None = None, gap_cb=None,
+                 on_batch_failure=None, on_crash=None):
         self.queue = queue
         self.dispatch = dispatch
         self.lanes = max(int(lanes), 1)
@@ -243,8 +301,18 @@ class BatchScheduler:
         #              the gap is arrival-bound (no work to run), reported
         #              separately so idle fleets don't read as stalls
         self.gap_cb = gap_cb
+        # isolation hooks (docs/ROBUSTNESS.md): on_batch_failure(batch,
+        # error) — a failed dispatch is handed to the service's bisection
+        # re-dispatcher instead of blanket-failing every member;
+        # on_crash(error) — the supervision escalation when the serve loop
+        # itself dies (the service flips Health to NOT_SERVING)
+        self.on_batch_failure = on_batch_failure
+        self.on_crash = on_crash
+        self.crashed: Exception | None = None
         self._last_harvest_done_ns: int | None = None
         self._work_waiting_at_harvest = False
+        self._pending = None   # previous batch, fetch still in flight
+        self._window: list[Ticket] = []   # collected, not yet all dispatched
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._serve, name="katpu-batch-scheduler", daemon=True)
@@ -252,6 +320,10 @@ class BatchScheduler:
     def start(self) -> "BatchScheduler":
         self._thread.start()
         return self
+
+    @property
+    def alive(self) -> bool:
+        return self.crashed is None and self._thread.is_alive()
 
     def stop(self, timeout_s: float = 5.0) -> None:
         self._stop.set()
@@ -261,8 +333,45 @@ class BatchScheduler:
             t.resolve(error=err)
 
     def _serve(self) -> None:
-        pending = None   # previous batch, fetch still in flight
+        """Supervised serve loop: an unhandled exception (anything outside
+        the per-batch dispatch guard — queue plumbing, window forming, an
+        injected scheduler_loop fault) must NOT die silently with requests
+        queued behind a drain that will never come. The crash path closes
+        the queue (future submits raise SchedulerDown), fails every queued
+        and in-flight ticket, and escalates through on_crash so the service
+        flips Health to NOT_SERVING."""
+        try:
+            self._serve_inner()
+        except Exception as e:  # noqa: BLE001 — the supervision contract
+            self.crashed = e
+            err = SchedulerDown(f"batch scheduler crashed: {e!r}")
+            err.__cause__ = e
+            self.queue.close(err)
+            for t in self.queue.drain():
+                t.resolve(error=err)
+            # tickets already COLLECTED into the current window live in
+            # neither the queue nor _pending — without this they would
+            # block their clients until the gRPC deadline, the exact hang
+            # the supervision contract exists to prevent
+            for t in self._window:
+                if not t.done.is_set():
+                    t.resolve(error=err)
+            self._window = []
+            if self._pending is not None:
+                for t in getattr(self._pending, "tickets", ()):
+                    if not t.done.is_set():
+                        t.resolve(error=err)
+                self._pending = None
+            if self.on_crash is not None:
+                try:
+                    self.on_crash(e)
+                except Exception:  # noqa: BLE001 — escalation is best-effort
+                    pass
+
+    def _serve_inner(self) -> None:
         while not self._stop.is_set():
+            if faults.PLAN is not None:
+                faults.PLAN.fire("scheduler_loop")
             # with a fetch in flight, poll instead of sleeping: an empty
             # queue means there is nothing to overlap the fetch with, and
             # the waiters of the pending batch may be exactly what the next
@@ -270,14 +379,15 @@ class BatchScheduler:
             # idle_wait_s here adds a dead stall to every round trip
             window = self.queue.collect(
                 self.window_max,
-                wait_s=0.0 if pending is not None else self.idle_wait_s,
+                wait_s=0.0 if self._pending is not None else self.idle_wait_s,
                 coalesce_s=self.window_s)
             if not window:
                 # idle: nothing to overlap the pending fetch with — harvest
-                if pending is not None:
-                    self._harvest(pending)
-                    pending = None
+                if self._pending is not None:
+                    self._harvest(self._pending)
+                    self._pending = None
                 continue
+            self._window = window
             self.windows += 1
             for run in split_by_key(window):
                 # canonical member order: the round-robin cursor rotates the
@@ -289,20 +399,34 @@ class BatchScheduler:
                 for lo in range(0, len(run), self.lanes):
                     batch = run[lo:lo + self.lanes]
                     self.batches += 1
-                    self._note_gap(pending is not None)
+                    self._note_gap(self._pending is not None)
                     try:
                         inflight = self.dispatch(batch)
                     except Exception as e:  # noqa: BLE001 — fail the batch, keep serving
-                        for t in batch:
-                            t.resolve(error=e)
+                        # a failed dispatch is handed to the service's
+                        # bisection re-dispatcher when one is wired: split
+                        # lanes, retry halves, isolate the poison member —
+                        # healthy co-batched tenants still get results
+                        if self.on_batch_failure is not None:
+                            try:
+                                self.on_batch_failure(batch, e)
+                            except Exception as e2:  # noqa: BLE001
+                                for t in batch:
+                                    if not t.done.is_set():
+                                        t.resolve(error=e2)
+                        else:
+                            for t in batch:
+                                t.resolve(error=e)
                         continue
                     # pipeline point: THIS batch's upload+dispatch is now in
                     # flight; only now pay the previous batch's fetch wait
-                    if pending is not None:
-                        self._harvest(pending)
-                    pending = inflight
-        if pending is not None:
-            self._harvest(pending)
+                    if self._pending is not None:
+                        self._harvest(self._pending)
+                    self._pending = inflight
+            self._window = []
+        if self._pending is not None:
+            self._harvest(self._pending)
+            self._pending = None
 
     def _note_gap(self, pipelined: bool) -> None:
         """Estimated device idle before the dispatch about to launch (see

@@ -39,6 +39,18 @@ from kubernetes_autoscaler_tpu.models.cluster_state import (
     PodGroupTensors,
     ScheduledPodTensors,
 )
+from kubernetes_autoscaler_tpu.sidecar import faults
+
+
+class MemberFault(Exception):
+    """One member's result is poisoned (NaN in its lane's outputs, or its
+    per-member assembly raised): ONLY that member's ticket errors — the
+    batch's other members are assembled and resolved normally, because
+    vmapped lanes are computationally independent (docs/ROBUSTNESS.md)."""
+
+    def __init__(self, tenant: str, message: str):
+        super().__init__(f"member {tenant or 'default'!r}: {message}")
+        self.tenant = tenant
 
 
 @dataclass
@@ -192,31 +204,67 @@ class StackCache:
 
 
 class InFlightBatch:
-    """One dispatched window batch: resolve tickets at harvest time."""
+    """One dispatched window batch: resolve tickets at harvest time.
+
+    Failure contract (docs/ROBUSTNESS.md): NO ticket may be left pending —
+    a client blocked on an unresolved ticket waits out its full gRPC
+    deadline for nothing. A batch-level failure (fetch raised, assembly
+    length mismatch) is delegated to `on_failure` (the service's bisection
+    re-dispatcher) when wired, else fails every still-pending member with
+    the error; a PER-member failure (MemberFault in the assembled results)
+    errors only that member and reports it through `on_member_fault` (the
+    quarantine hook) while co-members resolve normally."""
 
     def __init__(self, tickets, fetch, assemble, batch_info: dict,
-                 on_done=None):
+                 on_done=None, on_failure=None, on_member_fault=None):
         self.tickets = tickets
         self.fetch = fetch
         self.assemble = assemble          # host pytree -> list of responses
         self.batch_info = batch_info
         self.on_done = on_done
+        self.on_failure = on_failure
+        self.on_member_fault = on_member_fault
 
     def harvest(self) -> None:
         try:
+            if faults.PLAN is not None:
+                faults.PLAN.fire(
+                    "harvest", tenants=[t.tenant for t in self.tickets])
             host = self.fetch.get()
             harvested_ns = time.perf_counter_ns()
             results = self.assemble(host)
+            if len(results) != len(self.tickets):
+                # zip would silently truncate and leave the surplus tickets
+                # blocked until their deadline — the exact hang this layer
+                # exists to prevent (tests/test_fault_injection.py)
+                raise RuntimeError(
+                    f"assembly returned {len(results)} results for "
+                    f"{len(self.tickets)} members")
             self.batch_info["dur_ns"] = (
                 time.perf_counter_ns() - self.batch_info["t0_ns"])
             for t, r in zip(self.tickets, results):
                 t.stamps.harvested = harvested_ns
                 t.stamps.resolved = time.perf_counter_ns()
-                t.resolve(result=r, batch_info=self.batch_info)
+                if isinstance(r, Exception):
+                    t.resolve(error=r, batch_info=self.batch_info)
+                    if self.on_member_fault is not None:
+                        try:
+                            self.on_member_fault(t, r)
+                        except Exception:  # noqa: BLE001 — best-effort hook
+                            pass
+                else:
+                    t.resolve(result=r, batch_info=self.batch_info)
             if self.on_done is not None:
                 self.on_done(self)
         except Exception as e:  # noqa: BLE001 — every ticket must resolve
-            for t in self.tickets:
+            live = [t for t in self.tickets if not t.done.is_set()]
+            if self.on_failure is not None and live:
+                try:
+                    self.on_failure(live, e)
+                    return
+                except Exception as e2:  # noqa: BLE001 — bisection failed
+                    e = e2
+            for t in live:
                 if not t.done.is_set():
                     t.resolve(error=e)
 
@@ -244,42 +292,95 @@ def stack_down_lanes(lanes_list: list[DownLane]):
     )
 
 
-def assemble_up(host: dict, members: list[UpLane]) -> list[dict]:
-    """Per-member scale-up responses from the batched fetch — field-for-field
-    the serial handler's JSON (ids mapping, option list, fits/remaining)."""
-    out = []
+def assemble_up_one(host: dict, ln: UpLane, i: int) -> dict:
+    """One member's scale-up response from the batched fetch —
+    field-for-field the serial handler's JSON (ids mapping, option list,
+    fits/remaining)."""
+    best = int(host["best"][i])
+    return {
+        "best": ln.ids[best] if 0 <= best < len(ln.ids) else "",
+        "options": [
+            {
+                "id": ln.ids[j],
+                "node_count": int(host["node_count"][i, j]),
+                "pods": int(host["pods"][i, j]),
+                "waste": float(host["waste"][i, j]),
+                "price": float(host["price"][i, j]),
+                "valid": bool(host["valid"][i, j]),
+            }
+            for j in range(len(ln.ids))
+        ],
+        "fits_existing": int(host["fits"][i]),
+        "remaining": int(host["remaining"][i]),
+    }
+
+
+def assemble_down_one(host: dict, ln: DownLane, i: int) -> dict:
+    # device lanes carry a host copy of the valid mask (valid_np) so
+    # assembly never round-trips to the device
+    valid_src = ln.valid_np if ln.valid_np is not None else ln.nodes["valid"]
+    valid = np.asarray(valid_src).astype(bool)
+    return {
+        "eligible": np.nonzero(host["eligible"][i] & valid)[0].tolist(),
+        "drainable": np.nonzero(host["drainable"][i] & valid)[0].tolist(),
+        "utilization": [round(float(u), 4)
+                        for u in host["util"][i][valid]],
+    }
+
+
+def _result_poisoned(r, path="") -> str:
+    """Name the first non-finite float in an assembled response ('' when
+    clean): a poisoned lane (corrupted inputs, device fault) surfaces as
+    NaN/inf in ITS outputs only — lanes are vmap-independent — so the check
+    isolates the offender without failing the batch."""
+    import math
+
+    if isinstance(r, dict):
+        for k, v in r.items():
+            bad = _result_poisoned(v, f"{path}.{k}" if path else k)
+            if bad:
+                return bad
+    elif isinstance(r, (list, tuple)):
+        for j, v in enumerate(r):
+            bad = _result_poisoned(v, f"{path}[{j}]")
+            if bad:
+                return bad
+    elif isinstance(r, float) and not math.isfinite(r):
+        return f"{path}={r}"
+    return ""
+
+
+def assemble_members(host: dict, members: list, tenants: list[str],
+                     assemble_one) -> list:
+    """Fault-isolated per-member assembly: each member assembles inside its
+    own guard (assembly fault hook, NaN screen, exception fence), so one
+    poisoned lane yields one MemberFault entry in the result list while its
+    co-members' responses stay bit-identical to a fault-free run."""
+    out: list = []
     for i, ln in enumerate(members):
-        best = int(host["best"][i])
-        out.append({
-            "best": ln.ids[best] if 0 <= best < len(ln.ids) else "",
-            "options": [
-                {
-                    "id": ln.ids[j],
-                    "node_count": int(host["node_count"][i, j]),
-                    "pods": int(host["pods"][i, j]),
-                    "waste": float(host["waste"][i, j]),
-                    "price": float(host["price"][i, j]),
-                    "valid": bool(host["valid"][i, j]),
-                }
-                for j in range(len(ln.ids))
-            ],
-            "fits_existing": int(host["fits"][i]),
-            "remaining": int(host["remaining"][i]),
-        })
+        tenant = tenants[i] if i < len(tenants) else ""
+        try:
+            if faults.PLAN is not None:
+                faults.PLAN.fire("assembly", tenant=tenant)
+            r = assemble_one(host, ln, i)
+            bad = _result_poisoned(r)
+            if bad:
+                raise MemberFault(
+                    tenant, f"non-finite result plane ({bad}) — "
+                            f"poisoned lane quarantined")
+            out.append(r)
+        except MemberFault as e:
+            out.append(e)
+        except Exception as e:  # noqa: BLE001 — isolate to this member
+            out.append(MemberFault(tenant, f"assembly failed: {e!r}"))
     return out
+
+
+def assemble_up(host: dict, members: list[UpLane]) -> list[dict]:
+    """Whole-batch scale-up assembly (tests/tools; the server uses the
+    fault-isolated assemble_members wrapper)."""
+    return [assemble_up_one(host, ln, i) for i, ln in enumerate(members)]
 
 
 def assemble_down(host: dict, members: list[DownLane]) -> list[dict]:
-    out = []
-    for i, ln in enumerate(members):
-        # device lanes carry a host copy of the valid mask (valid_np) so
-        # assembly never round-trips to the device
-        valid_src = ln.valid_np if ln.valid_np is not None else ln.nodes["valid"]
-        valid = np.asarray(valid_src).astype(bool)
-        out.append({
-            "eligible": np.nonzero(host["eligible"][i] & valid)[0].tolist(),
-            "drainable": np.nonzero(host["drainable"][i] & valid)[0].tolist(),
-            "utilization": [round(float(u), 4)
-                            for u in host["util"][i][valid]],
-        })
-    return out
+    return [assemble_down_one(host, ln, i) for i, ln in enumerate(members)]

@@ -47,11 +47,15 @@ from kubernetes_autoscaler_tpu.metrics.metrics import (
 )
 from kubernetes_autoscaler_tpu.metrics.phases import PHASE_BUCKETS, PhaseStats
 from kubernetes_autoscaler_tpu.models.cluster_state import DEFAULT_DIMS, Dims
+from kubernetes_autoscaler_tpu.sidecar import faults
 from kubernetes_autoscaler_tpu.sidecar.admission import (
     AdmissionQueue,
     BatchScheduler,
+    Quarantined,
     QueueFull,
+    SchedulerDown,
     Ticket,
+    WorldValidationError,
 )
 from kubernetes_autoscaler_tpu.sidecar.lifecycle import (
     REQUEST_PHASE_BUCKETS,
@@ -64,6 +68,7 @@ from kubernetes_autoscaler_tpu.sidecar.native_api import NativeSnapshotState
 from kubernetes_autoscaler_tpu.sidecar.shapes import ShapeClass, ShapeLadder, rung
 from kubernetes_autoscaler_tpu.replay.journal import TenantJournal
 from kubernetes_autoscaler_tpu.sidecar.wire import (
+    BASE_VERSION_HEADER,
     RETRY_AFTER_MS_HEADER,
     SLO_BUDGET_MS_HEADER,
     TENANT_ID_HEADER,
@@ -122,6 +127,14 @@ class _Tenant:
     # per-tenant flight journal (replay/journal.TenantJournal): bounded
     # in-memory provenance ring, persisted on breach/backpressure
     journal: TenantJournal | None = None
+    # pre-admission validation cache: the section-version tuple the last
+    # clean validation ran against (unchanged sections re-validate free)
+    validated_key: tuple | None = None
+    # warm restart (docs/ROBUSTNESS.md): True while serving from a
+    # checkpoint-restored export (native codec state still empty); the
+    # first ApplyDelta full-resend exits rehydration
+    rehydrated: bool = False
+    rehydrated_meta: dict | None = None
 
 
 class SimulatorService:
@@ -139,9 +152,22 @@ class SimulatorService:
                  slo_dump_dir: str = "",
                  tail_sample_capacity: int = 64,
                  tail_slow_quantile: float = 0.95,
-                 journal_capacity: int = 256):
+                 journal_capacity: int = 256,
+                 quarantine_ttl_s: float = 30.0,
+                 max_world: tuple | None = None,
+                 rehydrate_dir: str = ""):
         self.dims = dims
         self.max_tenants = int(max_tenants)
+        # fault-domain isolation (docs/ROBUSTNESS.md): quarantine TTL and
+        # the structural world caps the pre-admission validator enforces
+        # ((nodes, groups, pods); defaults are generous — they bound abuse,
+        # not legitimate scale)
+        self.quarantine_ttl_s = float(quarantine_ttl_s)
+        self.max_world = tuple(max_world) if max_world \
+            else (1 << 20, 1 << 16, 1 << 21)
+        self._quarantine: dict[str, dict] = {}
+        self._quarantine_lock = threading.Lock()
+        self._not_serving = ""      # non-empty = Health reports NOT_SERVING
         self.node_bucket = node_bucket
         self.group_bucket = group_bucket
         self.pod_bucket = pod_bucket
@@ -151,6 +177,12 @@ class SimulatorService:
         # in-process sidecar's series appear identically on both surfaces.
         self.registry = Registry(prefix="katpu_sidecar")
         register_exposition(self.registry)
+        # activate a chaos plan declared in the environment (KATPU_FAULTS);
+        # a programmatically installed plan wins, absence costs one env
+        # read. The registry rides as the plan's default so hook sites
+        # WITHOUT a handle (batch.py / admission.py) still count their
+        # fires into faults_injected_total — the "stamped 3 ways" contract
+        faults.from_env(registry=self.registry)
         self.phases = PhaseStats(owner="sidecar", registry=self.registry)
         self.ladder = ShapeLadder(node_bucket, group_bucket, pod_bucket,
                                   registry=self.registry)
@@ -195,7 +227,15 @@ class SimulatorService:
                 self._queue, self._dispatch_batch, lanes=self.batch_lanes,
                 window_s=batch_window_ms / 1000.0,
                 window_max=batch_window_max,
-                gap_cb=self._note_gap).start()
+                gap_cb=self._note_gap,
+                on_batch_failure=self._batch_failure,
+                on_crash=self._scheduler_crash).start()
+        # warm restart: rehydrate per-tenant serving records persisted by
+        # checkpoint() — steady tenants serve batched sims again without a
+        # full world re-send (docs/ROBUSTNESS.md)
+        self.rehydration = {"restored": 0, "digest_mismatch": 0, "error": 0}
+        if rehydrate_dir:
+            self._rehydrate(rehydrate_dir)
 
     def close(self) -> None:
         if self._scheduler is not None:
@@ -304,6 +344,401 @@ class SimulatorService:
         with self._tenants_lock:
             return sorted(self._tenants)
 
+    # ---- fault-domain isolation (docs/ROBUSTNESS.md) ----
+
+    def _check_quarantine(self, tenant: str) -> None:
+        """Admission edge: a quarantined tenant's sims are rejected with
+        FAILED_PRECONDITION until its TTL elapses (auto-parole — the first
+        request after the TTL is admitted and the entry cleared)."""
+        with self._quarantine_lock:
+            q = self._quarantine.get(tenant)
+            if q is None:
+                return
+            now = _time.monotonic()
+            if now < q["until"]:
+                raise Quarantined(tenant, q["reason"],
+                                  retry_after_ms=max(
+                                      int((q["until"] - now) * 1000), 1))
+            del self._quarantine[tenant]
+        self._note_parole(tenant, "ttl")
+
+    def _note_parole(self, tenant: str, how: str) -> None:
+        self.registry.counter(
+            "tenant_paroled_total",
+            help="Quarantined tenants re-admitted, by parole path (ttl = "
+                 "sentence elapsed; new-world = the tenant re-sent its "
+                 "world via ApplyDelta)").inc(how=how)
+        with self._events_lock:
+            self.events.emit("QuarantineParole", tenant or "default", how,
+                             now=_time.time())
+
+    def _quarantine_tenant(self, tenant: str, reason: str,
+                           error: Exception | None = None) -> None:
+        """Isolate the offender: further sims reject until the TTL parole
+        (or an ApplyDelta re-send). Counted per reason, evidenced on the
+        event sink and the Statusz quarantine table."""
+        now = _time.monotonic()
+        with self._quarantine_lock:
+            q = self._quarantine.get(tenant)
+            if q is None:
+                q = self._quarantine[tenant] = {
+                    "since": _time.time(), "count": 0}
+            q["count"] += 1
+            q["reason"] = reason
+            q["until"] = now + self.quarantine_ttl_s
+            q["error"] = repr(error) if error is not None else ""
+        self.registry.counter(
+            "tenant_quarantined_total",
+            help="Tenants quarantined after a window failure bisected down "
+                 "to them, by fault reason").inc(reason=reason)
+        with self._events_lock:
+            self.events.emit("TenantQuarantined", tenant or "default",
+                             reason, message=repr(error) if error else "",
+                             now=_time.time())
+
+    def _parole_on_new_world(self, tenant: str) -> None:
+        """A successful ApplyDelta paroles early: the quarantined world was
+        the evidence, and the tenant just replaced it."""
+        with self._quarantine_lock:
+            if self._quarantine.pop(tenant, None) is None:
+                return
+        self._note_parole(tenant, "new-world")
+
+    def quarantine_stats(self) -> dict:
+        """tenant -> {reason, count, remaining_s, since} (statusz/bench)."""
+        now = _time.monotonic()
+        with self._quarantine_lock:
+            return {
+                t or "default": {
+                    "reason": q["reason"], "count": q["count"],
+                    "since": q["since"],
+                    "remaining_s": round(max(q["until"] - now, 0.0), 3),
+                    "error": q["error"],
+                }
+                for t, q in self._quarantine.items()}
+
+    @staticmethod
+    def _fault_reason(error: Exception) -> str:
+        if isinstance(error, faults.InjectedFault):
+            return f"injected-{error.hook}"
+        from kubernetes_autoscaler_tpu.sidecar.batch import MemberFault
+
+        if isinstance(error, MemberFault):
+            return "poison-result"
+        return f"window-{type(error).__name__}"
+
+    def _batch_failure(self, tickets: list[Ticket], error: Exception) -> None:
+        """Entry point for a FAILED window batch (BatchScheduler
+        .on_batch_failure / InFlightBatch.on_failure): start a bounded
+        bisection re-dispatch. The budget caps TOTAL re-dispatches for the
+        whole failure tree — a genuine device/infra failure (every half
+        keeps failing) degrades the window with per-member errors instead
+        of looping, while a single poison member costs ~2·log2(B)
+        re-dispatches to isolate."""
+        budget = [max(4, 2 * max(len(tickets), 1).bit_length() + 2)]
+        self.registry.counter(
+            "window_failures_total",
+            help="Batched dispatch windows that failed at dispatch or "
+                 "harvest and entered bisection re-dispatch").inc()
+        self._bisect(tickets, error, budget)
+
+    def _bisect(self, tickets: list[Ticket], error: Exception,
+                budget: list[int], tried: set | None = None) -> None:
+        tried = tried if tried is not None else set()
+        live = [t for t in tickets if not t.done.is_set()]
+        if not live:
+            return
+        if len(live) == 1:
+            t = live[0]
+            if id(t) not in tried and budget[0] > 0:
+                # one retry before conviction: a singleton that failed may
+                # have hit a TRANSIENT fault, not be poison — multi-member
+                # windows implicitly get this via their half re-dispatches,
+                # a lone member (low traffic, lanes=1) must get it too
+                tried.add(id(t))
+                budget[0] -= 1
+                self.registry.counter("window_redispatches_total").inc()
+                try:
+                    inflight = self._dispatch_batch(
+                        live, bisect_budget=budget, bisect_tried=tried)
+                except Exception as e:  # noqa: BLE001 — recurse: now convict
+                    self._bisect(live, e, budget, tried)
+                    return
+                inflight.harvest()
+                return
+            # isolated AND retried: the poison member. Quarantine + error
+            # THIS ticket; every healthy co-member was already served
+            # bit-identically by its own half re-dispatch (vmap lanes are
+            # independent).
+            self._quarantine_tenant(t.tenant, self._fault_reason(error),
+                                    error=error)
+            t.resolve(error=error)
+            return
+        if budget[0] <= 0:
+            self.registry.counter(
+                "bisect_budget_exhausted_total",
+                help="Bisection re-dispatch trees cut short by the retry "
+                     "budget (a whole-device/infra failure pattern, not a "
+                     "poison member) — remaining members degrade with "
+                     "per-member errors").inc()
+            for t in live:
+                t.resolve(error=error)
+            return
+        mid = (len(live) + 1) // 2
+        for half in (live[:mid], live[mid:]):
+            budget[0] -= 1
+            self.registry.counter(
+                "window_redispatches_total",
+                help="Half-window re-dispatches issued by bisection").inc()
+            try:
+                inflight = self._dispatch_batch(half, bisect_budget=budget,
+                                                bisect_tried=tried)
+            except Exception as e:  # noqa: BLE001 — recurse on this half
+                self._bisect(half, e, budget, tried)
+                continue
+            # synchronous harvest: the failure path trades the pipeline
+            # overlap for bounded isolation latency; a harvest failure
+            # recurses through the InFlightBatch's on_failure (same budget)
+            inflight.harvest()
+
+    def _scheduler_crash(self, error: Exception) -> None:
+        """Supervision escalation (BatchScheduler.on_crash): the dispatch
+        thread died, so the serving path is gone — flip Health to
+        NOT_SERVING (orchestration restarts the sidecar) and leave the
+        evidence on metrics + events. Queued tickets were already failed
+        and the admission queue closed by the scheduler's crash handler."""
+        self._not_serving = f"batch scheduler crashed: {error!r}"
+        self.registry.counter(
+            "scheduler_crashes_total",
+            help="Batch-scheduler serve-loop deaths (Health flips to "
+                 "NOT_SERVING; queued tickets failed fast)").inc()
+        with self._events_lock:
+            self.events.emit("SchedulerCrash", "sidecar",
+                             type(error).__name__, message=str(error),
+                             now=_time.time())
+
+    # ---- pre-admission validation (docs/ROBUSTNESS.md) ----
+
+    def _note_validation_reject(self, tenant: str,
+                                e: WorldValidationError) -> None:
+        self.registry.counter(
+            "world_validation_rejects_total",
+            help="Requests rejected INVALID_ARGUMENT by pre-admission "
+                 "world/param validation, by taxonomy reason",
+        ).inc(reason=e.reason)
+        with self._events_lock:
+            self.events.emit("WorldValidationReject", tenant or "default",
+                             e.reason, message=str(e), now=_time.time())
+
+    def _validate_params(self, params: SimParams, kind: str) -> None:
+        """Request-side structural screen (cheap scalar checks, every
+        request): NaN/inf and negative values in the simulation parameters
+        — a NaN threshold or template capacity would poison every lane of
+        the window it joined."""
+        import math
+
+        def _bad_float(v) -> bool:
+            return isinstance(v, float) and not math.isfinite(v)
+
+        if kind == "down":
+            th = params.threshold
+            if not isinstance(th, (int, float)) or _bad_float(float(th)):
+                raise WorldValidationError("nan", f"threshold={th!r}")
+            if th < 0:
+                raise WorldValidationError("negative-request",
+                                           f"threshold={th!r}")
+            return
+        if params.max_new_nodes < 0:
+            raise WorldValidationError(
+                "negative-request", f"max_new_nodes={params.max_new_nodes}")
+        for g in params.node_groups or []:
+            tpl = (g or {}).get("template") or {}
+            for field_name in ("capacity", "allocatable"):
+                for k, v in (tpl.get(field_name) or {}).items():
+                    if _bad_float(v):
+                        raise WorldValidationError(
+                            "nan", f"node group {g.get('id')!r}: "
+                                   f"{field_name}[{k}]={v}")
+                    if isinstance(v, (int, float)) and v < 0:
+                        raise WorldValidationError(
+                            "negative-request",
+                            f"node group {g.get('id')!r}: "
+                            f"{field_name}[{k}]={v}")
+
+    def _validate_world(self, ts: _Tenant) -> None:
+        """World-side structural screen, run BEFORE the world reaches a
+        coalescing window; caller holds ts.lock. Cached per section-version
+        tuple, so steady tenants re-validate for one tuple compare — the
+        scan only runs when a delta actually changed a section. Rehydrated
+        tenants were validated before their checkpoint."""
+        if ts.rehydrated:
+            return
+        n, p, g = ts.state.counts()
+        mn, mg, mp = self.max_world
+        if n > mn or g > mg or p > mp:
+            raise WorldValidationError(
+                "oversize-world",
+                f"counts nodes={n} groups={g} pods={p} exceed caps "
+                f"nodes={mn} groups={mg} pods={mp}")
+        key = ts.state.section_versions()
+        if ts.validated_key == key:
+            return
+        groups_np = ts.export_np.get("groups")
+        pods_np = ts.export_np.get("pods")
+        for section, arr in (("groups", groups_np), ("pods", pods_np)):
+            if arr is not None and int(arr["req"].min(initial=0)) < 0:
+                raise WorldValidationError(
+                    "negative-request",
+                    f"{section} section carries a negative resource "
+                    f"request (min={int(arr['req'].min())})")
+        ts.validated_key = key
+
+    # ---- warm restart: checkpoint + rehydration (docs/ROBUSTNESS.md) ----
+
+    @staticmethod
+    def _export_digest(arrays: dict) -> str:
+        """Canonical digest over a tenant's class-shaped export planes:
+        name, dtype, shape and raw bytes of every section field in sorted
+        order — the journal-style content digest a rehydrating sidecar
+        verifies before trusting a record."""
+        h = hashlib.sha256()
+        for name in sorted(arrays):
+            a = np.ascontiguousarray(arrays[name])
+            h.update(name.encode())
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+        return h.hexdigest()[:16]
+
+    def checkpoint(self, dir_path: str) -> dict:
+        """Persist per-tenant rehydration records (graceful shutdown /
+        periodic checkpoint): class-shaped native export planes + metadata
+        (world version, section versions, shape class, SLO budget, journal
+        cursor, content digest). A restarted sidecar pointed at the same
+        directory serves these tenants' batched sims again WITHOUT a full
+        world re-send; constrained (aux-overlay) and empty tenants are
+        skipped — they fall back to the existing full-encode re-send path."""
+        import os
+
+        os.makedirs(dir_path, exist_ok=True)
+        written = []
+        for tid in self.tenants():
+            ts = self._tenant_peek(tid)
+            if ts is None:
+                continue
+            with ts.lock:
+                if ts.aux:
+                    continue    # constrained tier: needs the native world
+                if ts.state.version == 0 and not ts.rehydrated:
+                    continue    # empty world: nothing to restore
+                if not ts.rehydrated:
+                    self._export_np(ts)     # refresh sections at class shape
+                if np.any(ts.export_np["nodes"]["zone_id"] > 0):
+                    # zoned worlds restart cold by design: the codec's
+                    # zone-id interning is not recoverable from the export
+                    # planes, and a rehydrated tenant's templates would be
+                    # lowered against a FRESH id space — silently wrong
+                    # multi-zone sims instead of a re-send
+                    continue
+                arrays = {f"{sec}:{k}": v
+                          for sec in ("nodes", "groups", "pods")
+                          for k, v in ts.export_np[sec].items()}
+                n, p, g = (tuple(ts.rehydrated_meta["counts"])
+                           if ts.rehydrated else ts.state.counts())
+                cursor = (ts.journal.cursor()
+                          if ts.journal is not None else None)
+                meta = {
+                    "tenant": tid,
+                    "version": (ts.rehydrated_meta["version"]
+                                if ts.rehydrated else ts.state.version),
+                    "counts": [n, p, g],
+                    "sections": {s: list(ts.export_keys[s])
+                                 for s in ("nodes", "groups", "pods")},
+                    "shape_class": ts.shape_class.key if ts.shape_class
+                    else "",
+                    "slo_budget_ms": self.slo.get(tid) or 0.0,
+                    "journal_cursor": list(cursor) if cursor else None,
+                    "digest": self._export_digest(arrays),
+                }
+            fname = ("rehydrate-"
+                     + hashlib.sha1((tid or "default").encode())
+                     .hexdigest()[:12] + ".npz")
+            path = os.path.join(dir_path, fname)
+            tmp = path + ".tmp.npz"
+            with open(tmp, "wb") as f:
+                np.savez(f, __meta__=np.frombuffer(
+                    json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+            os.replace(tmp, path)
+            written.append(tid)
+        return {"dir": dir_path, "tenants": len(written), "ids": written}
+
+    def _rehydrated_total(self):
+        """The one accessor for `tenant_rehydrated_total` — whichever
+        outcome fires first creates the family with its help text (the
+        _phase_hist convention)."""
+        return self.registry.counter(
+            "tenant_rehydrated_total",
+            help="Warm-restart rehydration outcomes per checkpoint record "
+                 "(restored / digest-mismatch / error)")
+
+    def _rehydrate(self, dir_path: str) -> None:
+        """Load rehydration records written by checkpoint(). Every record
+        is digest-verified before its planes are trusted; a mismatch (torn
+        write, tampering, version skew) drops the record — that tenant is
+        simply cold and re-sends its world like any new tenant."""
+        import glob
+        import os
+
+        for path in sorted(glob.glob(os.path.join(dir_path,
+                                                  "rehydrate-*.npz"))):
+            try:
+                with np.load(path) as z:
+                    meta = json.loads(bytes(z["__meta__"].tobytes()))
+                    arrays = {k: z[k] for k in z.files if k != "__meta__"}
+                if self._export_digest(arrays) != meta["digest"]:
+                    self.rehydration["digest_mismatch"] += 1
+                    self._rehydrated_total().inc(outcome="digest-mismatch")
+                    continue
+                tid = meta["tenant"]
+                ts = self._tenant(tid)
+                with ts.lock:
+                    ts.export_np = {"nodes": {}, "groups": {}, "pods": {}}
+                    for k, v in arrays.items():
+                        sec, field_name = k.split(":", 1)
+                        ts.export_np[sec][field_name] = v
+                    ts.export_keys = {s: tuple(v) for s, v in
+                                      meta["sections"].items()}
+                    ts.rehydrated = True
+                    ts.rehydrated_meta = {"version": meta["version"],
+                                          "counts": meta["counts"],
+                                          "digest": meta["digest"]}
+                    n, p, g = meta["counts"]
+                    ts.shape_class = self.ladder.classify(n, g, p,
+                                                          tenant=tid)
+                    if meta.get("slo_budget_ms"):
+                        self.slo.set(tid, float(meta["slo_budget_ms"]))
+                    if ts.journal is not None:
+                        ts.journal.record("rehydrate", meta["version"],
+                                          digest=meta["digest"])
+                self.rehydration["restored"] += 1
+                self._rehydrated_total().inc(outcome="restored")
+            except Exception:  # noqa: BLE001 — a bad record = a cold tenant
+                self.rehydration["error"] += 1
+                self._rehydrated_total().inc(outcome="error")
+
+    def _exit_rehydration(self, ts: _Tenant) -> None:
+        """First ApplyDelta after a warm restart: the native codec state is
+        authoritative again — drop the restored planes and every cache
+        keyed by the OLD process's section versions. Caller holds ts.lock."""
+        ts.rehydrated = False
+        ts.rehydrated_meta = None
+        ts.export_keys = {}
+        ts.export_np = {}
+        ts.dev_keys = {}
+        ts.dev_np = {}
+        ts.serial_cache = None
+        ts.validated_key = None
+
     # legacy single-tenant accessors (tests, conformance tooling)
     @property
     def state(self) -> NativeSnapshotState:
@@ -315,16 +750,41 @@ class SimulatorService:
 
     # ---- rpc: ApplyDelta ----
 
-    def apply_delta(self, payload: bytes, tenant: str = "") -> dict:
+    def apply_delta(self, payload: bytes, tenant: str = "",
+                    base_version: int | None = None) -> dict:
         from kubernetes_autoscaler_tpu.sidecar.wire import split_aux
 
         ts = self._tenant(tenant)
         with ts.lock:
+            # snapshot-version pinning (wire.BASE_VERSION_HEADER): a delta
+            # built against a version the server does not hold — most
+            # importantly after a restart, when the codec is empty or the
+            # tenant is serving a rehydrated export — must reject loudly
+            # (INVALID_ARGUMENT, reason pinned by tests) instead of
+            # silently applying against the wrong base snapshot
+            if base_version is not None \
+                    and int(base_version) != ts.state.version:
+                e = WorldValidationError(
+                    "section-version-mismatch",
+                    f"delta built against version {base_version}, server "
+                    f"holds {ts.state.version}"
+                    + (" (rehydrated world — full re-send required)"
+                       if ts.rehydrated else ""))
+                self._note_validation_reject(tenant, e)
+                raise e
             try:
+                if faults.PLAN is not None:
+                    payload = faults.PLAN.fire(
+                        "codec_decode", tenant=tenant, payload=payload,
+                        registry=self.registry)
                 # split INSIDE the guarded region: any malformed trailer must
                 # surface as an error dict, never an uncaught exception
                 dense, aux = split_aux(payload)
                 ts.state.apply_delta(dense)
+                if ts.rehydrated:
+                    # the codec state is authoritative again: drop the
+                    # restored planes + the old process's cache keys
+                    self._exit_rehydration(ts)
                 if aux is not None:
                     ts.aux.update(aux.get("up", {}))
                     for uid in aux.get("del", []):
@@ -336,14 +796,34 @@ class SimulatorService:
                     ts.journal.record(
                         "delta", ts.state.version, nbytes=len(payload),
                         digest=hashlib.sha256(payload).hexdigest()[:16])
-                return {"version": ts.state.version, "error": ""}
+                # the ack version is read UNDER ts.lock: a concurrent delta
+                # for this tenant must not make the ack report a version
+                # whose contents this caller never sent (clients pin
+                # BASE_VERSION_HEADER from exactly this value)
+                acked_version = ts.state.version
             except (ValueError, TypeError) as e:
+                # codec rejections ride the error-dict contract (committed
+                # goldens / Go shim compatibility) but still count into the
+                # validation taxonomy — a chaos-truncated section lands here
+                self._note_validation_reject(
+                    tenant, WorldValidationError("codec", str(e)))
                 return {"version": ts.state.version, "error": str(e)}
+        # a successful re-send paroles a quarantined tenant early: the
+        # quarantined world is gone, the tenant brought a new one
+        self._parole_on_new_world(tenant)
+        return {"version": acked_version, "error": ""}
 
     def _classify(self, ts: _Tenant) -> ShapeClass:
         """(Re)bucket a tenant's world; caller holds ts.lock. Counts within
         the current rungs keep the class — the hit counters measure exactly
         the "no new padded shape" guarantee."""
+        if ts.rehydrated:
+            # the class was restored (and re-seen on the ladder) at
+            # rehydration time; the empty codec counts would misclassify
+            return ts.shape_class
+        if faults.PLAN is not None:
+            faults.PLAN.fire("classify", tenant=ts.tid,
+                             registry=self.registry)
         n, p, g = ts.state.counts()
         ts.shape_class = self.ladder.classify(n, g, p, tenant=ts.tid)
         return ts.shape_class
@@ -360,6 +840,15 @@ class SimulatorService:
 
         if ts is None:
             ts = self._tenant("")
+        if ts.rehydrated:
+            # the serial/constrained tier assembles from the NATIVE world,
+            # which a warm restart does not restore — the client must
+            # re-send before this path can serve (FAILED cold fallback)
+            raise WorldValidationError(
+                "rehydration-pending",
+                "tenant restored from checkpoint serves batched sims only; "
+                "the serial/constrained path requires an ApplyDelta "
+                "world re-send")
         # serial-path residency: the assembled world is immutable once
         # built, and every ApplyDelta bumps the codec version (aux rides
         # the same payload) — so (version, buckets) keys a safe cache and
@@ -411,12 +900,31 @@ class SimulatorService:
 
     # ---- rpc: ScaleUpSim ----
 
+    def _admit_sim(self, tenant: str, params: SimParams, kind: str) -> None:
+        """The admission edge every sim passes BEFORE a ticket exists:
+        dead-scheduler fail-fast (UNAVAILABLE — nothing would drain the
+        queue), quarantine sentence check (FAILED_PRECONDITION), and the
+        request-side structural validation (INVALID_ARGUMENT)."""
+        if self._not_serving:
+            raise SchedulerDown(self._not_serving)
+        self._check_quarantine(tenant)
+        try:
+            self._validate_params(params, kind)
+        except WorldValidationError as e:
+            self._note_validation_reject(tenant, e)
+            raise
+
     def scale_up_sim(self, params: SimParams, tenant: str = "") -> dict:
         entry_ns = _time.perf_counter_ns()
+        self._admit_sim(tenant, params, "up")
         ts = self._tenant(tenant)
         if self._batchable(ts):
             return self._submit("up", ts, params, entry_ns)
-        return self._scale_up_serial(ts, params, entry_ns)
+        try:
+            return self._scale_up_serial(ts, params, entry_ns)
+        except WorldValidationError as e:
+            self._note_validation_reject(tenant, e)
+            raise
 
     def _scale_up_serial(self, ts: _Tenant, params: SimParams,
                          entry_ns: int = 0) -> dict:
@@ -458,10 +966,15 @@ class SimulatorService:
 
     def scale_down_sim(self, params: SimParams, tenant: str = "") -> dict:
         entry_ns = _time.perf_counter_ns()
+        self._admit_sim(tenant, params, "down")
         ts = self._tenant(tenant)
         if self._batchable(ts):
             return self._submit("down", ts, params, entry_ns)
-        return self._scale_down_serial(ts, params, entry_ns)
+        try:
+            return self._scale_down_serial(ts, params, entry_ns)
+        except WorldValidationError as e:
+            self._note_validation_reject(tenant, e)
+            raise
 
     def _scale_down_serial(self, ts: _Tenant, params: SimParams,
                            entry_ns: int = 0) -> dict:
@@ -505,6 +1018,12 @@ class SimulatorService:
         re-materialized the whole export on any single-pod delta). Caller
         holds ts.lock. The geometric rungs make `pad_to(n, rung) == rung`,
         so every tenant of a class exports identical tensor shapes."""
+        if ts.rehydrated:
+            # warm restart: serve the checkpoint-restored planes as-is —
+            # the empty codec must not overwrite them; the first ApplyDelta
+            # re-send exits this mode (_exit_rehydration)
+            return ts.export_np["nodes"], ts.export_np["groups"], \
+                ts.export_np["pods"]
         sc = self._classify(ts)
         sv = ts.state.section_versions()
         refreshed = []
@@ -558,6 +1077,8 @@ class SimulatorService:
         from kubernetes_autoscaler_tpu.models.world_store import H2D_HELP
 
         self._export_np(ts)
+        if faults.PLAN is not None:
+            faults.PLAN.fire("h2d", tenant=ts.tid, registry=self.registry)
         uploaded = 0
         for section in ("nodes", "groups", "pods"):
             key = ts.export_keys[section]
@@ -615,6 +1136,15 @@ class SimulatorService:
 
         stamps = Stamps(entry=entry_ns or _time.perf_counter_ns())
         with ts.lock:
+            # pre-admission world validation: a structurally bad world
+            # (negative requests, oversize counts) never reaches a
+            # coalescing window where it could take co-tenants down
+            self._export_np(ts)
+            try:
+                self._validate_world(ts)
+            except WorldValidationError as e:
+                self._note_validation_reject(ts.tid, e)
+                raise
             # the RESIDENT device lanes: dirty sections upload here (the
             # only world h2d on the batched path); untouched sections and
             # steady tenants reuse their device arrays as-is
@@ -776,10 +1306,17 @@ class SimulatorService:
             self._account_new_tenant(
                 tenants, self._sim_cache_size() - before)
 
-    def _dispatch_batch(self, tickets: list[Ticket]):
+    def _dispatch_batch(self, tickets: list[Ticket],
+                        bisect_budget: list | None = None,
+                        bisect_tried: set | None = None):
         """Scheduler-thread entry: stack one batch-compatible ticket run,
         dispatch the vmapped program, issue the async result fetch. Returns
-        the in-flight handle the scheduler harvests one window later."""
+        the in-flight handle the scheduler harvests one window later.
+
+        `bisect_budget`/`bisect_tried` are set on bisection re-dispatches:
+        the in-flight handle's failure path then recurses into `_bisect`
+        with the SAME bounded budget (and singleton-retry history) instead
+        of starting a fresh tree."""
         import jax.numpy as jnp
 
         from kubernetes_autoscaler_tpu.ops import autoscale_step as a
@@ -788,10 +1325,14 @@ class SimulatorService:
 
         kind = tickets[0].kind
         key = tickets[0].key
+        tenants = [t.tenant for t in tickets]
         t0 = _time.perf_counter_ns()
         for t in tickets:
             t.stamps.stack0 = t0
         members = [t.lane for t in tickets]
+        if faults.PLAN is not None:
+            faults.PLAN.fire("stack", tenants=tenants,
+                             registry=self.registry)
         lanes_list = b.pad_lanes(members, self.batch_lanes)
         stack_key = (key, tuple(t.fp for t in tickets))
 
@@ -801,6 +1342,9 @@ class SimulatorService:
         # charged, per dirty section, when the lanes refreshed.
         with self._recompile_charge([self._tenant(t.tenant)
                                      for t in tickets]):
+            if faults.PLAN is not None:
+                faults.PLAN.fire("dispatch", tenants=tenants,
+                                 registry=self.registry)
             if kind == "up":
                 nt, gt, pt, gr = self._stack_cache.get(
                     stack_key, lambda: b.stack_up_lanes(lanes_list))
@@ -819,7 +1363,8 @@ class SimulatorService:
                     "fits": out.fits_existing.sum(-1),
                     "remaining": out.remaining.sum(-1),
                 }
-                assemble = lambda host: b.assemble_up(host, members)  # noqa: E731
+                assemble = lambda host: b.assemble_members(  # noqa: E731
+                    host, members, tenants, b.assemble_up_one)
             else:
                 nt, gt, pt = self._stack_cache.get(
                     stack_key, lambda: b.stack_down_lanes(lanes_list)[:3])
@@ -834,7 +1379,8 @@ class SimulatorService:
                     "drainable": out.removal.drainable,
                     "util": out.utilization,
                 }
-                assemble = lambda host: b.assemble_down(host, members)  # noqa: E731
+                assemble = lambda host: b.assemble_members(  # noqa: E731
+                    host, members, tenants, b.assemble_down_one)
         occupancy = len(tickets)
         self.occupancies.append(occupancy)
         self.registry.counter(
@@ -873,7 +1419,17 @@ class SimulatorService:
                         for t in tickets],
             "t0_ns": t0,
         }
-        return b.InFlightBatch(tickets, fetch, assemble, batch_info)
+        # failure wiring (docs/ROBUSTNESS.md): a batch-level harvest
+        # failure enters (or continues) the bounded bisection tree; a
+        # per-member poison result quarantines exactly that tenant
+        on_failure = ((lambda tks, e: self._bisect(
+            tks, e, bisect_budget, bisect_tried))
+            if bisect_budget is not None else self._batch_failure)
+        return b.InFlightBatch(
+            tickets, fetch, assemble, batch_info,
+            on_failure=on_failure,
+            on_member_fault=lambda t, e: self._quarantine_tenant(
+                t.tenant, self._fault_reason(e), error=e))
 
     def batch_stats(self) -> dict:
         """Bench/ops view of the batching layer."""
@@ -993,6 +1549,38 @@ class SimulatorService:
             f"tail sampler: offered={tstats['offered']} "
             f"retained={tstats['retained']} evicted={tstats['evicted']} "
             f"held={tstats['held']} reasons={json.dumps(tstats['reasons'], sort_keys=True)}")
+        # fault-domain isolation (docs/ROBUSTNESS.md): quarantine table,
+        # window-failure/bisection accounting, rehydration + chaos plane
+        qs = self.quarantine_stats()
+        wrej = self.registry.counter("world_validation_rejects_total")
+        lines.append(
+            f"quarantine: {len(qs)} tenants (ttl {self.quarantine_ttl_s}s) "
+            f"quarantined_total={self.registry.counter('tenant_quarantined_total').total():.0f} "
+            f"paroled_total={self.registry.counter('tenant_paroled_total').total():.0f} "
+            f"window_failures={self.registry.counter('window_failures_total').total():.0f} "
+            f"redispatches={self.registry.counter('window_redispatches_total').total():.0f} "
+            f"validation_rejects={wrej.total():.0f}")
+        for t in sorted(qs):
+            q = qs[t]
+            lines.append(f"  {t:<15} reason={q['reason']} "
+                         f"count={q['count']} "
+                         f"parole_in={q['remaining_s']}s")
+        rh = self.rehydration
+        lines.append(
+            f"warm restart: restored={rh['restored']} "
+            f"digest_mismatch={rh['digest_mismatch']} "
+            f"errors={rh['error']} "
+            f"scheduler={'NOT_SERVING (' + self._not_serving + ')' if self._not_serving else 'serving'}")
+        if faults.PLAN is not None:
+            fs = faults.PLAN.stats()
+            lines.append(
+                f"faults: ACTIVE seed={fs['seed']} "
+                f"specs={len(fs['specs'])} fired={fs['fired_total']}")
+            for ent in fs["log_tail"]:
+                lines.append(f"  #{ent['seq']} {ent['hook']}/{ent['kind']} "
+                             f"spec={ent['spec']} tenant={ent['tenant'] or '-'}")
+        else:
+            lines.append("faults: disabled")
         # flight-journal section: per-tenant provenance ring accounting
         # (records/bytes/held/drops/persists), capped like the tenant table
         jrows = []
@@ -1119,8 +1707,15 @@ class SimulatorService:
         return exemplar
 
     def health(self) -> dict:
-        return {"version": self.state.version, "error": "",
-                "tenants": len(self._tenants)}
+        """SERVING, or NOT_SERVING once the batch scheduler crashed (the
+        supervision contract: a sidecar whose dispatch thread is dead must
+        not look healthy to orchestration OR to client half-open probes)."""
+        if self._not_serving:
+            return {"version": self.state.version, "status": "NOT_SERVING",
+                    "error": self._not_serving,
+                    "tenants": len(self._tenants)}
+        return {"version": self.state.version, "status": "SERVING",
+                "error": "", "tenants": len(self._tenants)}
 
     # ---- rpc: Metricz ----
 
@@ -1232,19 +1827,27 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
                 return v
         return None
 
-    def _reject_exhausted(context, e: QueueFull) -> bytes:
-        # explicit backpressure: the caller sees RESOURCE_EXHAUSTED with a
-        # retry hint instead of a wedged RPC; the request was never queued,
-        # so retrying after the hint is always safe
+    def _reject(context, e: Exception, code, code_name: str,
+                retry_after_ms: int | None = None,
+                reason: str | None = None) -> bytes:
+        # explicit structured rejection: the caller sees a REAL status code
+        # (RESOURCE_EXHAUSTED backpressure / FAILED_PRECONDITION quarantine
+        # / INVALID_ARGUMENT validation / UNAVAILABLE dead scheduler)
+        # instead of a wedged RPC or an anonymous error string
         try:
-            context.set_trailing_metadata(
-                ((RETRY_AFTER_MS_HEADER, str(e.retry_after_ms)),))
-            context.set_code(grpc.StatusCode.RESOURCE_EXHAUSTED)
+            if retry_after_ms is not None:
+                context.set_trailing_metadata(
+                    ((RETRY_AFTER_MS_HEADER, str(retry_after_ms)),))
+            context.set_code(code)
             context.set_details(str(e))
         except Exception:  # noqa: BLE001 — non-grpc contexts in tests
             pass
-        return json.dumps({"error": str(e), "code": "RESOURCE_EXHAUSTED",
-                           "retry_after_ms": e.retry_after_ms}).encode()
+        body = {"error": str(e), "code": code_name}
+        if retry_after_ms is not None:
+            body["retry_after_ms"] = retry_after_ms
+        if reason is not None:
+            body["reason"] = reason
+        return json.dumps(body).encode()
 
     def _json_method(name: str, fn, parse_params: bool, sample: bool = True):
         def handler(request: bytes, context):
@@ -1267,6 +1870,11 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
                         node_groups=raw.get("node_groups"),
                     )
                     body = lambda: fn(params, tenant=tenant)  # noqa: E731
+                elif name == "ApplyDelta":
+                    base = _meta_of(context, BASE_VERSION_HEADER)
+                    kw = ({"base_version": int(base)}
+                          if base not in (None, "") else {})
+                    body = lambda: fn(request, tenant=tenant, **kw)  # noqa: E731
                 else:
                     body = lambda: fn(request, tenant=tenant)  # noqa: E731
                 resp, group = traced_call(
@@ -1275,9 +1883,25 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
                     tenant=tenant, sample=sample)
                 if group is not None and isinstance(resp, dict):
                     resp["trace"] = group
+                if faults.PLAN is not None:
+                    faults.PLAN.fire("grpc_reply", tenant=tenant,
+                                     registry=service.registry)
                 return json.dumps(resp).encode()
             except QueueFull as e:
-                return _reject_exhausted(context, e)
+                return _reject(context, e, grpc.StatusCode.RESOURCE_EXHAUSTED,
+                               "RESOURCE_EXHAUSTED",
+                               retry_after_ms=e.retry_after_ms)
+            except Quarantined as e:
+                return _reject(context, e, grpc.StatusCode.FAILED_PRECONDITION,
+                               "FAILED_PRECONDITION",
+                               retry_after_ms=e.retry_after_ms,
+                               reason=e.reason)
+            except WorldValidationError as e:
+                return _reject(context, e, grpc.StatusCode.INVALID_ARGUMENT,
+                               "INVALID_ARGUMENT", reason=e.reason)
+            except SchedulerDown as e:
+                return _reject(context, e, grpc.StatusCode.UNAVAILABLE,
+                               "UNAVAILABLE")
             except Exception as e:  # fail-closed with the error on the wire
                 return json.dumps({"error": str(e)}).encode()
 
@@ -1346,6 +1970,99 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
     return server, bound
 
 
+class CircuitOpen(ConnectionError):
+    """Fast-fail from an OPEN client circuit: the sidecar kept failing, so
+    this call never touched the wire — one exception per loop instead of a
+    full retry ladder per RPC against a flapping server. Carries the error
+    that opened the circuit and the time until the next half-open probe."""
+
+    def __init__(self, retry_in_s: float, last_error: Exception | None):
+        super().__init__(
+            f"sidecar circuit open (half-open probe in {retry_in_s:.2f}s); "
+            f"last error: {last_error!r}")
+        self.retry_in_s = retry_in_s
+        self.last_error = last_error
+
+
+class CircuitBreaker:
+    """closed → open → half-open client circuit (docs/ROBUSTNESS.md).
+
+    closed: calls flow; `threshold` CONSECUTIVE transport failures
+    (UNAVAILABLE after the retry ladder, deadline exceeded) open it.
+    open: calls fast-fail with CircuitOpen until `cooldown_s` elapses.
+    half-open: exactly one probe (the client uses the cheap Health RPC) is
+    allowed through; success closes the circuit, failure re-opens it for
+    another cooldown. Responses that prove the server ALIVE — including
+    backpressure rejections — reset the failure streak.
+
+    State changes land on the default metrics registry
+    (`sidecar_breaker_state{target}` 0/1/2 and
+    `sidecar_breaker_transitions_total{target,to}`) so a flapping sidecar
+    is visible from the control plane's own /metrics. `clock` is
+    injectable for fake-clock tests."""
+
+    STATES = {"closed": 0, "open": 1, "half-open": 2}
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 1.0,
+                 clock=_time.monotonic, target: str = ""):
+        self.threshold = max(int(threshold), 1)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self.target = target
+        self.state = "closed"
+        self.failures = 0
+        self.last_error: Exception | None = None
+        self._opened_at = 0.0
+        self._lock = threading.Lock()
+
+    def _to(self, state: str) -> None:
+        if state == self.state:
+            return
+        self.state = state
+        from kubernetes_autoscaler_tpu.metrics.metrics import default_registry
+
+        labels = {"target": self.target} if self.target else {}
+        default_registry.gauge(
+            "sidecar_breaker_state",
+            help="Client circuit-breaker state per sidecar target "
+                 "(0=closed 1=open 2=half-open)",
+        ).set(float(self.STATES[state]), **labels)
+        default_registry.counter(
+            "sidecar_breaker_transitions_total",
+            help="Client circuit-breaker state transitions",
+        ).inc(to=state, **labels)
+
+    def gate(self) -> str:
+        """'ok' to call through, 'probe' to health-check first (half-open);
+        raises CircuitOpen while the circuit is open and cooling."""
+        with self._lock:
+            if self.state == "closed":
+                return "ok"
+            elapsed = self._clock() - self._opened_at
+            if self.state == "open" and elapsed < self.cooldown_s:
+                raise CircuitOpen(self.cooldown_s - elapsed, self.last_error)
+            self._to("half-open")
+            return "probe"
+
+    def ok(self) -> None:
+        """The server answered (a sim result, or even a structured
+        rejection): the streak resets and a half-open circuit closes."""
+        with self._lock:
+            self.failures = 0
+            if self.state != "closed":
+                self._to("closed")
+
+    def fail(self, error: Exception) -> None:
+        """A transport-level failure: half-open re-opens immediately, a
+        closed circuit opens once the consecutive streak hits threshold."""
+        with self._lock:
+            self.last_error = error
+            self.failures += 1
+            if self.state == "half-open" or self.failures >= self.threshold:
+                self._to("open")
+                self._opened_at = self._clock()
+
+
 class SimulatorClient:
     """Thin client mirroring the Go side's calls (tests + examples).
 
@@ -1358,9 +2075,23 @@ class SimulatorClient:
     in under a second). When the cap is hit the last error raises promptly,
     so a control loop using the sidecar degrades to its LOCAL simulation
     fallback instead of hanging a RunOnce forever.
-    Backpressure (RESOURCE_EXHAUSTED) is NOT retried here — it surfaces as
-    admission.QueueFull with the server's retry-after hint so the caller can
-    shed or defer load deliberately."""
+
+    Backpressure (RESOURCE_EXHAUSTED) now honors the server's
+    `katpu-retry-after-ms` hint (ISSUE 12 small fix): up to
+    `queue_retry_attempts` jittered, capped sleeps before surfacing
+    admission.QueueFull — the hint is what the server computed the queue
+    needs, so blind-fast retry (hammering a saturated server) and
+    terminal-give-up (shedding load the queue would have absorbed in 20ms)
+    are both wrong. Deliberate immediate shedding is still available with
+    `queue_retry_attempts=0`.
+
+    On top of the per-RPC ladder sits a real CIRCUIT BREAKER
+    (docs/ROBUSTNESS.md): `breaker_threshold` consecutive transport
+    failures open it, after which calls fast-fail with CircuitOpen (no
+    wire touch) until `breaker_cooldown_s` elapses; the half-open probe is
+    the cheap Health RPC, so a flapping sidecar costs one fast exception
+    per loop instead of a full retry ladder per RPC. `clock`/`sleep` are
+    injectable for fake-clock tests."""
 
     def __init__(self, port: int, cert_file: str | None = None,
                  host: str = "127.0.0.1",
@@ -1370,13 +2101,33 @@ class SimulatorClient:
                  rpc_timeout_s: float = 30.0,
                  retry_budget_s: float = 10.0,
                  retry_attempts: int = 5,
-                 slo_budget_ms: float = 0.0):
+                 slo_budget_ms: float = 0.0,
+                 queue_retry_attempts: int = 3,
+                 queue_retry_cap_ms: float = 2000.0,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown_s: float = 1.0,
+                 clock=_time.monotonic,
+                 sleep=_time.sleep):
         import grpc
+        import random as _random
 
         self.tenant = tenant
         self.rpc_timeout_s = rpc_timeout_s
         self.retry_budget_s = retry_budget_s
         self.retry_attempts = retry_attempts
+        self.queue_retry_attempts = max(int(queue_retry_attempts), 0)
+        self.queue_retry_cap_ms = float(queue_retry_cap_ms)
+        self._clock = clock
+        self._sleep = sleep
+        # full jitter over the server hint: a deterministic per-client seed
+        # keeps chaos runs replayable (the jitter exists to decorrelate a
+        # HERD of clients, not to randomize one client's evidence)
+        self._rng = _random.Random(0x5EED)
+        # breaker_threshold=0 disables the breaker (raw ladder semantics)
+        self.breaker = (CircuitBreaker(
+            threshold=breaker_threshold, cooldown_s=breaker_cooldown_s,
+            clock=clock, target=f"{host}:{port}")
+            if breaker_threshold > 0 else None)
         # declared per-tenant latency budget (wire.SLO_BUDGET_MS_HEADER):
         # the server counts tenant_slo_breaches_total against it and keeps
         # tenant-scoped breach dumps
@@ -1416,7 +2167,27 @@ class SimulatorClient:
             pass
         return 20
 
-    def _call(self, method: str, payload: bytes) -> bytes:
+    def _probe_health(self) -> None:
+        """The half-open probe: ONE cheap Health RPC, no retry ladder. A
+        SERVING answer closes the breaker and lets the real call proceed; a
+        failure (or NOT_SERVING) re-opens it for another cooldown and
+        fast-fails the caller."""
+        rpc = self.channel.unary_unary(
+            f"/{_SERVICE}/Health",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        try:
+            resp = json.loads(rpc(b"", timeout=min(self.rpc_timeout_s, 2.0)))
+            if resp.get("status", "SERVING") == "NOT_SERVING":
+                raise ConnectionError(
+                    f"sidecar NOT_SERVING: {resp.get('error')}")
+            self.breaker.ok()
+        except Exception as e:  # noqa: BLE001 — any probe failure re-opens
+            self.breaker.fail(e)
+            raise CircuitOpen(self.breaker.cooldown_s, e) from e
+
+    def _call(self, method: str, payload: bytes, metadata=()) -> bytes:
         import grpc
 
         rpc = self.channel.unary_unary(
@@ -1424,12 +2195,23 @@ class SimulatorClient:
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b,
         )
+        # circuit gate BEFORE any wire touch: open = one fast exception
+        # (the caller's local-fallback signal), half-open = Health probe
+        if self.breaker is not None:
+            tracer0 = trace.current_tracer()
+            try:
+                if self.breaker.gate() == "probe":
+                    self._probe_health()
+            except CircuitOpen:
+                if tracer0 is not None:
+                    tracer0.annotate(breaker="open")
+                raise
         # trace propagation: the ACTIVE tracer's id rides request metadata
         # (never the payload bytes — the KAD1 wire contract stays trace-free)
         # and the rpc itself is a client-side span on the same timeline;
         # tenant identity rides the same way (wire.TENANT_ID_HEADER)
         tracer = trace.current_tracer()
-        md = []
+        md = list(metadata)
         if tracer is not None:
             md.append((TRACE_ID_HEADER, tracer.trace_id))
         if self.tenant:
@@ -1438,7 +2220,7 @@ class SimulatorClient:
             md.append((SLO_BUDGET_MS_HEADER, str(self.slo_budget_ms)))
 
         def invoke():
-            deadline = _time.monotonic() + self.retry_budget_s
+            deadline = self._clock() + self.retry_budget_s
             delay = 0.05
             for attempt in range(max(self.retry_attempts, 1)):
                 try:
@@ -1450,19 +2232,49 @@ class SimulatorClient:
                         raise QueueFull(None, self._retry_after_ms(e)) from e
                     if (code != grpc.StatusCode.UNAVAILABLE
                             or attempt + 1 >= self.retry_attempts
-                            or _time.monotonic() + delay >= deadline):
+                            or self._clock() + delay >= deadline):
                         raise   # cap hit: degrade, don't hang
-                    _time.sleep(delay)
+                    self._sleep(delay)
                     delay = min(delay * 2, 1.0)
 
-        if tracer is None:
-            return invoke()
-        with tracer.span(f"rpc/{method}", cat="rpc", bytes=len(payload)):
-            return invoke()
+        def attempt():
+            """invoke() + breaker accounting + the retry-after contract:
+            backpressure sleeps the server's hint (full jitter, capped)
+            up to queue_retry_attempts times — neither terminal nor blind."""
+            import grpc as _grpc
 
-    def _call_json(self, method: str, payload: bytes) -> dict:
+            for qa in range(self.queue_retry_attempts + 1):
+                try:
+                    out = invoke()
+                    if self.breaker is not None:
+                        self.breaker.ok()
+                    return out
+                except QueueFull as e:
+                    if self.breaker is not None:
+                        self.breaker.ok()   # the server ANSWERED: alive
+                    if qa >= self.queue_retry_attempts:
+                        raise
+                    hint_ms = max(e.retry_after_ms, 1)
+                    wait_ms = min(hint_ms * (1.0 + self._rng.random()),
+                                  self.queue_retry_cap_ms)
+                    if tracer is not None:
+                        tracer.bump("queue_retries")
+                    self._sleep(wait_ms / 1000.0)
+                except _grpc.RpcError as e:
+                    if self.breaker is not None and e.code() in (
+                            _grpc.StatusCode.UNAVAILABLE,
+                            _grpc.StatusCode.DEADLINE_EXCEEDED):
+                        self.breaker.fail(e)
+                    raise
+
+        if tracer is None:
+            return attempt()
+        with tracer.span(f"rpc/{method}", cat="rpc", bytes=len(payload)):
+            return attempt()
+
+    def _call_json(self, method: str, payload: bytes, metadata=()) -> dict:
         t0 = _time.perf_counter()
-        resp = json.loads(self._call(method, payload))
+        resp = json.loads(self._call(method, payload, metadata=metadata))
         rpc_wall_ms = (_time.perf_counter() - t0) * 1000.0
         # the server reports its child spans back in the response; merge
         # them so ONE trace covers both processes
@@ -1485,8 +2297,16 @@ class SimulatorClient:
                     server_queue_ms=lc.get("phases_ms", {}).get("queue"))
         return resp
 
-    def apply_delta(self, writer: DeltaWriter) -> dict:
-        return self._call_json("ApplyDelta", writer.payload())
+    def apply_delta(self, writer: DeltaWriter,
+                    base_version: int | None = None) -> dict:
+        """`base_version` pins the snapshot version this delta was built
+        against (wire.BASE_VERSION_HEADER): a restarted/rehydrated server
+        holding a different version rejects INVALID_ARGUMENT
+        (section-version-mismatch) — the full-resend signal — instead of
+        applying the delta to the wrong base."""
+        md = (((BASE_VERSION_HEADER, str(int(base_version))),)
+              if base_version is not None else ())
+        return self._call_json("ApplyDelta", writer.payload(), metadata=md)
 
     def scale_up_sim(self, **params) -> dict:
         return self._call_json("ScaleUpSim", json.dumps(params).encode())
@@ -1531,6 +2351,15 @@ def main(argv=None):
     ap.add_argument("--queue-depth", type=int, default=128,
                     help="admission bound; beyond it requests are rejected "
                          "with RESOURCE_EXHAUSTED + retry-after")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="warm-restart state dir: rehydrate per-tenant "
+                         "serving records from here at startup and persist "
+                         "them on graceful shutdown (SIGTERM/SIGINT) — a "
+                         "restarted sidecar serves steady tenants without "
+                         "full world re-sends (docs/ROBUSTNESS.md)")
+    ap.add_argument("--quarantine-ttl-s", type=float, default=30.0,
+                    help="poison-tenant quarantine sentence before "
+                         "auto-parole")
     ap.add_argument("--grpc-cert", default="")
     ap.add_argument("--grpc-key", default="")
     ap.add_argument("--grpc-client-ca", default="")
@@ -1549,7 +2378,26 @@ def main(argv=None):
     service = SimulatorService(batch_lanes=args.batch_lanes,
                                batch_window_ms=args.batch_window_ms,
                                batch_window_max=args.batch_window_max or None,
-                               queue_depth=args.queue_depth)
+                               queue_depth=args.queue_depth,
+                               quarantine_ttl_s=args.quarantine_ttl_s,
+                               rehydrate_dir=args.checkpoint_dir)
+    if args.checkpoint_dir and service.rehydration["restored"]:
+        print(f"katpu-sidecar rehydrated "
+              f"{service.rehydration['restored']} tenants from "
+              f"{args.checkpoint_dir} "
+              f"(digest_mismatch={service.rehydration['digest_mismatch']})",
+              flush=True)
+    # graceful termination checkpoints the tenant table: SIGTERM (the
+    # orchestrated shutdown path) raises into the KeyboardInterrupt branch
+    import signal
+
+    def _term(_sig, _frm):
+        raise KeyboardInterrupt
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:   # pragma: no cover — non-main-thread embedding
+        pass
 
     def bind():
         srv, bound = make_grpc_server(
@@ -1575,6 +2423,10 @@ def main(argv=None):
                       f"{args.host}:{bound}", flush=True)
     except KeyboardInterrupt:
         server.stop(2.0)
+        if args.checkpoint_dir:
+            ck = service.checkpoint(args.checkpoint_dir)
+            print(f"katpu-sidecar checkpointed {ck['tenants']} tenants to "
+                  f"{args.checkpoint_dir}", flush=True)
         service.close()
 
 

@@ -44,6 +44,15 @@ TENANT_ID_HEADER = "katpu-tenant-id"
 # trailing metadata under this key (milliseconds, decimal string).
 RETRY_AFTER_MS_HEADER = "katpu-retry-after-ms"
 
+# Snapshot-version pinning for ApplyDelta (decimal string): a client that
+# tracks its server-side world version stamps the version its delta was
+# built AGAINST here; a mismatch (most importantly: the server restarted
+# and holds version 0 or a rehydrated world) rejects INVALID_ARGUMENT with
+# reason `section-version-mismatch` instead of silently applying a delta to
+# the wrong base snapshot — the client's signal to full-resend
+# (docs/ROBUSTNESS.md, warm restart).
+BASE_VERSION_HEADER = "katpu-base-version"
+
 # Per-tenant SLO budget declaration (milliseconds, decimal string): a client
 # that knows its own loop deadline stamps it here; the server registers it
 # as the tenant's latency budget (sidecar/lifecycle.SloBudgets) and counts

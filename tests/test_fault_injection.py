@@ -1,0 +1,426 @@
+"""Deterministic fault-injection plane (sidecar/faults.py) + the failure
+paths it exists to exercise: harvest failures resolving every ticket,
+scheduler-thread supervision, the client's retry-after honor and circuit
+breaker (fake clocks — no real sleeps on the assertion paths)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubernetes_autoscaler_tpu.sidecar import faults
+from kubernetes_autoscaler_tpu.sidecar.admission import (
+    AdmissionQueue,
+    BatchScheduler,
+    QueueFull,
+    SchedulerDown,
+    Ticket,
+)
+from kubernetes_autoscaler_tpu.sidecar.batch import InFlightBatch, MemberFault
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """The fault plane is a process global: never leak a plan across
+    tests (the zero-overhead contract of every other suite depends on
+    PLAN being None)."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---- plan semantics -------------------------------------------------------
+
+
+def test_spec_after_and_times_are_deterministic():
+    plan = faults.install([{"hook": "dispatch", "after": 2, "times": 2}])
+    fired = []
+    for i in range(6):
+        try:
+            plan.fire("dispatch")
+            fired.append(False)
+        except faults.InjectedFault:
+            fired.append(True)
+    # skips 2, fires exactly 2, then exhausted — pure invocation counting
+    assert fired == [False, False, True, True, False, False]
+
+
+def test_tenant_scoped_spec_counts_only_matching_invocations():
+    plan = faults.install(
+        [{"hook": "dispatch", "tenant": "t1", "after": 1, "times": 1}])
+    # co-tenant traffic does not advance t1's schedule
+    for _ in range(5):
+        plan.fire("dispatch", tenants=["t0", "t2"])
+    plan.fire("dispatch", tenants=["t0", "t1"])     # t1 hit #1 (skipped)
+    with pytest.raises(faults.InjectedFault) as ei:
+        plan.fire("dispatch", tenants=["t1"])       # t1 hit #2 → fires
+    assert ei.value.hook == "dispatch"
+    plan.fire("dispatch", tenants=["t1"])           # times exhausted
+
+
+def test_seeded_probabilistic_specs_replay():
+    def pattern(seed):
+        plan = faults.FaultPlan(
+            [{"hook": "harvest", "prob": 0.5, "times": 0}], seed=seed)
+        out = []
+        for _ in range(32):
+            try:
+                plan.fire("harvest")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        return out
+
+    assert pattern(7) == pattern(7)       # same seed → same schedule
+    assert pattern(7) != pattern(8)       # the seed is load-bearing
+    assert 0 < sum(pattern(7)) < 32
+
+
+def test_truncate_and_nan_corruption_kinds():
+    import numpy as np
+
+    plan = faults.FaultPlan([{"hook": "codec_decode", "kind": "truncate"}])
+    out = plan.fire("codec_decode", payload=b"KAD1" + b"x" * 100)
+    assert len(out) < 104 and out.startswith(b"KAD1")
+
+    plan = faults.FaultPlan([{"hook": "assembly", "kind": "nan"}])
+    arrays = {"f": np.ones(4, np.float32), "i": np.ones(4, np.int32)}
+    out = plan.fire("assembly", payload=arrays)
+    assert np.isnan(out["f"]).all()
+    assert (out["i"] == 1).all()          # ints have no NaN encoding
+
+
+def test_unknown_hook_or_kind_rejected():
+    with pytest.raises(ValueError, match="hook"):
+        faults.FaultSpec(hook="nope")
+    with pytest.raises(ValueError, match="kind"):
+        faults.FaultSpec(hook="dispatch", kind="explode")
+
+
+def test_env_config_round_trip(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, json.dumps(
+        {"seed": 3, "specs": [{"hook": "h2d", "kind": "delay",
+                               "delay_ms": 1, "tenant": "t9"}]}))
+    plan = faults.from_env()
+    assert plan is faults.PLAN
+    assert plan.seed == 3 and plan.specs[0].hook == "h2d"
+    # an installed plan wins over the env (idempotent re-read)
+    assert faults.from_env() is plan
+
+
+def test_fired_faults_are_stamped_on_registry_and_log():
+    from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+
+    reg = Registry(prefix="t")
+    plan = faults.install([{"hook": "dispatch", "tenant": "tx"}],
+                          registry=reg)
+    with pytest.raises(faults.InjectedFault):
+        plan.fire("dispatch", tenants=["tx"])
+    assert reg.counter("faults_injected_total").value(
+        hook="dispatch", kind="raise") == 1
+    assert plan.fired_total() == 1
+    ent = plan.stats()["log_tail"][-1]
+    assert ent["hook"] == "dispatch" and ent["tenant"] == "tx"
+
+
+def test_disabled_plane_is_inert():
+    """The zero-overhead contract's functional half: with no plan installed
+    the guard is a single global identity test and nothing fires anywhere
+    (the ns/op half is measured by bench --chaos and asserted in CI)."""
+    assert faults.PLAN is None
+    # the exact guard expression every hook site uses
+    for _ in range(1000):
+        if faults.PLAN is not None:  # pragma: no cover
+            raise AssertionError("disabled plane fired")
+
+
+# ---- harvest failure path (ISSUE 12 satellite 1) --------------------------
+
+
+class _FailingFetch:
+    def get(self):
+        raise RuntimeError("device fell over mid-fetch")
+
+
+class _OkFetch:
+    def __init__(self, host):
+        self.host = host
+
+    def get(self):
+        return self.host
+
+
+def _ticket(tenant):
+    return Ticket(tenant=tenant, kind="up", key=("up",), lane=None)
+
+
+def test_harvest_exception_fails_every_member_ticket_promptly():
+    """A mid-harvest exception must resolve EVERY member with the error —
+    a pending ticket blocks its client until the gRPC deadline."""
+    tickets = [_ticket(f"t{i}") for i in range(3)]
+    batch = InFlightBatch(tickets, _FailingFetch(), lambda host: [],
+                          {"t0_ns": time.perf_counter_ns()})
+    t0 = time.perf_counter()
+    batch.harvest()
+    assert time.perf_counter() - t0 < 1.0
+    for t in tickets:
+        assert t.done.is_set()
+        with pytest.raises(RuntimeError, match="mid-fetch"):
+            t.wait(0.1)
+
+
+def test_assembly_length_mismatch_fails_instead_of_stranding_tickets():
+    """zip() silently truncates: assembly returning fewer results than
+    members must fail the batch, not strand the surplus tickets."""
+    tickets = [_ticket(f"t{i}") for i in range(3)]
+    batch = InFlightBatch(tickets, _OkFetch({}), lambda host: [{"ok": 1}],
+                          {"t0_ns": time.perf_counter_ns()})
+    batch.harvest()
+    for t in tickets:
+        assert t.done.is_set()
+        with pytest.raises(RuntimeError, match="3 members"):
+            t.wait(0.1)
+
+
+def test_injected_harvest_fault_delegates_to_failure_handler():
+    tickets = [_ticket("a"), _ticket("b")]
+    faults.install([{"hook": "harvest", "times": 1}])
+    seen = []
+    batch = InFlightBatch(
+        tickets, _OkFetch({}), lambda host: [{}, {}],
+        {"t0_ns": time.perf_counter_ns()},
+        on_failure=lambda live, e: seen.append((live, e)))
+    batch.harvest()
+    assert len(seen) == 1
+    live, e = seen[0]
+    assert [t.tenant for t in live] == ["a", "b"]
+    assert isinstance(e, faults.InjectedFault) and e.hook == "harvest"
+
+
+def test_member_fault_in_results_errors_only_that_member():
+    tickets = [_ticket("good"), _ticket("bad")]
+    poisoned = []
+    batch = InFlightBatch(
+        tickets, _OkFetch({}),
+        lambda host: [{"ok": 1}, MemberFault("bad", "poison")],
+        {"t0_ns": time.perf_counter_ns()},
+        on_member_fault=lambda t, e: poisoned.append(t.tenant))
+    batch.harvest()
+    assert tickets[0].wait(0.1) == {"ok": 1}
+    with pytest.raises(MemberFault):
+        tickets[1].wait(0.1)
+    assert poisoned == ["bad"]
+
+
+# ---- scheduler supervision (ISSUE 12 satellite 3) -------------------------
+
+
+def test_scheduler_crash_closes_queue_fails_tickets_and_escalates():
+    faults.install([{"hook": "scheduler_loop", "after": 1, "times": 1}])
+    q = AdmissionQueue(max_depth=8)
+    crashes = []
+    held = _ticket("queued")
+    q.submit(held)
+
+    # a dispatch that never returns results fast enough to drain: the
+    # fault fires on the second loop iteration regardless
+    s = BatchScheduler(q, lambda b: (_ for _ in ()).throw(
+        RuntimeError("unused")), lanes=2, window_s=0.001,
+        idle_wait_s=0.01, on_crash=crashes.append).start()
+    deadline = time.time() + 5
+    while s.alive and time.time() < deadline:
+        time.sleep(0.01)
+    assert not s.alive
+    assert crashes and isinstance(crashes[0], faults.InjectedFault)
+    # every queued ticket failed fast with the supervision error
+    assert held.done.is_set()
+    with pytest.raises(Exception):
+        held.wait(0.1)
+    # the queue is closed: nobody accepts work into an undrained queue
+    with pytest.raises(SchedulerDown):
+        q.submit(_ticket("late"))
+    s.stop()
+
+
+def test_scheduler_crash_mid_window_fails_collected_tickets():
+    """Tickets already COLLECTED into a window (popped from the queue, not
+    yet dispatched) must fail on a crash too — they live in neither the
+    queue nor the pending batch, and stranding them blocks their clients
+    until the gRPC deadline (review finding on the supervision path)."""
+    q = AdmissionQueue(max_depth=8)
+
+    class _Inflight:
+        def __init__(self, tickets):
+            self.tickets = tickets
+
+        def harvest(self):
+            for t in self.tickets:
+                t.resolve(result={"ok": t.tenant})
+
+    def gap_cb(gap_s, cause):
+        # fires on the SECOND dispatch (the first has no previous harvest)
+        # — between collect and dispatch, crashing the loop mid-window
+        raise RuntimeError("gap estimator blew up")
+
+    s = BatchScheduler(q, _Inflight, lanes=2, window_s=0.001,
+                       idle_wait_s=0.01, gap_cb=gap_cb).start()
+    first = _ticket("w1")
+    q.submit(first)
+    assert first.wait(5.0) == {"ok": "w1"}
+    second = _ticket("w2")
+    q.submit(second)
+    with pytest.raises(SchedulerDown):
+        second.wait(5.0)
+    assert not s.alive
+    s.stop()
+
+
+# ---- client retry-after honor + circuit breaker (satellite 2 / tentpole) --
+
+
+class _FakeRpcError(Exception):
+    """Duck-typed grpc.RpcError: code() + trailing_metadata()."""
+
+    def __init__(self, code, retry_after_ms=None):
+        self._code = code
+        self._md = ((("katpu-retry-after-ms", str(retry_after_ms)),)
+                    if retry_after_ms is not None else ())
+
+    def code(self):
+        return self._code
+
+    def trailing_metadata(self):
+        return self._md
+
+
+def _scripted_client(script, clock, sleeps, **kw):
+    """A SimulatorClient whose channel is replaced by a script: each call
+    pops the next behavior (an exception to raise, or bytes to return)."""
+    grpc = pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorClient
+
+    # grpc.RpcError must be the caught type: graft the fake onto it
+    class _Err(_FakeRpcError, grpc.RpcError):
+        pass
+
+    calls = []
+
+    def unary_unary(path, request_serializer=None,
+                    response_deserializer=None):
+        def rpc(payload, timeout=None, metadata=None):
+            calls.append(path.rsplit("/", 1)[-1])
+            step = script.pop(0)
+            if isinstance(step, tuple):
+                raise _Err(*step)
+            return step
+        return rpc
+
+    c = SimulatorClient(0, clock=clock, sleep=sleeps.append, **kw)
+    c.channel.close()
+    import types
+
+    c.channel = types.SimpleNamespace(unary_unary=unary_unary)
+    return c, calls
+
+
+def test_client_honors_retry_after_hint_with_jitter_and_cap():
+    grpc = pytest.importorskip("grpc")
+    RE = grpc.StatusCode.RESOURCE_EXHAUSTED
+    fake = [0.0]
+    sleeps = []
+    script = [(RE, 40), (RE, 40), b'{"ok": 1}']
+    c, calls = _scripted_client(script, lambda: fake[0], sleeps,
+                                queue_retry_attempts=3,
+                                queue_retry_cap_ms=60.0,
+                                breaker_threshold=0)
+    assert json.loads(c._call("ScaleUpSim", b"{}")) == {"ok": 1}
+    # two backpressure sleeps: each ≥ the 40ms hint, jittered up, capped
+    assert len(sleeps) == 2
+    for s in sleeps:
+        assert 0.040 <= s <= 0.060
+    assert sleeps[0] != sleeps[1]   # full jitter, not a fixed multiplier
+
+
+def test_client_surfaces_queuefull_after_retry_budget():
+    grpc = pytest.importorskip("grpc")
+    RE = grpc.StatusCode.RESOURCE_EXHAUSTED
+    sleeps = []
+    script = [(RE, 10)] * 3
+    c, calls = _scripted_client(script, time.monotonic, sleeps,
+                                queue_retry_attempts=2,
+                                breaker_threshold=0)
+    with pytest.raises(QueueFull) as ei:
+        c._call("ScaleUpSim", b"{}")
+    assert ei.value.retry_after_ms == 10
+    assert len(sleeps) == 2 and not script   # 1 + 2 retries, then surfaced
+
+
+def test_breaker_opens_fast_fails_and_half_open_probe_recovers():
+    grpc = pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar.server import CircuitOpen
+
+    UNAVAIL = grpc.StatusCode.UNAVAILABLE
+    fake = [0.0]
+    sleeps = []
+    script = [
+        (UNAVAIL,), (UNAVAIL,),          # two calls → threshold=2 → open
+        b'{"status": "SERVING"}',        # the half-open Health probe
+        b'{"ok": 1}',                    # the real call after recovery
+    ]
+    c, calls = _scripted_client(script, lambda: fake[0], sleeps,
+                                retry_attempts=1, retry_budget_s=0.01,
+                                breaker_threshold=2, breaker_cooldown_s=5.0)
+    for _ in range(2):
+        with pytest.raises(grpc.RpcError):
+            c._call("ScaleUpSim", b"{}")
+    assert c.breaker.state == "open"
+    # open circuit: fast-fail, the wire is NOT touched
+    wire_calls = len(calls)
+    with pytest.raises(CircuitOpen):
+        c._call("ScaleUpSim", b"{}")
+    assert len(calls) == wire_calls
+    # cooldown elapses (fake clock): half-open probes Health, then serves
+    fake[0] += 10.0
+    assert json.loads(c._call("ScaleUpSim", b"{}")) == {"ok": 1}
+    assert calls[-2:] == ["Health", "ScaleUpSim"]
+    assert c.breaker.state == "closed"
+
+
+def test_half_open_probe_failure_reopens():
+    grpc = pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar.server import CircuitOpen
+
+    UNAVAIL = grpc.StatusCode.UNAVAILABLE
+    fake = [0.0]
+    script = [(UNAVAIL,), (UNAVAIL,),      # open
+              (UNAVAIL,),                  # the probe itself fails
+              b'{"status": "NOT_SERVING", "error": "scheduler dead"}']
+    c, calls = _scripted_client(script, lambda: fake[0], [],
+                                retry_attempts=1, retry_budget_s=0.01,
+                                breaker_threshold=2, breaker_cooldown_s=5.0)
+    for _ in range(2):
+        with pytest.raises(grpc.RpcError):
+            c._call("ScaleUpSim", b"{}")
+    fake[0] += 10.0
+    with pytest.raises(CircuitOpen):       # probe UNAVAILABLE → reopen
+        c._call("ScaleUpSim", b"{}")
+    assert c.breaker.state == "open"
+    fake[0] += 10.0
+    with pytest.raises(CircuitOpen):       # probe NOT_SERVING → reopen too
+        c._call("ScaleUpSim", b"{}")
+    assert c.breaker.state == "open"
+    assert calls.count("Health") == 2
+
+
+def test_breaker_metrics_visible_on_default_registry():
+    from kubernetes_autoscaler_tpu.metrics.metrics import default_registry
+    from kubernetes_autoscaler_tpu.sidecar.server import CircuitBreaker
+
+    b = CircuitBreaker(threshold=1, cooldown_s=1.0, target="unit:1")
+    b.fail(RuntimeError("x"))
+    assert default_registry.gauge("sidecar_breaker_state").value(
+        target="unit:1") == 1.0
+    assert default_registry.counter(
+        "sidecar_breaker_transitions_total").value(
+        to="open", target="unit:1") >= 1

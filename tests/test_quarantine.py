@@ -1,0 +1,401 @@
+"""Poison-member isolation end to end (docs/ROBUSTNESS.md): window failure
+→ bounded bisection re-dispatch → quarantine of exactly the offender while
+healthy co-members get results BIT-IDENTICAL to a fault-free run → TTL
+parole → re-admission. Plus the pre-admission validation-reject taxonomy
+pins (nan / negative-request / section-version-mismatch / oversize-world)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_autoscaler_tpu.sidecar import faults, native_api
+from kubernetes_autoscaler_tpu.sidecar.admission import (
+    Quarantined,
+    WorldValidationError,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_api.available(), reason="native codec not buildable"
+)
+
+MIB = 1024 * 1024
+
+NGS = [
+    {"id": "ng-big",
+     "template": {"name": "t", "capacity": {"cpu": 4.0,
+                                            "memory": 8192 * MIB,
+                                            "pods": 110}},
+     "max_new": 10, "price": 1.0},
+    {"id": "ng-small",
+     "template": {"name": "t2", "capacity": {"cpu": 2.0,
+                                             "memory": 4096 * MIB,
+                                             "pods": 110}},
+     "max_new": 10, "price": 0.5},
+]
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def tenant_delta(seed: int, n_nodes: int = 2, n_pods: int = 6):
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    w = DeltaWriter()
+    for i in range(n_nodes):
+        w.upsert_node(build_test_node(
+            f"n{seed}-{i}", cpu_milli=2000 + 1000 * (i % 2), mem_mib=4096))
+    for i in range(n_pods):
+        w.upsert_pod(build_test_pod(
+            f"p{seed}-{i}", cpu_milli=400 + 100 * (seed % 3), mem_mib=256,
+            owner_name=f"rs{seed}"))
+    return w.payload()
+
+
+def make_service(**kw):
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorService
+
+    kw.setdefault("node_bucket", 16)
+    kw.setdefault("group_bucket", 16)
+    return SimulatorService(**kw)
+
+
+def storm(svc, tenants):
+    """One synchronized round of up+down per tenant through the coalescing
+    window; per-tenant results or the raised exception."""
+    from kubernetes_autoscaler_tpu.sidecar.server import SimParams
+
+    res: dict = {}
+    bar = threading.Barrier(len(tenants))
+
+    def worker(t):
+        bar.wait(30)
+        try:
+            res[t] = (
+                svc.scale_up_sim(SimParams(max_new_nodes=16,
+                                           node_groups=NGS), tenant=t),
+                svc.scale_down_sim(SimParams(threshold=0.5), tenant=t))
+        except Exception as e:  # noqa: BLE001
+            res[t] = e
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in tenants]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(120)
+    return res
+
+
+def strip(r):
+    if isinstance(r, Exception):
+        return r
+    up, down = dict(r[0]), dict(r[1])
+    up.pop("lifecycle", None)
+    down.pop("lifecycle", None)
+    return (up, down)
+
+
+@pytest.fixture()
+def batched4():
+    svc = make_service(batch_lanes=4, batch_window_ms=20.0,
+                       quarantine_ttl_s=0.4)
+    tenants = [f"t{i}" for i in range(4)]
+    for i, t in enumerate(tenants):
+        assert svc.apply_delta(tenant_delta(i), tenant=t)["error"] == ""
+    yield svc, tenants
+    svc.close()
+
+
+def test_poison_bisect_quarantine_parole_lifecycle(batched4):
+    """The full sentence: poison → bisect → quarantine (offender only,
+    healthy co-members bit-identical to a no-fault run) → FAILED-
+    PRECONDITION rejects while serving → TTL parole → re-admission with
+    identical results."""
+    svc, tenants = batched4
+    ref = {t: strip(r) for t, r in storm(svc, tenants).items()}
+    assert all(not isinstance(r, Exception) for r in ref.values()), ref
+
+    faults.install([{"hook": "dispatch", "tenant": "t1", "times": 0}],
+                   seed=7, registry=svc.registry)
+    res = {t: strip(r) for t, r in storm(svc, tenants).items()}
+    # the offender: isolated, errored with the injected fault, quarantined
+    assert isinstance(res["t1"], faults.InjectedFault)
+    for t in ("t0", "t2", "t3"):
+        assert res[t] == ref[t], f"healthy member {t} result drifted"
+    qs = svc.quarantine_stats()
+    assert set(qs) == {"t1"}
+    assert qs["t1"]["reason"] == "injected-dispatch"
+    assert svc.registry.counter("tenant_quarantined_total").value(
+        reason="injected-dispatch") >= 1
+    assert svc.registry.counter("window_failures_total").total() >= 1
+    assert svc.registry.counter("window_redispatches_total").total() >= 2
+    assert svc.registry.counter("faults_injected_total").value(
+        hook="dispatch", kind="raise") >= 1
+    # statusz carries the quarantine table
+    sz = svc.statusz()
+    assert "quarantine: 1 tenants" in sz and "injected-dispatch" in sz
+    assert "faults: ACTIVE" in sz
+
+    # while quarantined: FAILED_PRECONDITION-grade rejects with parole hint
+    from kubernetes_autoscaler_tpu.sidecar.server import SimParams
+
+    with pytest.raises(Quarantined) as ei:
+        svc.scale_down_sim(SimParams(threshold=0.5), tenant="t1")
+    assert ei.value.retry_after_ms >= 1
+
+    # TTL parole: after the sentence (and with the chaos gone) t1 is
+    # re-admitted and serves results identical to the no-fault run
+    faults.clear()
+    time.sleep(0.5)
+    r = strip(storm(svc, ["t1"])["t1"])
+    assert r == ref["t1"]
+    assert not svc.quarantine_stats()
+    assert svc.registry.counter("tenant_paroled_total").value(
+        how="ttl") >= 1
+
+
+def test_transient_dispatch_fault_recovers_every_member(batched4):
+    """A one-shot (infra blip) dispatch fault: bisection re-dispatches the
+    halves, everyone gets bit-identical results, NOBODY is quarantined."""
+    svc, tenants = batched4
+    ref = {t: strip(r) for t, r in storm(svc, tenants).items()}
+    faults.install([{"hook": "dispatch", "times": 1}], seed=3,
+                   registry=svc.registry)
+    res = {t: strip(r) for t, r in storm(svc, tenants).items()}
+    for t in tenants:
+        assert res[t] == ref[t], t
+    assert not svc.quarantine_stats()
+    assert svc.registry.counter("window_failures_total").total() >= 1
+
+
+def test_singleton_window_transient_fault_retries_before_conviction():
+    """A lone member's window failing ONCE (transient) must not convict —
+    the singleton gets one re-dispatch before quarantine (review finding:
+    multi-member windows implicitly retry via their halves; a lanes=1 /
+    low-traffic deployment got zero retries)."""
+    from kubernetes_autoscaler_tpu.sidecar.server import SimParams
+
+    svc = make_service(batch_lanes=1, batch_window_ms=1.0)
+    try:
+        assert svc.apply_delta(tenant_delta(0), tenant="solo")["error"] == ""
+        ref = svc.scale_down_sim(SimParams(threshold=0.5), tenant="solo")
+        ref.pop("lifecycle", None)
+        faults.install([{"hook": "dispatch", "times": 1}], seed=2,
+                       registry=svc.registry)
+        out = svc.scale_down_sim(SimParams(threshold=0.5), tenant="solo")
+        out.pop("lifecycle", None)
+        assert out == ref
+        assert not svc.quarantine_stats()
+        # the poison case still convicts: a singleton that fails its
+        # retry too is quarantined
+        faults.install([{"hook": "dispatch", "tenant": "solo",
+                         "times": 0}], seed=2, registry=svc.registry)
+        with pytest.raises(faults.InjectedFault):
+            svc.scale_down_sim(SimParams(threshold=0.5), tenant="solo")
+        assert svc.quarantine_stats()["solo"]["reason"] \
+            == "injected-dispatch"
+    finally:
+        svc.close()
+
+
+def test_persistent_infra_failure_degrades_within_budget(batched4):
+    """Every dispatch failing (a device/infra failure, not a poison
+    member): the bisection budget bounds total re-dispatches and every
+    member gets a prompt per-member error instead of an unbounded retry
+    loop."""
+    svc, tenants = batched4
+    faults.install([{"hook": "dispatch", "times": 0}], seed=5,
+                   registry=svc.registry)
+    t0 = time.perf_counter()
+    res = storm(svc, tenants)
+    assert time.perf_counter() - t0 < 30
+    assert all(isinstance(r, Exception) for r in res.values())
+    # bounded: the budget for a failed window of W members is
+    # 2*bit_length(W)+2 re-dispatches, never a loop
+    redispatches = svc.registry.counter("window_redispatches_total").total()
+    failures = svc.registry.counter("window_failures_total").total()
+    assert redispatches <= failures * (2 * 4 + 2)
+
+
+def test_member_poison_result_quarantines_without_failing_batch(batched4):
+    """A per-member assembly fault (the poisoned-lane path): only that
+    member errors — co-members resolve from the SAME dispatch — and the
+    offender is quarantined with the poison-result reason."""
+    svc, tenants = batched4
+    ref = {t: strip(r) for t, r in storm(svc, tenants).items()}
+    faults.install([{"hook": "assembly", "tenant": "t2", "times": 0}],
+                   seed=11, registry=svc.registry)
+    res = {t: strip(r) for t, r in storm(svc, tenants).items()}
+    from kubernetes_autoscaler_tpu.sidecar.batch import MemberFault
+
+    assert isinstance(res["t2"], MemberFault)
+    for t in ("t0", "t1", "t3"):
+        assert res[t] == ref[t], t
+    assert svc.quarantine_stats()["t2"]["reason"] == "poison-result"
+    # no window failed: this is member-level isolation, not bisection
+    assert svc.registry.counter("window_failures_total").total() == 0
+
+
+def test_apply_delta_paroles_early(batched4):
+    svc, tenants = batched4
+    svc._quarantine_tenant("t3", "injected-dispatch")
+    from kubernetes_autoscaler_tpu.sidecar.server import SimParams
+
+    with pytest.raises(Quarantined):
+        svc.scale_down_sim(SimParams(threshold=0.5), tenant="t3")
+    # a successful world re-send is the early-parole path
+    assert svc.apply_delta(tenant_delta(3), tenant="t3")["error"] == ""
+    assert not svc.quarantine_stats()
+    assert svc.registry.counter("tenant_paroled_total").value(
+        how="new-world") == 1
+    out = svc.scale_down_sim(SimParams(threshold=0.5), tenant="t3")
+    assert "eligible" in out
+
+
+# ---- validation-reject taxonomy pins --------------------------------------
+
+
+def _reject_count(svc, reason):
+    return svc.registry.counter("world_validation_rejects_total").value(
+        reason=reason)
+
+
+def test_validation_nan_threshold_and_capacity():
+    from kubernetes_autoscaler_tpu.sidecar.server import SimParams
+
+    svc = make_service(batch_lanes=2, batch_window_ms=1.0)
+    try:
+        assert svc.apply_delta(tenant_delta(0), tenant="a")["error"] == ""
+        with pytest.raises(WorldValidationError) as ei:
+            svc.scale_down_sim(SimParams(threshold=float("nan")), tenant="a")
+        assert ei.value.reason == "nan"
+        bad_ngs = [{"id": "ng", "template": {
+            "name": "t", "capacity": {"cpu": float("nan"),
+                                      "memory": 1024.0 * MIB}}}]
+        with pytest.raises(WorldValidationError) as ei:
+            svc.scale_up_sim(SimParams(max_new_nodes=8,
+                                       node_groups=bad_ngs), tenant="a")
+        assert ei.value.reason == "nan"
+        assert _reject_count(svc, "nan") == 2
+    finally:
+        svc.close()
+
+
+def test_validation_negative_request_params_and_world():
+    from kubernetes_autoscaler_tpu.sidecar.server import SimParams
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    svc = make_service(batch_lanes=2, batch_window_ms=1.0)
+    try:
+        assert svc.apply_delta(tenant_delta(0), tenant="a")["error"] == ""
+        with pytest.raises(WorldValidationError) as ei:
+            svc.scale_up_sim(SimParams(max_new_nodes=-1, node_groups=NGS),
+                             tenant="a")
+        assert ei.value.reason == "negative-request"
+        # a world whose encoder smuggled a negative request vector: the
+        # codec applies it (the wire is just int32s) but pre-admission
+        # validation keeps it out of every coalescing window
+        w = DeltaWriter()
+        w.upsert_node(build_test_node("n0", cpu_milli=2000, mem_mib=4096))
+        w.upsert_pod(build_test_pod("bad", cpu_milli=-400, mem_mib=128,
+                                    owner_name="rs"))
+        assert svc.apply_delta(w.payload(), tenant="neg")["error"] == ""
+        with pytest.raises(WorldValidationError) as ei:
+            svc.scale_down_sim(SimParams(threshold=0.5), tenant="neg")
+        assert ei.value.reason == "negative-request"
+        assert _reject_count(svc, "negative-request") == 2
+    finally:
+        svc.close()
+
+
+def test_validation_section_version_mismatch():
+    svc = make_service()
+    try:
+        assert svc.apply_delta(tenant_delta(0), tenant="a")["error"] == ""
+        # a delta built against version 5 cannot apply to a version-1 world
+        with pytest.raises(WorldValidationError) as ei:
+            svc.apply_delta(tenant_delta(1), tenant="a", base_version=5)
+        assert ei.value.reason == "section-version-mismatch"
+        # the pinned version is advisory-correct: matching version applies
+        assert svc.apply_delta(tenant_delta(1), tenant="a",
+                               base_version=1)["version"] == 2
+        assert _reject_count(svc, "section-version-mismatch") == 1
+    finally:
+        svc.close()
+
+
+def test_validation_oversize_world():
+    from kubernetes_autoscaler_tpu.sidecar.server import SimParams
+
+    svc = make_service(batch_lanes=2, batch_window_ms=1.0,
+                       max_world=(4, 64, 64))
+    try:
+        assert svc.apply_delta(tenant_delta(0, n_nodes=6),
+                               tenant="big")["error"] == ""
+        with pytest.raises(WorldValidationError) as ei:
+            svc.scale_down_sim(SimParams(threshold=0.5), tenant="big")
+        assert ei.value.reason == "oversize-world"
+        assert _reject_count(svc, "oversize-world") == 1
+    finally:
+        svc.close()
+
+
+def test_status_codes_over_grpc_for_validation_and_quarantine():
+    """The wire mapping: validation rejects ride INVALID_ARGUMENT and a
+    quarantine sentence rides FAILED_PRECONDITION with the parole hint in
+    trailing metadata — structured statuses, not anonymous error strings."""
+    grpc = pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimulatorClient,
+        make_grpc_server,
+    )
+    from kubernetes_autoscaler_tpu.sidecar.wire import RETRY_AFTER_MS_HEADER
+
+    svc = make_service(batch_lanes=2, batch_window_ms=1.0,
+                       quarantine_ttl_s=30.0)
+    server, port = make_grpc_server(svc, port=0)
+    server.start()
+    try:
+        c = SimulatorClient(port, tenant="a")
+        ack = c._call_json("ApplyDelta", tenant_delta(0))
+        assert ack["error"] == ""
+        with pytest.raises(grpc.RpcError) as ei:
+            c.scale_down_sim(threshold=float("nan"))
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        svc._quarantine_tenant("a", "injected-dispatch")
+        with pytest.raises(grpc.RpcError) as ei:
+            c.scale_down_sim(threshold=0.5)
+        assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        md = dict(ei.value.trailing_metadata() or ())
+        assert int(md[RETRY_AFTER_MS_HEADER]) >= 1
+    finally:
+        server.stop(None)
+        svc.close()
+
+
+def test_truncated_payload_counts_into_codec_taxonomy():
+    """A chaos-truncated KAD1 section: the codec rejects it (error dict —
+    the legacy wire contract) AND the validation taxonomy counts it."""
+    svc = make_service()
+    try:
+        faults.install([{"hook": "codec_decode", "kind": "truncate",
+                         "tenant": "a"}], registry=svc.registry)
+        ack = svc.apply_delta(tenant_delta(0), tenant="a")
+        assert ack["error"], ack
+        assert _reject_count(svc, "codec") == 1
+        assert svc.registry.counter("faults_injected_total").value(
+            hook="codec_decode", kind="truncate") == 1
+    finally:
+        svc.close()

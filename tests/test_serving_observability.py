@@ -388,7 +388,10 @@ def test_reject_reason_queue_full_metric_and_event():
                 th.join(60)
         assert len(done) == 2
         rej = svc.registry.counter("admission_rejects_total")
-        assert rej.value(reason="queue-full") == 1
+        # ≥1, not ==1: the client now honors the server's retry-after hint
+        # (ISSUE 12) — the rejected call re-offers itself a few jittered
+        # times before surfacing QueueFull, and each offer counts
+        assert rej.value(reason="queue-full") >= 1
         evs = [ev for ev in svc.events.snapshot()
                if ev["kind"] == "AdmissionReject"]
         assert evs and evs[0]["reason"] == "queue-full"
