@@ -242,6 +242,10 @@ def run_floor_child(metric: str, args) -> int:
         # same contract: the delta-vs-full churn evidence survives a dead
         # tunnel on the CPU floor
         cmd += ["--world-store"]
+    if args.chaos_local:
+        # the control-loop chaos schedule is host-side orchestration — it
+        # degrades WITH the floor instead of vanishing from the evidence
+        cmd += ["--chaos-local"]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     print(f"[bench] degrading to CPU floor metric: {' '.join(cmd[1:])}",
@@ -431,6 +435,19 @@ def main() -> None:
                          "and steady-state jit-cache growth (never-null on "
                          "the CPU floor — the store is host+device "
                          "bookkeeping, backend-independent)")
+    ap.add_argument("--chaos-local", action="store_true",
+                    help="run the LOCAL control loop's seeded chaos "
+                         "schedule (docs/ROBUSTNESS.md 'Control loop'): a "
+                         "hung dispatch aborted at its phase budget with "
+                         "zero driver-thread deaths, a device loss healed "
+                         "by the WorldStore digest probe with decisions "
+                         "bit-identical to a cold encode, scale-down "
+                         "withheld (BackendDegraded surfaced) while "
+                         "degraded and re-enabled after the recovery "
+                         "hysteresis, and a kill/restart resuming the "
+                         "unneeded-since timers — printed as a "
+                         "local_chaos_control_loop JSON line (never-null "
+                         "on the CPU floor)")
     ap.add_argument("--journal", default="", metavar="DIR",
                     help="record a short RunOnce sequence into a "
                          "deterministic flight journal under DIR, measure "
@@ -899,6 +916,19 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
                 "error": f"{type(e).__name__}: {e}",
             }), flush=True)
 
+    if getattr(args, "chaos_local", False):
+        try:
+            with_timeout(lambda: bench_chaos_local(args), seconds=600)()
+        except Exception as e:
+            print(f"[bench] chaos-local phase failed: {type(e).__name__}: "
+                  f"{e}", file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "local_chaos_control_loop", "value": None,
+                "unit": "ms",
+                "error": f"{type(e).__name__}: {e}",
+            }), flush=True)
+
     if args.journal:
         try:
             with_timeout(lambda: bench_journal(args), seconds=600)()
@@ -922,7 +952,8 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
                   file=sys.stderr)
 
     if args.scaledown or args.e2e or args.trace or args.tenants \
-            or args.journal or args.world_store:
+            or args.journal or args.world_store \
+            or getattr(args, "chaos_local", False):
         print(primary_line, flush=True)
 
 
@@ -1976,6 +2007,298 @@ def bench_world_store(args) -> None:
         "modes": store.stats()["modes"],
         "verdicts_identical": identical,
         "steady_state_recompiles": steady_recompiles,
+    }), flush=True)
+
+
+def bench_chaos_local(args) -> None:
+    """--chaos-local (docs/ROBUSTNESS.md "Control loop"): the seeded chaos
+    schedule against the LOCAL control loop — (A) a hung device dispatch is
+    aborted at its phase budget by the backend supervisor's guard and the
+    run_loop driver survives every failed loop (zero driver-thread deaths),
+    (C) while degraded/recovering, scale-down actuation is withheld with
+    BackendDegraded surfaced on the reason plane and re-enables only after
+    the recovery hysteresis, (B) a device loss (every resident buffer
+    deleted) is healed by the WorldStore digest probe — post-rebuild
+    decisions bit-identical to a cold-encode comparator, counted as
+    encoder_encodes_total{mode=full,cause=device_lost} — and (D) a
+    kill/restart rehydrates the crash-consistent restart record so the
+    unneeded-since countdowns resume (no premature deletion, no reset).
+    Host-side orchestration: the numbers exist on the CPU floor."""
+    import tempfile
+    import threading
+
+    from kubernetes_autoscaler_tpu.config.options import (
+        AutoscalingOptions,
+        NodeGroupDefaults,
+    )
+    from kubernetes_autoscaler_tpu.core.loop import LoopTrigger, run_loop
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+    from kubernetes_autoscaler_tpu.sidecar import faults
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    # comfortably above the toy world's warm dispatch (~0.3-0.8s on a CPU
+    # floor / shared CI runner) and far below the injected 30s hang — the
+    # budget must separate slow from hung, not race the scheduler
+    phase_deadline_s = 2.0
+
+    def opts(**kw) -> AutoscalingOptions:
+        base = dict(
+            scale_down_delay_after_add_s=0.0,
+            scale_down_delay_after_failure_s=0.0,
+            node_shape_bucket=16, group_shape_bucket=16,
+            max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+            backend_phase_deadline_s=phase_deadline_s,
+            backend_probe_deadline_s=2.0,
+            backend_suspect_threshold=2,
+            backend_recovery_probes=1,
+            backend_recovery_hysteresis_loops=2,
+            # matures AFTER the two warmup loops (cadence 10 logical s) and
+            # DURING the degraded window, so the withheld loops block a
+            # genuinely due deletion
+            node_group_defaults=NodeGroupDefaults(
+                scale_down_unneeded_time_s=30.0,
+                scale_down_unready_time_s=30.0),
+        )
+        base.update(kw)
+        return AutoscalingOptions(**base)
+
+    def idle_world():
+        fake = FakeCluster()
+        tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+        fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+        fake.add_existing_node("ng1", build_test_node(
+            "busy", cpu_milli=4000, mem_mib=8192))
+        fake.add_existing_node("ng1", build_test_node(
+            "idle", cpu_milli=4000, mem_mib=8192))
+        for i in range(3):
+            fake.add_pod(build_test_pod(
+                f"b{i}", cpu_milli=1000, mem_mib=512,
+                owner_name="rs", node_name="busy"))
+        return fake
+
+    # ---- legs A + C: hung dispatch → degraded within budget; scale-down
+    #      withheld while degraded, re-enabled after the hysteresis ----
+    fake = idle_world()
+    a = StaticAutoscaler(fake.provider, fake, options=opts(),
+                         eviction_sink=fake)
+    trigger = LoopTrigger(scan_interval_s=0.001)
+    base_threads = threading.active_count()
+    now = [1000.0]
+
+    def one_loop():
+        """One driver iteration (run_loop's catch = the survival contract)
+        at a controlled logical clock; returns (status, wall_ms)."""
+        wt = a.walltime
+        a.walltime = lambda: now[0]
+        t0 = time.perf_counter()
+        try:
+            h = run_loop(a, trigger, max_iterations=1,
+                         error_backoff_initial_s=0.0)
+        finally:
+            a.walltime = wt
+        now[0] += 10.0
+        return h[0], (time.perf_counter() - t0) * 1000.0
+
+    # warm the jit caches with the guard relaxed — a cold compile is slow,
+    # not hung; production sets the deadline above compile time, the bench
+    # arms the tight budget only once the world is warm
+    a.supervisor.phase_deadline_s = 60.0
+    s0, _ = one_loop()   # baseline: candidate planned, countdown starts
+    one_loop()
+    assert a.supervisor.state == "healthy", a.supervisor.stats()
+    assert "idle" in a.planner.state.unneeded, s0
+    assert "idle" in fake.nodes, "countdown must outlive the warmup"
+    a.supervisor.phase_deadline_s = phase_deadline_s
+    faults.install([{"hook": "local_dispatch", "kind": "hang",
+                     "delay_ms": 30_000, "times": 2}], seed=20260804,
+                   registry=a.metrics)
+    abort_ms = []
+    try:
+        for _ in range(2):
+            st, wall = one_loop()
+            assert not st.ran and "PhaseDeadlineExceeded" in st.error, st
+            abort_ms.append(wall)
+    finally:
+        faults.clear()
+    degraded_state = a.supervisor.state
+    hang_injected = a.metrics.counter("faults_injected_total").value(
+        hook="local_dispatch", kind="hang")
+
+    withheld_loops = 0
+    deleted_at_state = None
+    reason_surfaced = False
+    for _ in range(5):
+        st, _ = one_loop()
+        if st.scale_down_withheld:
+            withheld_loops += 1
+            reason_surfaced = reason_surfaced or (
+                a.planner.unremovable.reason("idle") == "BackendDegraded"
+                and bool(a.event_sink.find(kind="NoScaleDown", obj="idle",
+                                           reason="BackendDegraded"))
+                and a.metrics.gauge("unremovable_nodes_count").value(
+                    reason="BackendDegraded") >= 1.0)
+        if st.scale_down_deleted:
+            deleted_at_state = st.backend_state
+            break
+    transitions = [f"{t['from']}>{t['to']}" for t in a.supervisor.transitions]
+
+    # ---- leg B: device loss → digest-probe rebuild, decisions
+    #      bit-identical to a cold-encode comparator ----
+    def churn_world():
+        fake = FakeCluster()
+        tmpl = build_test_node("tmpl", cpu_milli=16000, mem_mib=65536,
+                               pods=110, labels={"pool": "a", "disk": "ssd"})
+        fake.add_node_group("ng1", tmpl, min_size=0, max_size=64)
+        for i in range(12):
+            nd = build_test_node(
+                f"n{i}", cpu_milli=16000, mem_mib=65536, pods=110,
+                labels={"pool": "a" if i % 2 else "b",
+                        "disk": "ssd" if i % 3 else "hdd"})
+            fake.add_existing_node("ng1", nd)
+            for j in range(2):
+                fake.add_pod(build_test_pod(
+                    f"r{i}-{j}", cpu_milli=3200, mem_mib=1024,
+                    owner_name=f"rs{i % 5}", node_name=nd.name))
+        for i in range(40):
+            fake.add_pod(build_test_pod(
+                f"p{i}", cpu_milli=500, mem_mib=512,
+                owner_name=f"prs{i % 4}",
+                node_selector={"disk": "ssd"} if i % 4 == 0 else None))
+        return fake
+
+    plan_never = NodeGroupDefaults(scale_down_unneeded_time_s=3600.0,
+                                   scale_down_unready_time_s=3600.0)
+    worlds = [churn_world(), churn_world()]
+    # inline guards (deadline 0) for this leg: its world shapes cold-compile
+    # fresh kernels, and a slow compile under an armed watchdog would read
+    # as a hang — the leg exercises the HEAL path, not the deadline
+    autos = [StaticAutoscaler(
+        w.provider, w, eviction_sink=w,
+        options=opts(incremental_encode=inc, node_group_defaults=plan_never,
+                     backend_phase_deadline_s=0.0))
+        for w, inc in zip(worlds, (True, False))]
+    for x in autos:
+        x.capture_verdicts = True
+
+    def decisions(x, st):
+        verdict = tuple(sorted(
+            (key, int(cnt)) for key, cnt in zip(
+                x.last_verdict_keys or [],
+                x.last_verdict_plane
+                if x.last_verdict_plane is not None else [])
+            if key is not None))
+        return (sorted(st.scale_up.increases.items()) if st.scale_up
+                else None,
+                sorted(st.unneeded_nodes), st.pending_pods, verdict)
+
+    identical = True
+    for loop in range(3):
+        for w in worlds:
+            w.remove_pod(f"p{loop}")
+            w.add_pod(build_test_pod(f"q{loop}", cpu_milli=500, mem_mib=512,
+                                     owner_name=f"prs{loop % 4}"))
+        sts = [x.run_once(now=1000.0 + 10 * loop) for x in autos]
+        identical = identical and (decisions(autos[0], sts[0])
+                                   == decisions(autos[1], sts[1]))
+    store = autos[0]._world_store
+    lost_planes = 0
+    for key, dev in list(store.device_store._dev.items()):
+        if hasattr(dev, "delete"):
+            dev.delete()
+            lost_planes += 1
+    autos[0].supervisor.record_failure("dispatch", "error-XlaRuntimeError")
+    for w in worlds:
+        w.add_pod(build_test_pod("q-loss", cpu_milli=500, mem_mib=512,
+                                 owner_name="prs0"))
+    sts = [x.run_once(now=1100.0) for x in autos]
+    loss_identical = decisions(autos[0], sts[0]) == decisions(autos[1], sts[1])
+    device_loss = {
+        "lost_planes": lost_planes,
+        "heal_outcome": (autos[0].supervisor.last_heal or {}).get("outcome"),
+        "rebuild_cause_counter": autos[0].metrics.counter(
+            "encoder_encodes_total").value(mode="full", cause="device_lost"),
+        "identical_to_cold_encode": bool(identical and loss_identical),
+        "resident_again": None,
+    }
+    for w in worlds:
+        w.add_pod(build_test_pod("q-after", cpu_milli=500, mem_mib=512,
+                                 owner_name="prs1"))
+    sts = [x.run_once(now=1110.0) for x in autos]
+    device_loss["resident_again"] = store.last_mode == "delta"
+    device_loss["identical_to_cold_encode"] = bool(
+        device_loss["identical_to_cold_encode"]
+        and decisions(autos[0], sts[0]) == decisions(autos[1], sts[1]))
+
+    # ---- leg D: crash-kill → restart record resumes the countdowns ----
+    ckdir = tempfile.mkdtemp(prefix="katpu-chaos-local-")
+    rpath = os.path.join(ckdir, "restart_state.json")
+
+    def mk_restart(fk):
+        # inline guards here too: this leg pins restart-timer continuity,
+        # and every "restarted" autoscaler re-runs a cold first loop
+        return StaticAutoscaler(
+            fk.provider, fk, eviction_sink=fk,
+            options=opts(restart_state_path=rpath,
+                         max_bulk_soft_taint_count=0,
+                         backend_phase_deadline_s=0.0,
+                         node_group_defaults=NodeGroupDefaults(
+                             scale_down_unneeded_time_s=60.0,
+                             scale_down_unready_time_s=60.0)))
+
+    fk = idle_world()
+    r1 = mk_restart(fk)
+    r1.run_once(now=1000.0)          # countdown starts at 1000
+    r1.run_once(now=1010.0)
+    del r1                           # the "kill": nothing is flushed beyond
+    r2 = mk_restart(fk)              # the per-loop atomic record
+    early = r2.run_once(now=1030.0)  # < 1000+60: must NOT delete
+    resumed_since = r2.planner.unneeded_nodes.since.get("idle")
+    late = r2.run_once(now=1065.0)   # ≥ 1000+60 but < 1030+60: only correct
+    restart = {                      # if the countdown RESUMED, not reset
+        "rehydrated": r2.metrics.counter("restart_state_total").value(
+            event="rehydrated") == 1,
+        "resumed_since": resumed_since,
+        "premature_deletion": bool(early.scale_down_deleted),
+        "deleted_on_schedule": late.scale_down_deleted == ["idle"],
+    }
+
+    detect_p50 = float(np.percentile(abort_ms, 50)) if abort_ms else None
+    chaos = {
+        "phase_deadline_ms": phase_deadline_s * 1000.0,
+        "hung_dispatch": {
+            "hangs_injected": hang_injected,
+            "abort_ms": [round(x, 1) for x in abort_ms],
+            "degraded_within_budget": bool(
+                abort_ms and max(abort_ms)
+                < phase_deadline_s * 1000.0 * 4 + 500.0),
+            "state_after": degraded_state,
+            # every hung loop came back through run_loop's catch with a
+            # recorded failed status — the driver thread never died
+            "driver_deaths": 2 - len(abort_ms),
+            "abandoned_workers": max(
+                threading.active_count() - base_threads, 0),
+        },
+        "gating": {
+            "withheld_loops": withheld_loops,
+            "reason_surfaced": reason_surfaced,
+            "reenabled_after_hysteresis": deleted_at_state == "healthy",
+            "transitions": transitions,
+        },
+        "device_loss": device_loss,
+        "restart": restart,
+    }
+    print(f"[bench-chaos-local] {json.dumps(chaos)}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "local_chaos_control_loop",
+        "value": round(detect_p50, 2) if detect_p50 is not None else None,
+        "unit": "ms",
+        "backend": ("cpu-floor" if args.smoke or args.floor_for
+                    else __import__("jax").default_backend()),
+        **chaos,
     }), flush=True)
 
 

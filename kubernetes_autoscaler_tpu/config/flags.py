@@ -191,6 +191,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="size bound for the retained journal; older files "
                         "rotate out with drop accounting")
 
+    # backend supervisor / degraded-mode control loop (core/supervisor.py;
+    # no reference analog — the Go autoscaler has no accelerator to lose)
+    p.add_argument("--backend-phase-deadline", type=dur, default=0.0,
+                   help="wall-clock budget per guarded device phase "
+                        "(encode/dispatch/fetch); a hung op aborts the "
+                        "loop and marks the backend suspect (0 = inline "
+                        "guards, no watchdog)")
+    p.add_argument("--backend-probe-deadline", type=dur, default=5.0,
+                   help="deadline for the recovery probe's device round "
+                        "trip")
+    p.add_argument("--backend-suspect-threshold", type=int, default=2,
+                   help="consecutive guarded-phase failures before the "
+                        "suspect state escalates to degraded")
+    p.add_argument("--backend-recovery-probes", type=int, default=2,
+                   help="consecutive probe successes required to leave "
+                        "degraded")
+    p.add_argument("--backend-recovery-hysteresis", type=int, default=2,
+                   help="clean loops in recovering before scale-down "
+                        "re-enables (flap damping)")
+    p.add_argument("--restart-state-path", default="",
+                   help="persist unneeded-since clocks + in-flight "
+                        "scale-ups here each loop and rehydrate on start "
+                        "(crash-consistent restart; empty = off)")
+    p.add_argument("--restart-state-max-age", type=dur, default=1800.0,
+                   help="discard a restart record older than this "
+                        "wholesale (stale countdowns must not cause "
+                        "premature deletions)")
+
     # TPU data plane (no reference analog — Go has no tracing/compile cache)
     p.add_argument("--node-shape-bucket", type=int, default=256)
     p.add_argument("--group-shape-bucket", type=int, default=64)
@@ -325,6 +353,13 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         loop_wallclock_budget_s=args.loop_wallclock_budget,
         journal_dir=args.journal_dir,
         journal_max_mb=args.journal_max_mb,
+        backend_phase_deadline_s=args.backend_phase_deadline,
+        backend_probe_deadline_s=args.backend_probe_deadline,
+        backend_suspect_threshold=args.backend_suspect_threshold,
+        backend_recovery_probes=args.backend_recovery_probes,
+        backend_recovery_hysteresis_loops=args.backend_recovery_hysteresis,
+        restart_state_path=args.restart_state_path,
+        restart_state_max_age_s=args.restart_state_max_age,
     )
 
 

@@ -150,6 +150,27 @@ class AutoscalingOptions:
     journal_dir: str = ""                          # --journal-dir
     # size bound for the RETAINED journal (rotation + drop accounting)
     journal_max_mb: float = 64.0                   # --journal-max-mb
+    # backend supervisor (core/supervisor.py): the control loop's
+    # healthy → suspect → degraded → recovering ladder. 0 keeps the phase
+    # guards inline (no watchdog thread, zero overhead) while exceptions in
+    # guarded phases still drive the ladder; a positive deadline runs
+    # encode/dispatch/fetch on sacrificial workers so a hung device op
+    # aborts the LOOP at its budget instead of wedging the driver forever
+    backend_phase_deadline_s: float = 0.0          # --backend-phase-deadline
+    backend_probe_deadline_s: float = 5.0          # --backend-probe-deadline
+    # consecutive guarded-phase failures before suspect escalates
+    backend_suspect_threshold: int = 2             # --backend-suspect-threshold
+    # consecutive probe successes to leave degraded, then clean loops of
+    # hysteresis before scale-down re-enables (a flapping tunnel must not
+    # thrash full re-encodes)
+    backend_recovery_probes: int = 2               # --backend-recovery-probes
+    backend_recovery_hysteresis_loops: int = 2     # --backend-recovery-hysteresis
+    # crash-consistent restart record (unneeded-since clocks + in-flight
+    # scale-ups keyed to the journal cursor); "" = off
+    restart_state_path: str = ""                   # --restart-state-path
+    # records older than this are discarded wholesale on rehydration —
+    # stale countdown clocks must never cause premature deletions
+    restart_state_max_age_s: float = 1800.0        # --restart-state-max-age
     write_status_configmap: bool = True            # --write-status-configmap
     status_config_map_name: str = "cluster-autoscaler-status"
     max_inactivity_s: float = 10 * 60.0            # --max-inactivity (liveness)

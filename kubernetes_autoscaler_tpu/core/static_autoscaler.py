@@ -72,6 +72,14 @@ class RunOnceStatus:
     scale_down_deleted: list[str] = field(default_factory=list)
     unneeded_nodes: list[str] = field(default_factory=list)
     pending_pods: int = 0
+    # run_loop's catch records a failed loop here instead of dying with it
+    # (reference: loop/run.go RunAutoscalerOnce wrapper)
+    error: str = ""
+    # safe-action gating: scale-down actuation withheld because the backend
+    # supervisor does not trust the simulation (degraded/recovering or an
+    # unverified world) — the would-be victims carry BackendDegraded marks
+    scale_down_withheld: bool = False
+    backend_state: str = ""
 
 
 class StaticAutoscaler:
@@ -180,6 +188,22 @@ class StaticAutoscaler:
         self.event_sink = EventSink(registry=self.metrics)
         self.planner.event_sink = self.event_sink
         self.scale_up_orchestrator.event_sink = self.event_sink
+        # backend supervisor (core/supervisor.py): the healthy → suspect →
+        # degraded → recovering ladder around the device phases. Always
+        # constructed — with the default phase deadline of 0 the guards run
+        # inline (no watchdog threads) but raised phases still drive the
+        # ladder and the safe-action gating below.
+        from kubernetes_autoscaler_tpu.core import supervisor as supervisor_mod
+
+        self._supervisor_mod = supervisor_mod
+        self.supervisor = supervisor_mod.BackendSupervisor(
+            registry=self.metrics, event_sink=self.event_sink,
+            phase_deadline_s=self.options.backend_phase_deadline_s,
+            probe_deadline_s=self.options.backend_probe_deadline_s,
+            suspect_threshold=self.options.backend_suspect_threshold,
+            recovery_probes=self.options.backend_recovery_probes,
+            recovery_hysteresis_loops=(
+                self.options.backend_recovery_hysteresis_loops))
         self._last_unsched_reasons: set[str] = set()
         self._last_unremovable_reasons: set[str] = set()
         # always-on flight recorder: ring of the last N RunOnce traces,
@@ -234,6 +258,9 @@ class StaticAutoscaler:
         # one-time crash recovery on the first loop (reference:
         # cleanUpIfRequired static_autoscaler.go:258 + planner.go:91-93)
         self._startup_recovery_done = False
+        # the rehydrated restart record, kept for provenance (its journal
+        # cursor names the recorded loop the clocks came from)
+        self._restored_restart = None
         # device-resident world state (models/world_store.py wrapping the
         # incremental encoder, models/incremental.py); created lazily so
         # DrainOptions reflect the live flag values. `_encoder` stays the
@@ -351,7 +378,11 @@ class StaticAutoscaler:
 
     def _run_once_inner(self, now: float) -> RunOnceStatus:
         status = RunOnceStatus()
+        status.backend_state = self.supervisor.state
         self.event_sink.begin_loop()
+        # recovery probe when the ladder is off healthy (no-op otherwise);
+        # may advance degraded → recovering or demote suspect → degraded
+        self.supervisor.begin_loop()
         with self.metrics.time_function("main"):
             # finished async deletions first: their bookkeeping (and any
             # failed-node taint rollback) must land before this loop reads
@@ -526,11 +557,25 @@ class StaticAutoscaler:
                             verify_loops=self.options.incremental_verify_loops,
                         )
                         self._encoder = self._world_store.encoder
+                    # post-incident residency audit (WorldStore.heal):
+                    # digest-probe the resident device planes against the
+                    # host mirrors before trusting them again; device loss
+                    # forces the encode below full with cause=device_lost
+                    # instead of simming against stale planes
+                    if self.supervisor.world_stale \
+                            and self.supervisor.state != "degraded":
+                        healed = self._world_store.heal()
+                        self.supervisor.world_healed(
+                            healed["outcome"],
+                            {"lostPlanes": healed["lostPlanes"][:8]})
                     fails_before = self._encoder.verify_failures
-                    enc = self._world_store.encode(
-                        nodes, pods, node_group_ids=node_group_ids,
-                        now=now, pdb_namespaced_names=frozenset(pdb_names),
-                        namespaces=ns_labels)
+                    enc = self.supervisor.guard(
+                        "encode",
+                        lambda: self._world_store.encode(
+                            nodes, pods, node_group_ids=node_group_ids,
+                            now=now,
+                            pdb_namespaced_names=frozenset(pdb_names),
+                            namespaces=ns_labels))
                     if self._world_store.last_mode == "full":
                         # a full re-encode rebuilds device tensors from
                         # scratch — the loop-level recompile-risk event the
@@ -543,15 +588,24 @@ class StaticAutoscaler:
                             "incremental_verify_failures_total").inc(
                             self._encoder.verify_failures - fails_before)
                 else:
-                    enc = encode_cluster(
-                        nodes, pods,
-                        node_group_ids=node_group_ids,
-                        node_bucket=self.options.node_shape_bucket,
-                        group_bucket=self.options.group_shape_bucket,
-                        namespaces=ns_labels,
-                    )
-                    apply_drainability(enc, drain_opts, now=now,
-                                       pdb_namespaced_names=pdb_names)
+                    if self.supervisor.world_stale:
+                        # nothing resident to distrust: every loop here
+                        # re-lowers + re-uploads the whole world anyway
+                        self.supervisor.world_healed("full-encode")
+
+                    def _full_encode():
+                        e = encode_cluster(
+                            nodes, pods,
+                            node_group_ids=node_group_ids,
+                            node_bucket=self.options.node_shape_bucket,
+                            group_bucket=self.options.group_shape_bucket,
+                            namespaces=ns_labels,
+                        )
+                        apply_drainability(e, drain_opts, now=now,
+                                           pdb_namespaced_names=pdb_names)
+                        return e
+
+                    enc = self.supervisor.guard("encode", _full_encode)
                     # counter parity with the store-enabled path: every
                     # loop here is a full re-encode + full re-upload
                     self.metrics.counter(
@@ -595,7 +649,8 @@ class StaticAutoscaler:
 
             # filter-out-schedulable (reference: PodListProcessor.Process :530)
             with self.metrics.time_function("filter_out_schedulable"):
-                packed = snapshot.schedule_pending_on_existing()
+                packed = self.supervisor.guard(
+                    "dispatch", snapshot.schedule_pending_on_existing)
                 snapshot.apply_placement(packed.placed)
             if self.journal is not None or self.capture_verdicts:
                 # the filter-out-schedulable verdict plane, byte-preserved
@@ -617,7 +672,11 @@ class StaticAutoscaler:
                             keys[row] = equivalence_key(
                                 enc.pending_pods[idxs[0]])
                     self.last_verdict_keys = keys
-            remaining = int(np.asarray(snapshot.state.specs.count).sum())
+            # the loop's first device→host sync point: a hung tunnel that
+            # survived the (async) dispatch manifests HERE
+            remaining = self.supervisor.guard(
+                "fetch",
+                lambda: int(np.asarray(snapshot.state.specs.count).sum()))
             if dbg is not None and dbg.is_data_collection_allowed():
                 scheduled_counts = np.asarray(packed.scheduled)
                 fitting = [
@@ -671,8 +730,31 @@ class StaticAutoscaler:
                         self.metrics.counter("scaled_up_gpu_nodes_total").inc(gpu_nodes)
 
             # scale-down (reference: scaleDown :749; delay gating :604)
-            if self.options.scale_down_enabled and not scaled_up \
-                    and self._scale_down_allowed(now):
+            sd_due = (self.options.scale_down_enabled and not scaled_up
+                      and self._scale_down_allowed(now))
+            if sd_due and not self.supervisor.scale_down_safe():
+                # safe-action gating: while the backend is degraded/
+                # recovering or the resident world is unverified, the
+                # simulation cannot be trusted to name deletion victims —
+                # withhold ACTUATION (scale-up above stayed available:
+                # adding capacity on a stale view is recoverable, deleting
+                # is not). The standing unneeded set keeps its clocks (the
+                # `since` stamps are untouched, so recovery resumes the
+                # countdowns, not resets them) and every would-be victim is
+                # marked BackendDegraded on all four reason surfaces
+                # (events / status / registry gauge / snapshotz).
+                status.scale_down_withheld = True
+                status.unneeded_nodes = list(self.planner.state.unneeded)
+                why = (f"scale-down withheld: backend "
+                       f"{self.supervisor.state}"
+                       + (", world unverified"
+                          if self.supervisor.world_stale else ""))
+                for name in status.unneeded_nodes:
+                    self.planner._mark(name, "BackendDegraded", now,
+                                       message=why)
+                self.metrics.gauge("unneeded_nodes_count").set(
+                    len(status.unneeded_nodes))
+            elif sd_due:
                 with self.metrics.time_function("scale_down_update"):
                     self.planner.update(
                         enc, nodes, now,
@@ -810,6 +892,28 @@ class StaticAutoscaler:
             self.metrics.gauge("scale_down_in_cooldown").set(
                 0.0 if self._scale_down_allowed(now) else 1.0)
 
+            # crash-consistent restart record: the unneeded-since clocks +
+            # in-flight scale-ups, keyed to this loop's journal cursor —
+            # one atomic rewrite per loop (reference analog: the soft-taint
+            # WAL, which the per-loop taint budget makes lossy; this record
+            # is exact and also covers scale-ups, which have no taint)
+            if self.options.restart_state_path:
+                try:
+                    self._supervisor_mod.save_restart_state(
+                        self.options.restart_state_path, now=now,
+                        journal_cursor=self._journal_cursor,
+                        unneeded_since=self.planner.unneeded_nodes.since,
+                        scale_up_requests=self.cluster_state.scale_up_requests)
+                except OSError:
+                    self.metrics.counter(
+                        "restart_state_errors_total",
+                        help="Restart-record writes that failed (the "
+                             "previous intact record stays)").inc()
+
+            # a loop that reached here had no guarded-phase incident: it
+            # advances suspect → healthy / the recovering hysteresis count
+            self.supervisor.end_loop()
+            status.backend_state = self.supervisor.state
             self.health.mark_active(now)
             self.event_sink.end_loop()
         return status
@@ -1046,6 +1150,51 @@ class StaticAutoscaler:
             DELETION_CANDIDATE_TAINT,
             TO_BE_DELETED_TAINT,
         )
+
+        # crash-consistent restart record first (core/supervisor.py): exact
+        # unneeded-since clocks + the in-flight scale-ups soft taints never
+        # carried. Records older than --restart-state-max-age are discarded
+        # wholesale (premature-deletion guard), restored clocks apply only
+        # to nodes still present, and the fresh planner re-verifies
+        # unneededness before any deletion — a node that became busy during
+        # the downtime keeps its clock entry but never reaches actuation.
+        # Taint-based recovery below still runs: setdefault semantics let
+        # the exact record win where both exist.
+        if self.options.restart_state_path:
+            import os as _os
+
+            rec = self._supervisor_mod.load_restart_state(
+                self.options.restart_state_path, now=now,
+                max_age_s=self.options.restart_state_max_age_s)
+            rehydrate_help = ("Restart-record rehydrations by outcome "
+                              "(restored / discarded stale-or-corrupt)")
+            if rec is not None:
+                live = {nd.name for nd in nodes}
+                self.planner.unneeded_nodes.load_from_taints({
+                    n: t for n, t in rec["unneededSince"].items()
+                    if n in live and t <= now})
+                from kubernetes_autoscaler_tpu.clusterstate.registry import (
+                    ScaleUpRequest,
+                )
+
+                groups = {g.id() for g in self.provider.node_groups()}
+                for r in rec["scaleUpRequests"]:
+                    gid = str(r.get("group", ""))
+                    if gid in groups \
+                            and gid not in self.cluster_state.scale_up_requests:
+                        self.cluster_state.scale_up_requests[gid] = \
+                            ScaleUpRequest(gid, int(r["increase"]),
+                                           float(r["time"]),
+                                           float(r["expectedAddTime"]))
+                self._restored_restart = rec
+                self.metrics.counter("restart_state_total",
+                                     help=rehydrate_help).inc(
+                    event="rehydrated")
+            elif _os.path.exists(self.options.restart_state_path):
+                self._restored_restart = None
+                self.metrics.counter("restart_state_total",
+                                     help=rehydrate_help).inc(
+                    event="discarded")
 
         ttl = self.options.node_deletion_candidate_ttl_s
         tainted_since: dict[str, float] = {}

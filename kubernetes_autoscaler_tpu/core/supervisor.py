@@ -1,0 +1,427 @@
+"""Backend supervisor: the control loop's survival layer.
+
+Reference counterpart: loop/run.go:32 RunAutoscalerOnce (the health-check +
+recover wrapper around every loop) and clusterstate's health gating — applied
+to the problem the reference never has: the autoscaler's OWN accelerator.
+The simulation kernels run on a device behind a tunnel that can hang at
+dispatch, drop its buffers on a restart, or flap. Before this layer, a hung
+device op wedged RunOnce forever (the exact failure mode that kept five
+bench rounds null before the bench grew `InitBudget`/`with_timeout` — bench
+machinery that production `run_once` never had), and the first raising loop
+killed the driver thread.
+
+The supervisor is a four-state ladder:
+
+    healthy ──phase timeout/error──▶ suspect
+    suspect ──another failure / failed probe──▶ degraded
+    suspect ──clean loop──▶ healthy
+    degraded ──`recovery_probes` consecutive probe successes──▶ recovering
+    recovering ──`recovery_hysteresis_loops` clean loops──▶ healthy
+    recovering ──any failure──▶ degraded
+
+  * **Phase guards** (`guard(phase, fn)`): encode/dispatch/fetch run under a
+    per-phase wall-clock deadline on a sacrificial daemon worker (the same
+    escape hatch bench.py's `with_timeout` uses — a hung device op cannot be
+    interrupted, only abandoned). A deadline hit aborts the LOOP, not the
+    driver: `PhaseDeadlineExceeded` propagates to `run_loop`'s catch and the
+    supervisor records the incident. `phase_deadline_s == 0` (the default)
+    keeps the guard inline — zero threads, zero behavior change — while
+    still converting raised phases into ladder transitions.
+  * **Probe-based recovery with hysteresis**: while not healthy, each loop
+    starts with a tiny device op under its own deadline. Leaving `degraded`
+    takes `recovery_probes` consecutive successes, and `recovering` holds
+    scale-down withheld for `recovery_hysteresis_loops` more clean loops —
+    a flapping tunnel oscillates between degraded and recovering without
+    thrashing full re-encodes (the world heal runs only on the way out).
+  * **Safe-action gating** (`scale_down_safe()`): while degraded/recovering
+    or while the resident world is stale, scale-down actuation is withheld
+    (StaticAutoscaler marks the would-be victims `BackendDegraded` on every
+    reason surface) while conservative scale-up stays available — never
+    delete nodes off a possibly-wrong simulation; adding capacity on a
+    stale view is recoverable, deleting is not.
+  * **Crash-consistent restart** (`save_restart_state`/`load_restart_state`):
+    the planner's unneeded-since clocks and the registry's in-flight
+    scale-ups persist per loop as one atomic JSON record keyed to the
+    flight-journal cursor, and rehydrate on startup — a restart neither
+    resets scale-down countdowns (delayed scale-down) nor inherits stale
+    ones (premature deletion: records older than `max_age_s` are discarded
+    wholesale, and restored clocks only ever apply to nodes the fresh
+    planner still finds unneeded).
+
+Every transition is stamped three ways: the `backend_state` gauge +
+`backend_transitions_total{from,to,cause}` on the registry, a
+`BackendTransition` event on the event sink, and a closed span on the
+active tracer. Chaos evidence rides `bench.py --chaos-local`
+(docs/ROBUSTNESS.md "Control loop").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from kubernetes_autoscaler_tpu.metrics import trace as _trace
+from kubernetes_autoscaler_tpu.sidecar import faults
+
+STATES = ("healthy", "suspect", "degraded", "recovering")
+STATE_IDX = {s: i for i, s in enumerate(STATES)}
+
+GUARDED_PHASES = ("encode", "dispatch", "fetch")
+
+STATE_HELP = ("Backend supervisor ladder state "
+              "(0=healthy 1=suspect 2=degraded 3=recovering)")
+TRANSITIONS_HELP = ("Backend supervisor ladder transitions, by from/to "
+                    "state and cause")
+TIMEOUTS_HELP = "Guarded control-loop phases aborted at their deadline"
+PROBES_HELP = "Backend recovery probes, by outcome"
+
+# hard cap on ABANDONED (deadline-hit, still-wedged) guard/probe workers:
+# during a sustained outage each loop would otherwise leak one daemon
+# thread pinning a stack and an in-flight device op — over a 15h tunnel
+# outage that is thousands of wedged threads and the process dies of the
+# exact failure the supervisor exists to survive. At the cap, guards and
+# probes fail FAST without spawning: the backend is self-evidently hung.
+MAX_ABANDONED_WORKERS = 8
+
+
+class PhaseDeadlineExceeded(RuntimeError):
+    """A guarded phase (encode/dispatch/fetch) outlived its wall-clock
+    deadline: the device op is abandoned on its daemon worker and the loop
+    aborts — the driver thread survives and the supervisor ladder holds the
+    incident."""
+
+    def __init__(self, phase: str, seconds: float):
+        super().__init__(
+            f"{phase} phase exceeded its {seconds:.1f}s deadline "
+            f"(hung device op?) — loop aborted, backend marked suspect")
+        self.phase = phase
+        self.seconds = seconds
+
+
+def _default_probe() -> bool:
+    """One tiny device round trip: dispatch + fetch of an 8-element sum.
+    Exercises the same tunnel the sim kernels ride without touching their
+    jit caches. The `local_probe` fault hook makes probe outcomes part of a
+    seeded chaos schedule."""
+    import jax
+    import jax.numpy as jnp
+
+    if faults.PLAN is not None:
+        faults.PLAN.fire("local_probe")
+    return int(jax.device_get(jnp.arange(8, dtype=jnp.int32).sum())) == 28
+
+
+class BackendSupervisor:
+    """healthy → suspect → degraded → recovering ladder around the control
+    loop's device phases. Owned and driven by the control-loop thread; the
+    only other threads it creates are sacrificial guard/probe workers."""
+
+    def __init__(self, registry=None, event_sink=None,
+                 phase_deadline_s: float = 0.0,
+                 probe_deadline_s: float = 5.0,
+                 suspect_threshold: int = 2,
+                 recovery_probes: int = 2,
+                 recovery_hysteresis_loops: int = 2,
+                 probe=None, clock=time.monotonic):
+        self.registry = registry
+        self.event_sink = event_sink
+        self.phase_deadline_s = phase_deadline_s
+        self.probe_deadline_s = probe_deadline_s
+        self.suspect_threshold = max(int(suspect_threshold), 1)
+        self.recovery_probes = max(int(recovery_probes), 1)
+        self.recovery_hysteresis_loops = max(int(recovery_hysteresis_loops), 0)
+        self._probe = probe or _default_probe
+        self.clock = clock
+
+        self.state = "healthy"
+        self.consecutive_failures = 0
+        self.probe_successes = 0
+        self.clean_loops = 0
+        self.incidents = 0
+        # the resident device world is untrusted after any incident until
+        # StaticAutoscaler digest-probes/heals it (world_healed())
+        self.world_stale = False
+        self.last_incident: dict | None = None
+        self.last_heal: dict | None = None
+        self.transitions: deque = deque(maxlen=64)
+        # deadline-hit workers still wedged on the device op (daemon
+        # threads); reaped as they die, capped by MAX_ABANDONED_WORKERS
+        self._abandoned: list[threading.Thread] = []
+        self._set_gauge()
+
+    def _abandoned_live(self) -> int:
+        self._abandoned = [t for t in self._abandoned if t.is_alive()]
+        return len(self._abandoned)
+
+    # ---- the per-phase guard ------------------------------------------
+
+    def guard(self, phase: str, fn, deadline_s: float | None = None):
+        """Run one device phase under the supervisor's watch. With a
+        positive deadline the op runs on a daemon worker and is abandoned
+        at the deadline (`PhaseDeadlineExceeded`); with deadline 0 it runs
+        inline (zero overhead) but a raise still books the incident. Either
+        way the active tracer is preserved so phase spans keep landing on
+        the loop's timeline."""
+        deadline = (self.phase_deadline_s if deadline_s is None
+                    else deadline_s)
+        hook = f"local_{phase}"
+        if deadline <= 0:
+            try:
+                if faults.PLAN is not None:
+                    faults.PLAN.fire(hook, registry=self.registry)
+                return fn()
+            except Exception as e:
+                self.record_failure(phase, f"error-{type(e).__name__}")
+                raise
+        if self._abandoned_live() >= MAX_ABANDONED_WORKERS:
+            # the wedged-worker population says the backend is hung without
+            # asking it again — fail fast, leak nothing more
+            self.record_failure(phase, "timeout")
+            raise PhaseDeadlineExceeded(phase, deadline)
+        tracer = _trace.current_tracer()
+        result: list = []
+        error: list = []
+
+        def run():
+            # the worker inherits the loop's tracer so nested phase spans
+            # stay on one timeline; on a deadline hit the hung worker keeps
+            # its activation — it is a daemon and its late spans are the
+            # least of a wedged tunnel's problems
+            if tracer is not None:
+                _trace.activate(tracer)
+            try:
+                if faults.PLAN is not None:
+                    faults.PLAN.fire(hook, registry=self.registry)
+                result.append(fn())
+            except Exception as e:  # noqa: BLE001 — forwarded to the loop
+                error.append(e)
+            finally:
+                if tracer is not None:
+                    _trace.activate(None)
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"ka-phase-{phase}")
+        t.start()
+        t.join(timeout=deadline)
+        if t.is_alive():
+            self._abandoned.append(t)
+            if self.registry is not None:
+                self.registry.counter(
+                    "backend_phase_timeouts_total",
+                    help=TIMEOUTS_HELP).inc(phase=phase)
+            self.record_failure(phase, "timeout")
+            raise PhaseDeadlineExceeded(phase, deadline)
+        if error:
+            self.record_failure(phase, f"error-{type(error[0]).__name__}")
+            raise error[0]
+        return result[0]
+
+    # ---- ladder bookkeeping -------------------------------------------
+
+    def record_failure(self, phase: str, cause: str) -> None:
+        """One guarded-phase incident: any in-flight recovery resets and
+        the resident world is untrusted until healed."""
+        self.consecutive_failures += 1
+        self.probe_successes = 0
+        self.clean_loops = 0
+        self.world_stale = True
+        self.incidents += 1
+        self.last_incident = {"phase": phase, "cause": cause,
+                              "at": self.clock()}
+        full_cause = f"{phase}-{cause}"
+        if self.state == "healthy":
+            self._transition("suspect", full_cause)
+        elif self.state == "suspect" \
+                and self.consecutive_failures >= self.suspect_threshold:
+            self._transition("degraded", full_cause)
+        elif self.state == "recovering":
+            self._transition("degraded", full_cause)
+
+    def begin_loop(self) -> None:
+        """Top-of-RunOnce hook: a healthy backend costs one attribute read;
+        any other state runs the recovery probe under its deadline."""
+        if self.state == "healthy":
+            return
+        ok = self.run_probe()
+        if self.state == "suspect":
+            if not ok:
+                self._transition("degraded", "probe-failed")
+        elif self.state == "degraded":
+            if ok:
+                self.probe_successes += 1
+                if self.probe_successes >= self.recovery_probes:
+                    self._transition("recovering", "probe-ok")
+            else:
+                self.probe_successes = 0
+        elif self.state == "recovering":
+            if not ok:
+                self.clean_loops = 0
+                self.probe_successes = 0
+                self._transition("degraded", "probe-failed")
+
+    def end_loop(self) -> None:
+        """A loop that completed without a guarded-phase incident."""
+        self.consecutive_failures = 0
+        if self.state == "suspect":
+            self._transition("healthy", "clean-loop")
+        elif self.state == "recovering":
+            self.clean_loops += 1
+            if self.clean_loops >= self.recovery_hysteresis_loops:
+                self._transition("healthy", "recovered")
+
+    def run_probe(self) -> bool:
+        """The probe under its own daemon-worker deadline; hang == failure.
+        At the abandoned-worker cap no new worker spawns — a backend that
+        wedged that many probes/guards needs no further evidence."""
+        ok = False
+        if self._abandoned_live() < MAX_ABANDONED_WORKERS:
+            result: list = []
+
+            def run():
+                try:
+                    result.append(bool(self._probe()))
+                except Exception:  # noqa: BLE001 — a raising probe is a failed probe
+                    result.append(False)
+
+            t = threading.Thread(target=run, daemon=True, name="ka-probe")
+            t.start()
+            t.join(timeout=max(self.probe_deadline_s, 0.1))
+            if t.is_alive():
+                self._abandoned.append(t)
+            ok = bool(result and result[0])
+        if self.registry is not None:
+            self.registry.counter("backend_probes_total",
+                                  help=PROBES_HELP).inc(
+                outcome="ok" if ok else "failed")
+        return ok
+
+    # ---- safe-action gating -------------------------------------------
+
+    def scale_down_safe(self) -> bool:
+        """Never delete nodes off a possibly-wrong simulation: scale-down
+        actuation is withheld while degraded/recovering (the hysteresis
+        window included) or while the resident world is unverified after an
+        incident. Scale-up is never gated here — adding capacity on a stale
+        view is recoverable."""
+        return self.state not in ("degraded", "recovering") \
+            and not self.world_stale
+
+    def world_healed(self, outcome: str, detail: dict | None = None) -> None:
+        """StaticAutoscaler verified (or rebuilt) the resident world."""
+        self.world_stale = False
+        self.last_heal = {"outcome": outcome, "at": self.clock(),
+                          **(detail or {})}
+
+    # ---- surfaces ------------------------------------------------------
+
+    def _transition(self, to: str, cause: str) -> None:
+        frm, self.state = self.state, to
+        if to != "suspect":
+            # entering suspect keeps the failure streak (it decides
+            # suspect→degraded); every other arrival starts a fresh ledger
+            self.consecutive_failures = 0
+        if to == "recovering":
+            self.clean_loops = 0
+        self.transitions.append(
+            {"from": frm, "to": to, "cause": cause, "at": self.clock()})
+        if self.registry is not None:
+            self.registry.counter(
+                "backend_transitions_total",
+                help=TRANSITIONS_HELP).inc(
+                **{"from": frm, "to": to, "cause": cause})
+        self._set_gauge()
+        if self.event_sink is not None:
+            self.event_sink.emit(
+                "BackendTransition", obj="backend", reason=to,
+                message=f"{frm} -> {to}: {cause}")
+        tr = _trace.current_tracer()
+        if tr is not None:
+            tr.add_span("backend_transition", cat="supervisor",
+                        **{"from": frm, "to": to, "cause": cause})
+
+    def _set_gauge(self) -> None:
+        if self.registry is not None:
+            self.registry.gauge("backend_state", help=STATE_HELP).set(
+                float(STATE_IDX[self.state]))
+
+    def stats(self) -> dict:
+        return {
+            "state": self.state,
+            "abandonedWorkers": self._abandoned_live(),
+            "incidents": self.incidents,
+            "worldStale": self.world_stale,
+            "lastIncident": self.last_incident,
+            "lastHeal": self.last_heal,
+            "transitions": list(self.transitions),
+        }
+
+
+# ---- crash-consistent restart record -----------------------------------
+#
+# One atomic JSON file, rewritten each loop: the scale-down WAL that soft
+# taints cannot fully carry (the per-loop taint budget lags behind the
+# unneeded set) plus the in-flight scale-ups that have NO taint analog at
+# all, keyed to the flight-journal cursor so retained evidence names the
+# exact loop the record describes (docs/REPLAY.md).
+
+RESTART_RECORD_VERSION = 1
+
+
+def save_restart_state(path: str, *, now: float,
+                       journal_cursor: tuple | None,
+                       unneeded_since: dict,
+                       scale_up_requests: dict) -> None:
+    """Persist the restart record atomically (write + fsync + rename — a
+    crash mid-save leaves the previous intact record, never a torn one).
+    `now` is the RunOnce clock domain (wall or logical), and staleness at
+    load time is judged in the same domain."""
+    rec = {
+        "version": RESTART_RECORD_VERSION,
+        "savedAt": float(now),
+        "journalCursor": (list(journal_cursor)
+                          if journal_cursor is not None else None),
+        "unneededSince": {str(k): float(v)
+                          for k, v in unneeded_since.items()},
+        "scaleUpRequests": [
+            {"group": r.group_id, "increase": int(r.increase),
+             "time": float(r.time),
+             "expectedAddTime": float(r.expected_add_time)}
+            for r in scale_up_requests.values()
+        ],
+    }
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(rec, f, separators=(",", ":"))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_restart_state(path: str, *, now: float,
+                       max_age_s: float) -> dict | None:
+    """Load and screen a restart record. Returns None (cold start) when the
+    file is missing, unparseable, from a future clock domain, or older than
+    `max_age_s` — stale countdown clocks from a long-dead predecessor must
+    not cause premature deletions, so an over-age record is discarded
+    WHOLESALE rather than trusted partially."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(rec, dict) \
+            or rec.get("version") != RESTART_RECORD_VERSION:
+        return None
+    saved = rec.get("savedAt")
+    if not isinstance(saved, (int, float)):
+        return None
+    age = now - float(saved)
+    if age < 0 or (max_age_s > 0 and age > max_age_s):
+        return None
+    if not isinstance(rec.get("unneededSince"), dict) \
+            or not isinstance(rec.get("scaleUpRequests"), list):
+        return None
+    return rec

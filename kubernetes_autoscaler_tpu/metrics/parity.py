@@ -174,6 +174,17 @@ UNREMOVABLE_REASONS = {
     "AtomicScaleDownFailed": "AtomicScaleDownFailed",
 }
 
+# Reasons THIS framework produces with no reference analog (the reference
+# has no accelerator to lose). They ride the same four surfaces as the
+# mapped enum values; dashboards filtering on the reference enum simply
+# never match them.
+UNREMOVABLE_REASONS_LOCAL = {
+    "BackendDegraded": "scale-down actuation withheld while the backend "
+                       "supervisor distrusts the simulation (degraded/"
+                       "recovering ladder state or an unverified resident "
+                       "world, core/supervisor.py)",
+}
+
 UNREMOVABLE_REASONS_NA = {
     "NoReason": "the TTL cache stores refusals only; an accepted candidate has no entry",
     "CurrentlyBeingDeleted": "deletion in-flight state lives in the actuator's NodeDeletionTracker (pending_node_deletions gauge), not the unremovable cache",

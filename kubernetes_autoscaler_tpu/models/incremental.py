@@ -278,18 +278,23 @@ class IncrementalEncoder:
         self.last_full_cause: str | None = None
         self.grew_this_loop = False    # any plane crossed its padded bucket
         self._invalidated = False
+        self._invalidate_cause = "fingerprint_miss"
         self._seeded = False
         self._seq = 0
 
     # ------------------------------------------------------------------ API
 
-    def invalidate(self) -> None:
+    def invalidate(self, cause: str = "fingerprint_miss") -> None:
         """Force the next encode() to full-rebuild. The control plane calls
         this when an out-of-band lowering pass (DRA/CSI) mutated the SAME
         Node/Pod objects in place — a change object-identity diffing cannot
-        see (the snapshots' content_key comparison drives this)."""
+        see (the snapshots' content_key comparison drives this) — and the
+        backend supervisor calls it with cause="device_lost" when the
+        digest probe found resident device planes diverged from (or no
+        longer backing) their host mirrors after a backend incident."""
         self._seeded = False
-        self._invalidated = True   # cause label: fingerprint_miss
+        self._invalidated = True
+        self._invalidate_cause = cause
 
     def encode(
         self,
@@ -307,7 +312,7 @@ class IncrementalEncoder:
         if (not self._seeded
                 or (self.resync_loops and self.loops % self.resync_loops == 0)):
             cause = ("initial" if self.full_encodes == 0
-                     else "fingerprint_miss" if self._invalidated
+                     else self._invalidate_cause if self._invalidated
                      else "forced")
             return self._full(nodes, pods, node_group_ids, now,
                               pdb_namespaced_names, cause=cause)

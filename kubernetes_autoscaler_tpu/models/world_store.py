@@ -58,7 +58,8 @@ import numpy as np
 from kubernetes_autoscaler_tpu.utils.canonical import canon_map, digest_strs
 
 MODES = ("delta", "row_refresh", "full")
-CAUSES = ("initial", "fingerprint_miss", "shape_overflow", "forced", "churn")
+CAUSES = ("initial", "fingerprint_miss", "shape_overflow", "forced", "churn",
+          "device_lost")
 
 ENCODES_HELP = ("World encodes by mode (delta = resident planes patched by "
                 "row scatters; row_refresh = ≥1 whole-plane re-upload; "
@@ -201,6 +202,37 @@ class DevicePlaneStore:
         by mirror-aware readers — EncodedCluster.host_mirror_token)."""
         return dict(self._dev)
 
+    def verify_against(self, mirrors: dict) -> list[str]:
+        """Digest-probe the resident device planes against the host
+        mirrors: fetch each device shadow and compare shape/dtype/bytes.
+        Returns the keys that diverged — or, when the fetch itself dies
+        (a device restart freed the buffers), EVERY resident key, because
+        nothing on the device can be trusted. Only runs after a backend
+        incident (WorldStore.heal), never on the hot path."""
+        lost: list[str] = []
+        for key, dev in sorted(self._dev.items()):
+            mirror = mirrors.get(key)
+            if mirror is None:
+                continue
+            try:
+                host = np.asarray(dev)
+                same = (host.shape == mirror.shape
+                        and host.dtype == mirror.dtype
+                        and host.tobytes() == np.ascontiguousarray(
+                            mirror).tobytes())
+            except Exception:  # noqa: BLE001 — dead buffer == lost plane
+                return sorted(self._dev.keys())
+            if not same:
+                lost.append(key)
+        return lost
+
+    def drop_device_state(self) -> None:
+        """Forget every resident device array (device loss): the next full
+        encode reseeds from scratch instead of scattering into corpses."""
+        self._dev.clear()
+        self._dirty.clear()
+        self._dirty_rows.clear()
+
     def stats(self) -> dict:
         return {
             "h2dBytesTotal": self.h2d_bytes_total,
@@ -274,6 +306,30 @@ class WorldStore:
             self.registry.counter("world_store_h2d_bytes_total",
                                   help=H2D_HELP).inc(self.last_h2d_bytes)
         return enc
+
+    # self-healing ---------------------------------------------------------
+
+    def heal(self) -> dict:
+        """Post-incident residency audit (docs/ROBUSTNESS.md "Control
+        loop"): digest-probe every resident device plane against its host
+        mirror. Intact planes keep their residency (the incident was a
+        hang, not a loss); any divergence or a dead buffer means the
+        device restarted underneath us — drop the device state and force
+        the next encode full with cause="device_lost", so the loop sims
+        against a cold re-lowered world instead of stale planes. Decisions
+        after the rebuild are bit-identical to a cold encode (pinned by
+        tests/test_supervisor.py)."""
+        e = self.encoder
+        if not getattr(e, "_seeded", False):
+            # nothing resident (pre-first-encode, or already invalidated):
+            # the next encode is full anyway
+            return {"outcome": "not-resident", "lostPlanes": []}
+        lost = e.device_store.verify_against(e._m)
+        if not lost:
+            return {"outcome": "intact", "lostPlanes": []}
+        e.device_store.drop_device_state()
+        e.invalidate(cause="device_lost")
+        return {"outcome": "rebuilt", "lostPlanes": lost}
 
     # fingerprints ---------------------------------------------------------
 

@@ -43,6 +43,14 @@ from kubernetes_autoscaler_tpu.ops.bitplane import (
     pack_flat_bits,
     unpack_flat_bits_np,
 )
+from kubernetes_autoscaler_tpu.sidecar import faults as _faults
+
+# Chaos plane (sidecar/faults.py, grown to the local path for the control
+# loop's survival layer — docs/ROBUSTNESS.md "Control loop"): every
+# synchronous fetch and async harvest passes the `local_fetch` hook, so a
+# seeded hang/delay/raise exercises the REAL device→host transfer point the
+# supervisor's fetch guard watches. The `if _faults.PLAN is not None`
+# global-load guard is the zero-overhead-when-disabled contract.
 
 _SUPPORTED = ("bool", "int8", "int16", "int32", "uint8", "uint16",
               "float32")
@@ -120,6 +128,8 @@ def fetch_pytree(tree, phases=None):
     """Return the same pytree with every leaf as a host numpy array of the
     ORIGINAL shape and dtype, using at most three device→host transfers
     (bool leaves ride bit-packed). `phases` enables byte accounting."""
+    if _faults.PLAN is not None:
+        _faults.PLAN.fire("local_fetch")
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if len(leaves) <= 1:
         # one leaf is one transfer either way — skip the pack program (and
@@ -165,6 +175,8 @@ class AsyncFetch:
         caller did since issue) and rebuild the original pytree."""
         if self._done:
             return self._result
+        if _faults.PLAN is not None:
+            _faults.PLAN.fire("local_fetch")
         b, i, f = jax.device_get(self._bufs)
         self._result = _unflatten(self._leaves, self._treedef, b, i, f)
         self._done = True

@@ -14,6 +14,15 @@ every pipeline hand-off of the sidecar —
   grpc_reply       the response leaving the gRPC handler
   scheduler_loop   the BatchScheduler's serve loop (thread-death chaos)
 
+— and, since the control loop grew its own survival layer
+(core/supervisor.py, docs/ROBUSTNESS.md "Control loop"), at the LOCAL
+guarded phases of StaticAutoscaler.run_once:
+
+  local_encode     the world encode / delta program building
+  local_dispatch   the filter-out-schedulable + sim dispatch
+  local_fetch      the device→host verdict fetch
+  local_probe      the supervisor's recovery probe
+
 Specs fire on deterministic match-hit counters (`after` skips the first N
 matching invocations, `times` caps total fires; a tenant-scoped spec counts
 only that tenant's invocations, so its schedule is independent of co-tenant
@@ -51,7 +60,9 @@ from collections import deque
 from dataclasses import dataclass
 
 HOOKS = ("codec_decode", "classify", "stack", "h2d", "dispatch",
-         "harvest", "assembly", "grpc_reply", "scheduler_loop")
+         "harvest", "assembly", "grpc_reply", "scheduler_loop",
+         # the local control loop's guarded phases (core/supervisor.py)
+         "local_encode", "local_dispatch", "local_fetch", "local_probe")
 
 # raise: typed InjectedFault; delay/hang: sleep delay_ms (hang is the same
 # mechanism with an alarming name — a bounded stall, so tests can assert

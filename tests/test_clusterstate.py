@@ -1,0 +1,196 @@
+"""Dedicated ClusterStateRegistry coverage (clusterstate/registry.py):
+the scale-up-timeout → failed-scale-up → backoff → recovery path, plus the
+ExponentialBackoff amortized sweep's growth bound (utils/backoff.py).
+
+Reference counterpart: clusterstate/clusterstate_test.go (the
+RegisterOrUpdateScaleUp / updateScaleRequests / backoff suites).
+"""
+
+from kubernetes_autoscaler_tpu.clusterstate.registry import (
+    ClusterStateRegistry,
+    ScaleUpRequest,
+)
+from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
+from kubernetes_autoscaler_tpu.utils.backoff import ExponentialBackoff
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import build_test_node
+
+
+def mk_registry(provision_s: float = 100.0, provision_delay_s: float = 0.0):
+    fake = FakeCluster(provision_delay_s=provision_delay_s)
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    opts = AutoscalingOptions()
+    opts.node_group_defaults.max_node_provision_time_s = provision_s
+    return fake, ClusterStateRegistry(fake.provider, opts)
+
+
+def group(fake, gid="ng1"):
+    return next(g for g in fake.provider.node_groups() if g.id() == gid)
+
+
+def nodes_of(fake):
+    return fake.list_nodes()
+
+
+# ---------------------------------------------------------------- requests
+
+
+def test_register_scale_up_tracks_request_and_updates_on_repeat():
+    fake, reg = mk_registry()
+    g = group(fake)
+    reg.register_scale_up(g, 2, now=100.0)
+    req = reg.scale_up_requests["ng1"]
+    assert (req.increase, req.time, req.expected_add_time) == (2, 100.0, 200.0)
+    # a second burst merges and re-arms the provision clock
+    reg.register_scale_up(g, 3, now=150.0)
+    req = reg.scale_up_requests["ng1"]
+    assert req.increase == 5 and req.expected_add_time == 250.0
+    assert reg.last_scale_up_time == 150.0
+
+
+def test_scale_up_fulfilled_clears_request_and_backoff():
+    fake, reg = mk_registry()
+    g = group(fake)
+    g.increase_size(2)                    # materializes 2 ready nodes
+    reg.register_scale_up(g, 2, now=100.0)
+    reg.backoff.backoff("ng1", 90.0)      # pre-existing backoff must clear
+    reg.update_nodes(nodes_of(fake), now=110.0)
+    assert "ng1" not in reg.scale_up_requests
+    assert not reg.backoff.is_backed_off("ng1", 110.0)
+    assert reg.is_node_group_safe_to_scale_up(g, 110.0)
+
+
+def test_scale_up_timeout_fails_and_backs_off_then_recovers():
+    """The full ladder: request → provision timeout → failed-scale-up +
+    exponential backoff (group stops winning scale-ups) → backoff expiry →
+    the group is safe again."""
+    # nodes never materialize before the provision deadline
+    fake, reg = mk_registry(provision_s=100.0, provision_delay_s=10_000.0)
+    g = group(fake)
+    g.increase_size(2)                    # target 2, nothing registers
+    reg.register_scale_up(g, 2, now=100.0)
+    reg.update_nodes(nodes_of(fake), now=150.0)
+    assert "ng1" in reg.scale_up_requests, "not expired yet"
+    assert reg.is_node_group_safe_to_scale_up(g, 150.0)
+
+    reg.update_nodes(nodes_of(fake), now=201.0)   # past expected_add_time
+    assert "ng1" not in reg.scale_up_requests
+    assert reg.failed_scale_ups["ng1"] == 201.0
+    assert reg.backoff.is_backed_off("ng1", 201.0)
+    assert not reg.is_node_group_safe_to_scale_up(g, 201.0), \
+        "a timed-out group must stop winning scale-ups"
+
+    # backoff expiry (default initial 300s): safe again
+    until = 201.0 + reg.backoff.initial_s
+    assert not reg.is_node_group_safe_to_scale_up(g, until - 1.0)
+    assert reg.is_node_group_safe_to_scale_up(g, until + 1.0)
+
+
+def test_repeat_failures_double_backoff_up_to_cap_and_reset_after_quiet():
+    fake, reg = mk_registry()
+    g = group(fake)
+    b = reg.backoff
+    now = 1000.0
+    prev = 0.0
+    for k in range(10):
+        until = b.backoff("ng1", now)
+        dur = until - now
+        assert dur <= b.max_s
+        if k and prev < b.max_s:
+            assert dur == min(prev * 2, b.max_s), "ladder must double"
+        prev = dur
+        now = until + 1.0
+    assert prev == b.max_s
+    # quiet past the reset window starts the ladder fresh
+    now += b.reset_timeout_s + 1.0
+    assert b.backoff("ng1", now) - now == b.initial_s
+
+
+def test_failed_scale_up_via_registry_counts_and_backs_off():
+    fake, reg = mk_registry()
+    g = group(fake)
+    reg.register_scale_up(g, 1, now=100.0)
+    reg.register_failed_scale_up(g, now=120.0)
+    assert "ng1" not in reg.scale_up_requests
+    assert reg.backoff.is_backed_off("ng1", 121.0)
+
+
+def test_unregistered_nodes_tracked_and_upcoming_counted():
+    fake, reg = mk_registry(provision_delay_s=10_000.0)
+    g = group(fake)
+    g.increase_size(3)
+    reg.update_nodes(nodes_of(fake), now=100.0)
+    assert len(reg.unregistered) == 0, \
+        "a delayed provider reports no instances yet"
+    assert reg.upcoming_nodes() == {"ng1": 3}
+
+
+def test_acceptable_range_and_incorrect_size():
+    fake, reg = mk_registry()
+    g = group(fake)
+    g.increase_size(2)
+    reg.register_scale_up(g, 2, now=100.0)
+    reg.update_nodes(nodes_of(fake), now=110.0)
+    # 2 ready = target: fulfilled, range is exactly [target, target]
+    rng = reg.acceptable_ranges["ng1"]
+    assert rng.min_nodes <= 2 <= rng.max_nodes
+    assert not reg.has_incorrect_size("ng1")
+
+
+# ------------------------------------------------- backoff growth bound
+
+
+def test_backoff_dict_growth_bounded_under_group_churn():
+    """Satellite pin (ISSUE 13): ExponentialBackoff never pruned expired
+    entries — autoprovisioned node groups mint fresh ids forever, so long
+    runs grew the dict without bound. The amortized sweep keeps the
+    population bounded by the groups still inside their backoff/reset
+    windows."""
+    b = ExponentialBackoff(initial_s=10.0, max_s=20.0, reset_timeout_s=60.0)
+    now = 0.0
+    peak = 0
+    for round_ in range(200):
+        for i in range(50):
+            b.backoff(f"ng-{round_}-{i}", now)
+        peak = max(peak, len(b._entries))
+        now += 120.0     # every earlier round is past backoff AND reset
+    assert peak < 500, f"peak {peak}: sweep never engaged"
+    b.sweep(now)
+    assert len(b._entries) == 0 or all(
+        now < e.backoff_until or now - e.last_failure < b.reset_timeout_s
+        for e in b._entries.values())
+    # 10k distinct ids were seen; the dict must not remember them all
+    assert len(b._entries) <= 100
+
+
+def test_backoff_sweep_never_drops_live_entries():
+    b = ExponentialBackoff(initial_s=100.0, max_s=200.0, reset_timeout_s=300.0)
+    b.backoff("live", 1000.0)
+    # flood with garbage that expires immediately relative to the sweep time
+    for i in range(200):
+        b.backoff(f"g{i}", 0.0)
+    b.sweep(1050.0)
+    assert b.is_backed_off("live", 1050.0), "sweep must keep live entries"
+    # an entry past backoff but inside the reset window must survive too:
+    # the NEXT failure's duration doubles off its history
+    b2 = ExponentialBackoff(initial_s=10.0, max_s=80.0, reset_timeout_s=1000.0)
+    b2.backoff("laddered", 0.0)
+    b2.sweep(500.0)                       # backoff over, reset window not
+    assert "laddered" in b2._entries
+    assert b2.backoff("laddered", 500.0) - 500.0 == 20.0, "ladder preserved"
+
+
+def test_restart_rehydrated_request_times_out_like_native():
+    """The crash-consistent restart record (core/supervisor.py) re-creates
+    ScaleUpRequests verbatim; the registry must expire a rehydrated request
+    exactly like one it minted itself."""
+    fake, reg = mk_registry(provision_s=100.0, provision_delay_s=10_000.0)
+    g = group(fake)
+    g.increase_size(1)
+    reg.scale_up_requests["ng1"] = ScaleUpRequest("ng1", 1, 50.0, 150.0)
+    reg.update_nodes(nodes_of(fake), now=100.0)
+    assert "ng1" in reg.scale_up_requests
+    reg.update_nodes(nodes_of(fake), now=151.0)
+    assert "ng1" not in reg.scale_up_requests
+    assert reg.backoff.is_backed_off("ng1", 151.0)

@@ -1,0 +1,616 @@
+"""Degraded-mode control loop (core/supervisor.py, docs/ROBUSTNESS.md
+"Control loop"): the backend supervisor ladder, phase-deadline guards, loop
+survival, safe-action gating, WorldStore device-loss self-healing, and the
+crash-consistent restart record.
+"""
+
+import json
+import time
+
+import pytest
+
+from kubernetes_autoscaler_tpu.core.loop import LoopTrigger, run_loop
+from kubernetes_autoscaler_tpu.core.supervisor import (
+    BackendSupervisor,
+    PhaseDeadlineExceeded,
+    load_restart_state,
+    save_restart_state,
+)
+from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+from kubernetes_autoscaler_tpu.sidecar import faults
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import (
+    build_test_node,
+    build_test_pod,
+)
+
+from test_runonce import autoscaler_for, make_options
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def sup(**kw):
+    kw.setdefault("registry", Registry())
+    kw.setdefault("probe", lambda: True)
+    return BackendSupervisor(**kw)
+
+
+# ---------------------------------------------------------------- ladder
+
+
+def test_guard_inline_passthrough_and_error_books_incident():
+    s = sup()
+    assert s.guard("encode", lambda: 42) == 42
+    assert s.state == "healthy"
+    with pytest.raises(ValueError):
+        s.guard("encode", lambda: (_ for _ in ()).throw(ValueError("boom")))
+    assert s.state == "suspect"
+    assert s.world_stale
+    assert s.last_incident["phase"] == "encode"
+    assert s.registry.counter("backend_transitions_total").value(
+        **{"from": "healthy", "to": "suspect",
+           "cause": "encode-error-ValueError"}) == 1
+
+
+def test_guard_deadline_aborts_hung_phase_within_budget():
+    s = sup(phase_deadline_s=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(PhaseDeadlineExceeded) as ei:
+        s.guard("dispatch", lambda: time.sleep(10))
+    wall = time.monotonic() - t0
+    assert wall < 2.0, f"deadline abort took {wall:.1f}s"
+    assert ei.value.phase == "dispatch"
+    assert s.state == "suspect"
+    assert s.registry.counter("backend_phase_timeouts_total").value(
+        phase="dispatch") == 1
+    assert s.registry.gauge("backend_state").value() == 1.0
+
+
+def test_abandoned_worker_cap_fails_fast_without_spawning():
+    """A sustained hang must not leak one wedged daemon thread per loop:
+    at MAX_ABANDONED_WORKERS the guard (and the probe) fail fast with no
+    new worker — the wedged population IS the evidence."""
+    import threading
+
+    from kubernetes_autoscaler_tpu.core import supervisor as sup_mod
+
+    release = threading.Event()
+    s = sup(phase_deadline_s=0.05)
+    for _ in range(sup_mod.MAX_ABANDONED_WORKERS):
+        with pytest.raises(PhaseDeadlineExceeded):
+            s.guard("dispatch", release.wait)
+    assert s._abandoned_live() == sup_mod.MAX_ABANDONED_WORKERS
+    before = threading.active_count()
+    with pytest.raises(PhaseDeadlineExceeded):    # fast-fail, no spawn
+        s.guard("dispatch", release.wait)
+    assert threading.active_count() == before
+    s._probe = lambda: release.wait()             # capped probe: no spawn
+    assert s.run_probe() is False
+    assert threading.active_count() == before
+    release.set()                                 # workers drain...
+    for t in list(s._abandoned):
+        t.join(timeout=5.0)
+    assert s._abandoned_live() == 0               # ...and are reaped
+    assert s.guard("dispatch", lambda: 7) == 7    # guards run again
+
+
+def test_ladder_full_cycle_with_hysteresis():
+    s = sup(suspect_threshold=2, recovery_probes=2,
+            recovery_hysteresis_loops=2)
+    probe_ok = [False]
+    s._probe = lambda: probe_ok[0]
+    # healthy → suspect → degraded on the failure streak
+    s.record_failure("dispatch", "timeout")
+    assert s.state == "suspect" and not s.scale_down_safe()  # world stale
+    s.record_failure("dispatch", "timeout")
+    assert s.state == "degraded"
+    # failed probes keep it degraded; successes must be CONSECUTIVE
+    s.begin_loop()
+    assert s.state == "degraded"
+    probe_ok[0] = True
+    s.begin_loop()
+    probe_ok[0] = False
+    s.begin_loop()          # flap: streak resets
+    probe_ok[0] = True
+    s.begin_loop()
+    assert s.state == "degraded"
+    s.begin_loop()          # second consecutive success
+    assert s.state == "recovering"
+    assert not s.scale_down_safe()          # hysteresis holds the gate
+    s.world_healed("intact")
+    s.end_loop()
+    assert s.state == "recovering" and not s.scale_down_safe()
+    s.end_loop()
+    assert s.state == "healthy" and s.scale_down_safe()
+    tr = [f"{t['from']}>{t['to']}" for t in s.transitions]
+    assert tr == ["healthy>suspect", "suspect>degraded",
+                  "degraded>recovering", "recovering>healthy"]
+
+
+def test_recovering_demotes_on_new_failure():
+    s = sup(suspect_threshold=1, recovery_probes=1)
+    # the first failure always lands on suspect (the ladder has no
+    # healthy→degraded shortcut); the next one degrades at threshold 1
+    s.record_failure("fetch", "timeout")
+    assert s.state == "suspect"
+    s.record_failure("fetch", "timeout")
+    assert s.state == "degraded"
+    s.begin_loop()                   # probe ok → recovering
+    assert s.state == "recovering"
+    s.record_failure("dispatch", "error-RuntimeError")
+    assert s.state == "degraded"
+
+
+def test_suspect_clears_on_clean_loop():
+    s = sup()
+    s.record_failure("encode", "error-ValueError")
+    s.world_healed("intact")
+    s.end_loop()
+    assert s.state == "healthy"
+    assert s.scale_down_safe()
+
+
+# ------------------------------------------------- loop driver survival
+
+
+class _FlakySource:
+    """ClusterDataSource that raises on chosen loop indices."""
+
+    def __init__(self, inner, fail_on=frozenset()):
+        self.inner = inner
+        self.fail_on = set(fail_on)
+        self.calls = 0
+
+    def list_nodes(self):
+        n = self.calls
+        self.calls += 1
+        if n in self.fail_on:
+            raise RuntimeError(f"injected source failure #{n}")
+        return self.inner.list_nodes()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def test_run_loop_raises_then_recovers():
+    """Satellite pin: a raising run_once() records a failed RunOnceStatus
+    and the driver retries after backoff instead of dying (reference:
+    loop/run.go wrapper)."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node(
+        "n1", cpu_milli=4000, mem_mib=8192))
+    fake.add_pod(build_test_pod("p0", cpu_milli=500, mem_mib=256,
+                                owner_name="rs"))
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+
+    src = _FlakySource(fake, fail_on={1})
+    a = StaticAutoscaler(fake.provider, src, options=make_options(),
+                         eviction_sink=fake)
+    history = run_loop(a, LoopTrigger(scan_interval_s=0.01),
+                       max_iterations=3, error_backoff_initial_s=0.01)
+    assert len(history) == 3, "the driver must survive the raising loop"
+    assert history[0].ran and history[0].error == ""
+    assert not history[1].ran
+    assert "RuntimeError" in history[1].error
+    assert history[2].ran and history[2].pending_pods == 0
+    assert a.metrics.counter("errors_total").value(type="RuntimeError") == 1
+
+
+def test_hung_dispatch_degrades_not_kills(tmp_path):
+    """A hung device dispatch aborts at the phase deadline, the supervisor
+    books the incident, and the NEXT loop runs clean — zero driver-thread
+    deaths (the acceptance shape of bench.py --chaos-local leg A)."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node(
+        "n1", cpu_milli=4000, mem_mib=8192))
+    fake.add_pod(build_test_pod("p0", cpu_milli=500, mem_mib=256,
+                                owner_name="rs"))
+    a = autoscaler_for(fake, backend_probe_deadline_s=5.0)
+    a.run_once(now=999.0)       # warm the jit caches (cold compile is slow,
+    a.supervisor.phase_deadline_s = 2.0     # not hung) before arming
+    faults.install([{"hook": "local_dispatch", "kind": "hang",
+                     "delay_ms": 30_000, "times": 1}], seed=7,
+                   registry=a.metrics)
+    t0 = time.monotonic()
+    history = run_loop(a, LoopTrigger(scan_interval_s=0.01),
+                       max_iterations=2, error_backoff_initial_s=0.01)
+    assert time.monotonic() - t0 < 15.0, "abort must ride the phase budget"
+    assert not history[0].ran and "PhaseDeadlineExceeded" in history[0].error
+    assert history[1].ran, "the loop after the hang must complete"
+    assert a.supervisor.state == "healthy"      # suspect cleared by clean loop
+    assert a.metrics.counter("backend_phase_timeouts_total").value(
+        phase="dispatch") == 1
+    assert a.metrics.counter("faults_injected_total").value(
+        hook="local_dispatch", kind="hang") == 1
+
+
+def test_hostfetch_fires_local_fetch_hook():
+    """The fault plane reaches the REAL device→host transfer points: both
+    the synchronous fetch_pytree and an AsyncFetch harvest pass the
+    local_fetch hook (zero-overhead global-load guard when no plan is
+    installed)."""
+    import jax.numpy as jnp
+
+    from kubernetes_autoscaler_tpu.ops import hostfetch
+
+    tree = {"a": jnp.arange(4), "b": jnp.ones((3,), bool)}
+    reg = Registry()
+    faults.install([{"hook": "local_fetch", "times": 2}], seed=5,
+                   registry=reg)
+    with pytest.raises(faults.InjectedFault):
+        hostfetch.fetch_pytree(tree)
+    handle = hostfetch.fetch_pytree_async(tree)   # issue is hook-free
+    with pytest.raises(faults.InjectedFault):
+        handle.get()                              # the harvest is guarded
+    assert reg.counter("faults_injected_total").value(
+        hook="local_fetch", kind="raise") == 2
+    faults.clear()
+    out = hostfetch.fetch_pytree(tree)            # disabled plane: clean
+    assert out["a"].tolist() == [0, 1, 2, 3]
+
+
+def test_local_fault_hooks_fire_inside_guards():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node(
+        "n1", cpu_milli=4000, mem_mib=8192))
+    a = autoscaler_for(fake)
+    faults.install([{"hook": "local_encode", "times": 1}], seed=3,
+                   registry=a.metrics)
+    with pytest.raises(faults.InjectedFault):
+        a.run_once(now=1000.0)
+    assert a.supervisor.state == "suspect"
+    assert a.supervisor.last_incident["cause"] == "error-InjectedFault"
+    st = a.run_once(now=1001.0)
+    assert st.ran and a.supervisor.state == "healthy"
+
+
+# ------------------------------------------------------ safe-action gating
+
+
+def _idle_world():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node(
+        "busy", cpu_milli=4000, mem_mib=8192))
+    fake.add_existing_node("ng1", build_test_node(
+        "idle", cpu_milli=4000, mem_mib=8192))
+    for i in range(3):
+        fake.add_pod(build_test_pod(f"b{i}", cpu_milli=1000, mem_mib=512,
+                                    owner_name="rs", node_name="busy"))
+    return fake
+
+
+def test_scale_down_withheld_while_degraded_then_reenabled():
+    """ISSUE 13 acceptance: while degraded the would-be deletion is
+    withheld with a surfaced BackendDegraded reason on all four PR-4
+    surfaces, and scale-down re-enables only after the recovery
+    hysteresis."""
+    from kubernetes_autoscaler_tpu.config.options import NodeGroupDefaults
+
+    fake = _idle_world()
+    # a 5s countdown so the candidate SURVIVES as unneeded across the
+    # degraded window instead of deleting on the first loop
+    a = autoscaler_for(
+        fake, backend_recovery_probes=1,
+        backend_recovery_hysteresis_loops=2,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=5.0,
+            scale_down_unready_time_s=5.0))
+    s0 = a.run_once(now=1000.0)
+    assert s0.unneeded_nodes == ["idle"] and not s0.scale_down_deleted
+
+    # two incidents → degraded
+    a.supervisor.record_failure("dispatch", "timeout")
+    a.supervisor.record_failure("dispatch", "timeout")
+    assert a.supervisor.state == "degraded"
+
+    s1 = a.run_once(now=1010.0)   # clocks mature, but the gate holds
+    assert s1.scale_down_withheld and not s1.scale_down_deleted
+    assert "idle" in fake.nodes
+    # surface 1: unremovable cache → registry gauge
+    assert a.planner.unremovable.reason("idle") == "BackendDegraded"
+    assert a.metrics.gauge("unremovable_nodes_count").value(
+        reason="BackendDegraded") == 1.0
+    # surface 2: event sink
+    evs = a.event_sink.find(kind="NoScaleDown", obj="idle",
+                            reason="BackendDegraded")
+    assert evs and "withheld" in evs[0].message
+    # surface 3: status document histogram
+    assert a.last_status.to_dict()["clusterWide"]["scaleDown"][
+        "unremovableReasons"].get("BackendDegraded") == 1
+    # surface 4: /snapshotz reason plane feed
+    class _Dbg:
+        def set_phase_stats(self, *_): pass
+        def set_trace_id(self, *_): pass
+        def set_journal_cursor(self, *_): pass
+        def set_reason_plane(self, payload): self.payload = payload
+    dbg = _Dbg()
+    a._feed_snapshot_observability(dbg, None)
+    assert dbg.payload["unremovableNodes"]["idle"]["reason"] \
+        == "BackendDegraded"
+
+    # recovery: s1's probe already promoted degraded → recovering, so the
+    # hysteresis (2 clean loops) holds the gate through s2, and s3 runs
+    # healthy → scale-down actually deletes
+    assert a.supervisor.state == "recovering"
+    s2 = a.run_once(now=1020.0)
+    assert s2.scale_down_withheld and not s2.scale_down_deleted
+    assert s2.backend_state == "healthy"    # hysteresis satisfied at loop end
+    s3 = a.run_once(now=1030.0)
+    assert not s3.scale_down_withheld
+    assert s3.scale_down_deleted == ["idle"]
+    # countdown RESUMED, not reset: since stamp survived the window
+    assert a.supervisor.scale_down_safe()
+
+
+def test_transient_error_heals_world_intact_and_does_not_gate_suspect():
+    fake = _idle_world()
+    from kubernetes_autoscaler_tpu.config.options import NodeGroupDefaults
+
+    a = autoscaler_for(fake, node_group_defaults=NodeGroupDefaults(
+        scale_down_unneeded_time_s=5.0, scale_down_unready_time_s=5.0))
+    a.run_once(now=1000.0)
+    store = a._world_store
+    full0 = store.encoder.full_encodes
+    a.supervisor.record_failure("fetch", "error-RuntimeError")
+    st = a.run_once(now=1002.0)
+    assert st.ran and not st.scale_down_withheld   # suspect + healed ⇒ safe
+    assert a.supervisor.last_heal["outcome"] == "intact"
+    assert not a.supervisor.world_stale
+    assert store.encoder.full_encodes == full0, \
+        "an intact residency audit must not force a full re-encode"
+    assert a.supervisor.state == "healthy"
+
+
+# ------------------------------------------- WorldStore device-loss heal
+
+
+def _churn_world():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=16000, mem_mib=65536, pods=110,
+                           labels={"pool": "a", "disk": "ssd"})
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=64)
+    for i in range(12):
+        nd = build_test_node(f"n{i}", cpu_milli=16000, mem_mib=65536,
+                             pods=110,
+                             labels={"pool": "a" if i % 2 else "b",
+                                     "disk": "ssd" if i % 3 else "hdd"})
+        fake.add_existing_node("ng1", nd)
+        for j in range(2):
+            fake.add_pod(build_test_pod(
+                f"r{i}-{j}", cpu_milli=3200, mem_mib=1024,
+                owner_name=f"rs{i % 5}", node_name=nd.name))
+    for i in range(40):
+        fake.add_pod(build_test_pod(
+            f"p{i}", cpu_milli=500, mem_mib=512,
+            owner_name=f"prs{i % 4}",
+            node_selector={"disk": "ssd"} if i % 4 == 0 else None))
+    return fake
+
+
+def _decisions(a, status):
+    verdict = tuple(sorted(
+        (key, int(cnt)) for key, cnt in zip(
+            a.last_verdict_keys or [],
+            a.last_verdict_plane if a.last_verdict_plane is not None else [])
+        if key is not None))
+    return (sorted(status.scale_up.increases.items())
+            if status.scale_up else None,
+            sorted(status.unneeded_nodes), status.pending_pods, verdict)
+
+
+def test_device_loss_rebuilds_bit_identical_to_cold_encode():
+    """ISSUE 13 acceptance: after a device loss the WorldStore digest-probe
+    rebuilds from host (`encoder_encodes_total{mode=full,cause=device_lost}`)
+    and the decisions are bit-identical to a cold encode — pinned by
+    running an incremental world and a full-encode-every-loop world in
+    lockstep through the loss."""
+    from kubernetes_autoscaler_tpu.config.options import NodeGroupDefaults
+
+    ng = NodeGroupDefaults(scale_down_unneeded_time_s=3600.0,
+                           scale_down_unready_time_s=3600.0)
+    worlds = [_churn_world(), _churn_world()]
+    autos = [autoscaler_for(w, incremental_encode=inc,
+                            node_group_defaults=ng)
+             for w, inc in zip(worlds, (True, False))]
+    for a in autos:
+        a.capture_verdicts = True
+
+    def churn(loop):
+        for w in worlds:
+            w.remove_pod(f"p{loop}")
+            w.add_pod(build_test_pod(
+                f"q{loop}", cpu_milli=500, mem_mib=512,
+                owner_name=f"prs{loop % 4}"))
+
+    for loop in range(3):
+        churn(loop)
+        now = 1000.0 + 10 * loop
+        st = [a.run_once(now=now) for a in autos]
+        assert _decisions(autos[0], st[0]) == _decisions(autos[1], st[1])
+
+    # device restart: every resident buffer dies underneath the store
+    store = autos[0]._world_store
+    for key, dev in list(store.device_store._dev.items()):
+        if hasattr(dev, "delete"):
+            dev.delete()
+    autos[0].supervisor.record_failure("dispatch", "error-XlaRuntimeError")
+
+    churn(3)
+    st = [a.run_once(now=1030.0) for a in autos]
+    assert autos[0].supervisor.last_heal["outcome"] == "rebuilt"
+    assert store.last_mode == "full" and store.last_cause == "device_lost"
+    assert autos[0].metrics.counter("encoder_encodes_total").value(
+        mode="full", cause="device_lost") == 1
+    assert _decisions(autos[0], st[0]) == _decisions(autos[1], st[1]), \
+        "post-device-loss decisions must be bit-identical to a cold encode"
+    # and the store is resident again afterwards: the next loop deltas
+    churn(4)
+    st = [a.run_once(now=1040.0) for a in autos]
+    assert store.last_mode == "delta"
+    assert _decisions(autos[0], st[0]) == _decisions(autos[1], st[1])
+
+
+def test_heal_detects_corrupted_plane():
+    import numpy as np
+
+    fake = _churn_world()
+    a = autoscaler_for(fake)
+    a.run_once(now=1000.0)
+    store = a._world_store
+    # corrupt one resident plane (content divergence, buffers still alive)
+    key = next(k for k, v in sorted(store.device_store._dev.items())
+               if np.asarray(v).size and np.asarray(v).any())
+    import jax.numpy as jnp
+
+    store.device_store._dev[key] = jnp.zeros_like(store.device_store._dev[key])
+    healed = store.heal()
+    assert healed["outcome"] == "rebuilt"
+    assert key in healed["lostPlanes"]
+
+
+# --------------------------------------------- crash-consistent restart
+
+
+def test_restart_record_roundtrip_and_staleness(tmp_path):
+    path = str(tmp_path / "restart.json")
+    from kubernetes_autoscaler_tpu.clusterstate.registry import ScaleUpRequest
+
+    reqs = {"ng1": ScaleUpRequest("ng1", 3, 100.0, 1000.0)}
+    save_restart_state(path, now=120.0, journal_cursor=(7, "abcd"),
+                       unneeded_since={"idle": 90.0},
+                       scale_up_requests=reqs)
+    rec = load_restart_state(path, now=130.0, max_age_s=600.0)
+    assert rec["journalCursor"] == [7, "abcd"]
+    assert rec["unneededSince"] == {"idle": 90.0}
+    assert rec["scaleUpRequests"] == [{"group": "ng1", "increase": 3,
+                                       "time": 100.0,
+                                       "expectedAddTime": 1000.0}]
+    # stale wholesale discard (premature-deletion guard)
+    assert load_restart_state(path, now=120.0 + 601.0, max_age_s=600.0) is None
+    # records from a future clock domain are not trusted either
+    assert load_restart_state(path, now=100.0, max_age_s=600.0) is None
+    # corrupt file → cold start, not a crash
+    with open(path, "w") as f:
+        f.write("{torn")
+    assert load_restart_state(path, now=130.0, max_age_s=600.0) is None
+    with open(path, "w") as f:
+        json.dump({"version": 99, "savedAt": 120.0, "unneededSince": {},
+                   "scaleUpRequests": []}, f)
+    assert load_restart_state(path, now=130.0, max_age_s=600.0) is None
+
+
+def test_restart_resumes_unneeded_clocks_no_reset_no_premature(tmp_path):
+    """Acceptance: a kill/restart resumes unneeded-since timers — deletion
+    fires at the ORIGINAL maturity (no reset = no delayed scale-down) and
+    never before it (no premature deletion)."""
+    from kubernetes_autoscaler_tpu.config.options import NodeGroupDefaults
+
+    path = str(tmp_path / "restart.json")
+
+    def mk(fake):
+        return autoscaler_for(
+            fake, restart_state_path=path,
+            # isolate the restart record from the soft-taint WAL
+            max_bulk_soft_taint_count=0,
+            node_group_defaults=NodeGroupDefaults(
+                scale_down_unneeded_time_s=60.0,
+                scale_down_unready_time_s=60.0))
+
+    fake = _idle_world()
+    a = mk(fake)
+    s = a.run_once(now=1000.0)
+    assert s.unneeded_nodes == ["idle"] and not s.scale_down_deleted
+    a.run_once(now=1010.0)
+    assert a.planner.unneeded_nodes.since["idle"] == 1000.0
+
+    # crash: new process, same cluster, same record
+    b = mk(fake)
+    s1 = b.run_once(now=1030.0)
+    assert b.metrics.counter("restart_state_total").value(
+        event="rehydrated") == 1
+    assert b.planner.unneeded_nodes.since["idle"] == 1000.0
+    assert not s1.scale_down_deleted, "1030 < 1000+60: no premature deletion"
+    s2 = b.run_once(now=1055.0)
+    assert not s2.scale_down_deleted
+    s3 = b.run_once(now=1065.0)
+    assert s3.scale_down_deleted == ["idle"], \
+        "countdown resumed from 1000, not reset at restart (1030+60=1090)"
+
+
+def test_restart_discards_stale_record_and_busy_node_clock(tmp_path):
+    from kubernetes_autoscaler_tpu.config.options import NodeGroupDefaults
+
+    path = str(tmp_path / "restart.json")
+    ngd = NodeGroupDefaults(scale_down_unneeded_time_s=60.0,
+                            scale_down_unready_time_s=60.0)
+    fake = _idle_world()
+    a = autoscaler_for(fake, restart_state_path=path,
+                       max_bulk_soft_taint_count=0,
+                       node_group_defaults=ngd)
+    a.run_once(now=1000.0)
+
+    # (a) over-age record: discarded WHOLESALE — the clock restarts
+    b = autoscaler_for(fake, restart_state_path=path,
+                       max_bulk_soft_taint_count=0,
+                       restart_state_max_age_s=100.0,
+                       node_group_defaults=ngd)
+    sb = b.run_once(now=5000.0)
+    assert b.metrics.counter("restart_state_total").value(
+        event="discarded") == 1
+    assert not sb.scale_down_deleted
+    assert b.planner.unneeded_nodes.since["idle"] == 5000.0
+
+    # (b) the tracked node became busy during the downtime: the restored
+    # clock exists but the fresh planner drops it before any actuation
+    fake2 = _idle_world()
+    c = autoscaler_for(fake2, restart_state_path=path,
+                       max_bulk_soft_taint_count=0,
+                       node_group_defaults=ngd)
+    c.run_once(now=1000.0)
+    for i in range(3):
+        fake2.add_pod(build_test_pod(f"late{i}", cpu_milli=1000, mem_mib=512,
+                                     owner_name="rs2", node_name="idle"))
+    d = autoscaler_for(fake2, restart_state_path=path,
+                       max_bulk_soft_taint_count=0,
+                       node_group_defaults=ngd)
+    sd = d.run_once(now=1100.0)      # past maturity of the restored clock
+    assert not sd.scale_down_deleted
+    assert "idle" not in d.planner.state.unneeded
+    assert "idle" in fake2.nodes
+
+
+def test_restart_rehydrates_in_flight_scale_ups(tmp_path):
+    path = str(tmp_path / "restart.json")
+    fake = FakeCluster(provision_delay_s=10_000.0)   # nodes never arrive
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
+    fake.add_existing_node("ng1", build_test_node(
+        "seed", cpu_milli=4000, mem_mib=8192))
+    for i in range(4):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=3000, mem_mib=512,
+                                    owner_name="rs"))
+    a = autoscaler_for(fake, restart_state_path=path)
+    s = a.run_once(now=1000.0)
+    assert s.scale_up is not None and s.scale_up.scaled_up
+    req = a.cluster_state.scale_up_requests["ng1"]
+
+    b = autoscaler_for(fake, restart_state_path=path)
+    b.run_once(now=1005.0)
+    restored = b.cluster_state.scale_up_requests.get("ng1")
+    assert restored is not None, \
+        "in-flight scale-up must survive the restart (no taint WAL covers it)"
+    assert restored.expected_add_time == req.expected_add_time, \
+        "the provision timeout clock must continue, not restart"
