@@ -246,6 +246,11 @@ def run_floor_child(metric: str, args) -> int:
         # the control-loop chaos schedule is host-side orchestration — it
         # degrades WITH the floor instead of vanishing from the evidence
         cmd += ["--chaos-local"]
+    if args.device_stats:
+        # the residency census and compile census are host-side bookkeeping
+        # over whatever backend serves; the block degrades WITH the floor
+        # (device_stats_source flips to host-fallback) instead of vanishing
+        cmd += ["--device-stats"]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     print(f"[bench] degrading to CPU floor metric: {' '.join(cmd[1:])}",
@@ -435,6 +440,15 @@ def main() -> None:
                          "and steady-state jit-cache growth (never-null on "
                          "the CPU floor — the store is host+device "
                          "bookkeeping, backend-independent)")
+    ap.add_argument("--device-stats", action="store_true",
+                    help="emit the device-side observability block (ISSUE "
+                         "14): HBM residency ledger census reconciled "
+                         "against device memory_stats (host-RSS fallback "
+                         "on CPU, device_stats_source=host-fallback), "
+                         "per-tenant attribution, the hbm-budget admission "
+                         "reject, compile-census variants, a profiler "
+                         "capture round trip, and the disabled-path guard "
+                         "ns/op — never-null on both floors")
     ap.add_argument("--chaos-local", action="store_true",
                     help="run the LOCAL control loop's seeded chaos "
                          "schedule (docs/ROBUSTNESS.md 'Control loop'): a "
@@ -819,6 +833,23 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
               f"{pipe_ms:.2f}ms, {double_buffer['overlapped_ms']:.3f}ms of "
               f"encode/dispatch under an in-flight fetch", file=sys.stderr)
 
+    # compile census (ISSUE 14): name the primary program's variant — shape
+    # signature + lowered cost analysis (flops / bytes accessed). Mode
+    # "cost" on purpose: no AOT re-compile against the init budget; the
+    # figures come from the lowering alone.
+    from kubernetes_autoscaler_tpu.metrics import device as device_obs
+
+    primary_census = device_obs.CompileCensus(registry=registry,
+                                              mode="cost")
+    try:
+        census_rec = with_timeout(
+            lambda: primary_census.record(
+                "bench_step", step,
+                (nodes, specs, sched, groups, jnp.int32(0), plan)),
+            seconds=60)()
+    except Exception as e:  # noqa: BLE001 — census is evidence, not gating
+        census_rec = {"error": f"{type(e).__name__}: {e}"}
+
     checks = int(np.asarray(enc.specs.count).sum()) * args.nodes
     print(
         f"[bench] device={jax.devices()[0].platform} encode={encode_s:.2f}s "
@@ -864,6 +895,9 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
         "plane_fetch": plane_fetch,
         # encode/dispatch work overlapped with in-flight async fetches
         "double_buffer": double_buffer,
+        # the headline program as a NAMED compile-census variant (shape
+        # signature + lowered flops/bytes; metrics/device.CompileCensus)
+        "compile_census": census_rec,
         "phases": {
             "encode_ms": round(encode_s * 1000.0, 1),
             "compile_ms": round(compile_s * 1000.0, 1),
@@ -929,6 +963,18 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
                 "error": f"{type(e).__name__}: {e}",
             }), flush=True)
 
+    if getattr(args, "device_stats", False):
+        try:
+            with_timeout(lambda: bench_device_stats(args), seconds=600)()
+        except Exception as e:
+            print(f"[bench] device-stats phase failed: {type(e).__name__}: "
+                  f"{e}", file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "device_stats", "value": None, "unit": "MiB",
+                "error": f"{type(e).__name__}: {e}",
+            }), flush=True)
+
     if args.journal:
         try:
             with_timeout(lambda: bench_journal(args), seconds=600)()
@@ -953,7 +999,8 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
 
     if args.scaledown or args.e2e or args.trace or args.tenants \
             or args.journal or args.world_store \
-            or getattr(args, "chaos_local", False):
+            or getattr(args, "chaos_local", False) \
+            or getattr(args, "device_stats", False):
         print(primary_line, flush=True)
 
 
@@ -2424,6 +2471,177 @@ def bench_journal(args) -> None:
             "backend": report["backend"],
         },
     }), flush=True)
+
+
+def bench_device_stats(args) -> None:
+    """--device-stats: the device-side observability block (ISSUE 14 /
+    docs/OBSERVABILITY.md "Device surfaces"), never-null on both floors.
+
+    Drives a small in-process multi-tenant serving stack and reports:
+    (1) the HBM residency ledger census — per-owner/per-tenant tagged
+    bytes reconciled against `device.memory_stats()` totals on real
+    accelerators, or against host RSS with `device_stats_source:
+    host-fallback` on CPU backends (the never-null degradation);
+    (2) the `hbm-budget` admission reject: a tenant whose projected
+    residency breaches the budget is rejected with the structured
+    validation reason, with no OOM and no quarantine of innocents;
+    (3) the compile census variant table (which entry point compiled, at
+    which shape signature, charged to which tenant, at what flop/temp-HBM
+    cost); (4) a Profilez-armed capture round trip (capture dir + stamped
+    meta.json); (5) the disabled-path guard cost in ns/op (the PR 12
+    zero-overhead contract, CI-bounded)."""
+    import tempfile
+
+    import jax
+
+    from kubernetes_autoscaler_tpu.metrics import device
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimParams,
+        SimulatorService,
+    )
+    from kubernetes_autoscaler_tpu.sidecar.admission import (
+        WorldValidationError,
+    )
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    mib = 1024 * 1024
+    ngs = [{"id": "ng-4c", "template": {"name": "t4", "capacity": {
+        "cpu": 4.0, "memory": 16384 * mib, "pods": 110}},
+        "max_new": 32, "price": 1.0}]
+
+    def tenant_delta(i: int) -> bytes:
+        w = DeltaWriter()
+        for k in range(8):
+            w.upsert_node(build_test_node(
+                f"d{i}-n{k}", cpu_milli=2000 + 1000 * (k % 3),
+                mem_mib=8192, pods=110))
+        for k in range(24):
+            w.upsert_pod(build_test_pod(
+                f"d{i}-p{k}", cpu_milli=300, mem_mib=256,
+                owner_name=f"d{i}-rs{k % 3}",
+                node_name=f"d{i}-n{k % 8}" if k % 3 == 0 else ""))
+        return w.payload()
+
+    n_tenants = 4
+    prof_dir = tempfile.mkdtemp(prefix="katpu-devprof-")
+    svc = SimulatorService(
+        node_bucket=16, group_bucket=16, batch_lanes=2,
+        batch_window_ms=10.0, device_profile_dir=prof_dir,
+        profile_min_interval_s=0.0)
+    try:
+        for i in range(n_tenants):
+            ack = svc.apply_delta(tenant_delta(i), tenant=f"dev{i}")
+            assert not ack.get("error"), ack
+        for _round in range(2):      # warm + steady
+            for i in range(n_tenants):
+                svc.scale_up_sim(SimParams(max_new_nodes=16,
+                                           node_groups=ngs),
+                                 tenant=f"dev{i}")
+                svc.scale_down_sim(SimParams(threshold=0.5),
+                                   tenant=f"dev{i}")
+        rec = svc.hbm_stats()
+        tenants = {t: b for t, b in rec["tenants"].items()
+                   if t.startswith("dev")}
+        # reconciliation contract: on a real device every tagged byte is a
+        # subset of bytes_in_use (the documented slack is the UNTAGGED
+        # remainder — allocator overhead + XLA temp space); on the host
+        # fallback tagged-census-only is the report
+        reconciles = (rec["source"] != "device"
+                      or 0 < rec["tagged_bytes"] <= rec["bytes_in_use"])
+
+        # (2) hbm-budget admission: shrink the budget under this world's
+        # projected residency — the NEXT tenant rejects with the reason,
+        # resident tenants keep serving, nobody is quarantined
+        svc.hbm_budget_frac = 1e-12
+        svc.hbm_limit_bytes = 1
+        svc._hbm_limit_cache = None
+        ack = svc.apply_delta(tenant_delta(n_tenants),
+                              tenant=f"dev{n_tenants}")
+        assert not ack.get("error"), ack
+        budget_reject = None
+        try:
+            svc.scale_up_sim(SimParams(max_new_nodes=16, node_groups=ngs),
+                             tenant=f"dev{n_tenants}")
+        except WorldValidationError as e:
+            budget_reject = e.reason
+        svc.hbm_budget_frac = 0.0       # innocents keep serving
+        svc.hbm_limit_bytes = 0
+        svc._hbm_limit_cache = None
+        innocent = svc.scale_up_sim(
+            SimParams(max_new_nodes=16, node_groups=ngs), tenant="dev0")
+        budget = {
+            "reject_reason": budget_reject,
+            "taxonomy_count": svc.registry.counter(
+                "world_validation_rejects_total").value(
+                reason="hbm-budget"),
+            "innocents_ok": bool(innocent.get("best") is not None),
+            "quarantined": len(svc.quarantine_stats()),
+        }
+
+        # (3) profiler round trip: arm via the Profilez surface, capture
+        # the next dispatch, verify the stamped meta
+        armed = svc.profilez(json.dumps({"arm": True,
+                                         "reason": "bench"}).encode())
+        svc.scale_up_sim(SimParams(max_new_nodes=16, node_groups=ngs),
+                         tenant="dev1")
+        pstats = device.PROFILER.stats() if device.PROFILER else {}
+        cap = pstats.get("last") or {}
+        meta_ok = False
+        if cap.get("path"):
+            try:
+                with open(os.path.join(cap["path"], "meta.json")) as f:
+                    meta = json.load(f)
+                meta_ok = meta.get("reason") == "bench"
+            except OSError:
+                pass
+        profiler = {
+            "armed_ok": bool(armed.get("armed_now")),
+            "captured": bool(cap.get("path")),
+            "meta_ok": meta_ok,
+            "captures": pstats.get("captures", 0),
+            "throttled": pstats.get("throttled", 0),
+        }
+
+        census = svc.census.variants()
+
+        # (5) disabled-path guard: one module-global load + identity test
+        # per hot-path site (measure LAST — disabling drops the ledger)
+        saved = device.LEDGER
+        device.disable_ledger()
+        iters = 200_000
+        g0 = time.perf_counter_ns()
+        for _ in range(iters):
+            if device.LEDGER is not None:  # pragma: no cover
+                raise AssertionError("disabled ledger fired")
+        guard_ns = (time.perf_counter_ns() - g0) / iters
+        device.LEDGER = saved
+
+        print(json.dumps({
+            "metric": "device_stats",
+            "value": round(rec["tagged_bytes"] / mib, 4),
+            "unit": "MiB",
+            "backend": jax.default_backend(),
+            "device_stats_source": rec["source"],
+            "bytes_in_use": rec["bytes_in_use"],
+            "bytes_limit": rec["bytes_limit"],
+            "tagged_bytes": rec["tagged_bytes"],
+            "untagged_bytes": rec["untagged_bytes"],
+            "headroom_ratio": rec["headroom_ratio"],
+            "reconciles": reconciles,
+            "by_owner_tenant": rec["by_owner_tenant"],
+            "tenant_hbm_bytes": tenants,
+            "tenants_attributed": sum(1 for b in tenants.values() if b > 0),
+            "budget": budget,
+            "compile_census": census,
+            "profiler": profiler,
+            "disabled_guard_ns": round(guard_ns, 1),
+        }), flush=True)
+    finally:
+        svc.close()
 
 
 if __name__ == "__main__":

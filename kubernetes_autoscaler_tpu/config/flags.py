@@ -210,6 +210,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend-recovery-hysteresis", type=int, default=2,
                    help="clean loops in recovering before scale-down "
                         "re-enables (flap damping)")
+    p.add_argument("--device-ledger", type=_bool, default=True,
+                   help="HBM residency ledger: owner/tenant-tagged census "
+                        "of resident device arrays, reconciled against "
+                        "device memory_stats each loop (metrics/device.py)")
+    p.add_argument("--hbm-watchdog-loops", type=int, default=5,
+                   help="consecutive loops of monotonic untagged device-"
+                        "byte growth before the leak watchdog fires an "
+                        "event + flight-recorder dump")
+    p.add_argument("--device-profile-dir", default="",
+                   help="breach-armed device profiler: a loop-SLO breach "
+                        "arms a bounded jax.profiler.trace capture of the "
+                        "next RunOnce into this directory, stamped with "
+                        "trace id + journal cursor (empty = off)")
     p.add_argument("--restart-state-path", default="",
                    help="persist unneeded-since clocks + in-flight "
                         "scale-ups here each loop and rehydrate on start "
@@ -358,6 +371,9 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         backend_suspect_threshold=args.backend_suspect_threshold,
         backend_recovery_probes=args.backend_recovery_probes,
         backend_recovery_hysteresis_loops=args.backend_recovery_hysteresis,
+        device_ledger=args.device_ledger,
+        hbm_watchdog_loops=args.hbm_watchdog_loops,
+        device_profile_dir=args.device_profile_dir,
         restart_state_path=args.restart_state_path,
         restart_state_max_age_s=args.restart_state_max_age,
     )

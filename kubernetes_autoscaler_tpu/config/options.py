@@ -165,6 +165,15 @@ class AutoscalingOptions:
     # thrash full re-encodes)
     backend_recovery_probes: int = 2               # --backend-recovery-probes
     backend_recovery_hysteresis_loops: int = 2     # --backend-recovery-hysteresis
+    # device-side observability (metrics/device.py): the HBM residency
+    # ledger census published per loop + the leak watchdog (K loops of
+    # monotonic untagged growth fires an event + flight-recorder dump)
+    device_ledger: bool = True                     # --device-ledger
+    hbm_watchdog_loops: int = 5                    # --hbm-watchdog-loops
+    # breach-armed device profiler: a loop-SLO breach arms a bounded
+    # jax.profiler.trace capture of the NEXT RunOnce into this directory,
+    # stamped with trace id + journal cursor; "" = off
+    device_profile_dir: str = ""                   # --device-profile-dir
     # crash-consistent restart record (unneeded-since clocks + in-flight
     # scale-ups keyed to the journal cursor); "" = off
     restart_state_path: str = ""                   # --restart-state-path

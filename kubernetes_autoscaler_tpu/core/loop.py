@@ -76,6 +76,10 @@ def run_loop(
                 backend_state=autoscaler.supervisor.state
                 if getattr(autoscaler, "supervisor", None) is not None
                 else "",
+                # an OOM-failed loop's device-memory pprof evidence
+                # (static_autoscaler dumps it before the supervisor ladder
+                # churns the heap)
+                hbm_dump_path=getattr(autoscaler, "last_oom_dump", ""),
             )
             # exponent clamped: a backend down for hours must not overflow
             # float range inside the very handler that keeps the driver alive

@@ -522,6 +522,12 @@ class ScaleUpOrchestrator:
         gt = encode_node_groups(templates, enc.registry, enc.zone_table,
                                 enc.dims, daemonsets=self.daemonsets)
         self._group_tensor_cache = (fp, gt)
+        # HBM residency ledger (metrics/device.py): the marshalled group
+        # tensors are device arrays held across loops by this cache
+        from kubernetes_autoscaler_tpu.metrics import device
+
+        if device.LEDGER is not None:
+            device.LEDGER.track("marshal", "group_tensors", gt)
         return gt
 
     # ---- similar-group balancing (reference: compare_nodegroups.go:105) ----

@@ -33,6 +33,7 @@ from kubernetes_autoscaler_tpu.core.scaleup.orchestrator import (
     ScaleUpResult,
 )
 from kubernetes_autoscaler_tpu.expander.strategies import build_expander
+from kubernetes_autoscaler_tpu.metrics import device as device_obs
 from kubernetes_autoscaler_tpu.metrics import trace
 from kubernetes_autoscaler_tpu.metrics.metrics import HealthCheck, Registry, default_registry
 from kubernetes_autoscaler_tpu.metrics.trace import FlightRecorder
@@ -80,6 +81,9 @@ class RunOnceStatus:
     # unverified world) — the would-be victims carry BackendDegraded marks
     scale_down_withheld: bool = False
     backend_state: str = ""
+    # device-memory pprof snapshot persisted by an OOM-failed loop (the
+    # flight-recorder-adjacent evidence; "" = no OOM / no dump dir)
+    hbm_dump_path: str = ""
 
 
 class StaticAutoscaler:
@@ -212,6 +216,23 @@ class StaticAutoscaler:
         self.flight_recorder = FlightRecorder(
             capacity=self.options.flight_recorder_capacity,
             dump_dir=self.options.flight_recorder_dir)
+        # device-side observability (metrics/device.py): the HBM residency
+        # ledger census is published per loop and the leak watchdog watches
+        # the UNTAGGED remainder (device bytes no owner registered); a
+        # loop-SLO breach arms the device profiler so the NEXT RunOnce runs
+        # under a bounded jax.profiler.trace capture
+        if self.options.device_ledger:
+            device_obs.enable_ledger()
+        self._hbm_watchdog = device_obs.LeakWatchdog(
+            k=self.options.hbm_watchdog_loops, registry=self.metrics)
+        if self.options.device_profile_dir:
+            device_obs.install_profiler(self.options.device_profile_dir,
+                                        registry=self.metrics)
+        self.last_hbm_report: dict | None = None
+        # path of the device-memory pprof snapshot persisted by the most
+        # recent OOM-failed loop ("" = none); run_loop surfaces it on the
+        # failed RunOnceStatus
+        self.last_oom_dump: str = ""
         # deterministic flight journal (replay/): every RunOnce recorded as
         # a self-contained snapshot/delta record, replayable bit-for-bit by
         # `python -m kubernetes_autoscaler_tpu.replay` (--journal-dir /
@@ -332,7 +353,18 @@ class StaticAutoscaler:
         t0 = time.perf_counter()
         error: Exception | None = None
         self._journal_cursor = None
+        self.last_oom_dump = ""
         try:
+            prof = device_obs.PROFILER
+            if prof is not None and prof.armed:
+                # breach-armed capture: this whole RunOnce runs under one
+                # bounded jax.profiler.trace session (the capture dir is
+                # stamped with the arming trace id + journal cursor)
+                out, cap_path = prof.capture(
+                    lambda: self._run_once_inner(now))
+                if cap_path and tracer is not None:
+                    tracer.annotate(device_capture=cap_path)
+                return out
             return self._run_once_inner(now)
         except Exception as e:
             # liveness + errors_total (reference: errors surface through
@@ -340,6 +372,21 @@ class StaticAutoscaler:
             error = e
             self.health.mark_failed(now)
             self.metrics.counter("errors_total").inc(type=type(e).__name__)
+            if device_obs.is_oom(e):
+                # a device OOM is an allocator post-mortem: persist the
+                # per-allocation pprof snapshot next to the flight-recorder
+                # evidence BEFORE the supervisor ladder (and its re-encodes)
+                # churn the heap; run_loop surfaces the path on the failed
+                # RunOnceStatus
+                dump_dir = (self.options.flight_recorder_dir
+                            or self.options.device_profile_dir)
+                if dump_dir:
+                    self.last_oom_dump = device_obs.dump_memory_profile(
+                        dump_dir, tag="loop-oom", registry=self.metrics) or ""
+                    if self.last_oom_dump:
+                        self.event_sink.emit(
+                            "HbmOomDump", "device", "ResourceExhausted",
+                            message=self.last_oom_dump, now=now)
             # flush-on-error: an armed /snapshotz must never hang on a loop
             # that raised — resolve it with the partial payload + the error
             if dbg is not None and dbg.is_data_collection_allowed():
@@ -359,6 +406,31 @@ class StaticAutoscaler:
             breach = 0.0 < budget < loop_s
             if breach:
                 self.metrics.counter("loop_slo_breaches_total").inc()
+                if device_obs.PROFILER is not None:
+                    # the loop-SLO breach arms the device profiler: the
+                    # NEXT RunOnce captures a real device timeline linked
+                    # to this loop's trace id + journal cursor
+                    device_obs.PROFILER.arm(
+                        "loop_slo_breach",
+                        trace_id=tracer.trace_id if tracer else "",
+                        journal_cursor=self._journal_cursor)
+            # HBM residency census (metrics/device.py): publish the
+            # owner/tenant-tagged gauges and feed the leak watchdog the
+            # untagged remainder — K loops of monotonic growth is device
+            # memory NOBODY tagged, the canonical slow-leak signature
+            leak = None
+            if self.options.device_ledger and device_obs.LEDGER is not None:
+                rec = device_obs.LEDGER.reconcile(registry=self.metrics)
+                self.last_hbm_report = rec
+                leak = self._hbm_watchdog.observe(rec["untagged_bytes"])
+                if leak is not None:
+                    self.event_sink.emit(
+                        "HbmLeakSuspect", "device", "UntaggedGrowth",
+                        message=f"untagged device bytes grew "
+                                f"{leak['grew_bytes']}b over "
+                                f"{leak['loops']} loops "
+                                f"(now {leak['untagged_bytes']}b)",
+                        now=now)
             if tracer is not None:
                 cur = self._journal_cursor
                 tracer.end(root, loop_s=round(loop_s, 6),
@@ -371,6 +443,7 @@ class StaticAutoscaler:
                     trace.activate(None)
                     reason = ("error" if error is not None
                               else "slo_breach" if breach
+                              else "hbm_leak" if leak is not None
                               else "snapshotz" if armed else "")
                     if self.flight_recorder.record(tracer, dump_reason=reason):
                         self.metrics.counter(

@@ -70,6 +70,18 @@ class Gauge:
         with self._lock:
             return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def zero_matching(self, **labels) -> None:
+        """Stale-label zeroing, gauge edition (the drop_tenant sweep
+        contract from the counter/histogram families extended to the
+        device-residency gauges): every series whose label set contains
+        `labels` resets to 0 — a dropped tenant's `tenant_hbm_bytes` /
+        `resident_bytes` must not keep claiming device memory."""
+        items = set(labels.items())
+        with self._lock:
+            for key in self._values:
+                if items <= set(key):
+                    self._values[key] = 0.0
+
 
 @dataclass
 class Histogram:

@@ -156,6 +156,46 @@ SERVING_FAMILIES = {
         "(docs/REPLAY.md)"),
 }
 
+# ---- device surfaces: reference host-process families → device accounting ----
+#
+# The reference runs on a kube control plane with NO accelerator: its only
+# memory/compile observability is the Go process's own (go runtime metrics
+# scraped off-process, no per-component attribution, no compile concept).
+# This framework keeps multi-tenant state RESIDENT in device HBM and
+# compiles XLA programs on the serving path, so each absent reference
+# surface maps onto a device family (metrics/device.py; PARITY.md carries
+# the same table; the Metricz ≡ /metrics row-for-row parity test covers
+# every family below).
+DEVICE_FAMILIES = {
+    # absent reference surface -> our device accounting
+    "(process RSS, unattributed)": (
+        "hbm_bytes_in_use / hbm_bytes_limit / hbm_headroom_ratio — the "
+        "device's own totals (memory_stats), plus resident_bytes"
+        "{owner,tenant}: a weakref census of every LIVE device array by "
+        "owner component (world_store / tenant_export / stack_cache / "
+        "marshal) and tenant; the untagged remainder feeds the leak "
+        "watchdog (hbm_leak_suspects_total)"),
+    "(no per-tenant memory accounting)": (
+        "tenant_hbm_bytes{tenant} — live device bytes attributed to one "
+        "tenant across every owner tag; the projected-residency base the "
+        "--hbm-budget-frac admission gate charges (reject reason "
+        "`hbm-budget` in world_validation_rejects_total)"),
+    "(no compile concept)": (
+        "compile_census_total{fn,shape_sig,tenant} + compile_census_flops/"
+        "bytes_accessed/temp_bytes{fn,shape_sig} — every XLA compile on "
+        "the dispatch path named by entry point, shape signature and the "
+        "tenant charged, with cost_analysis/memory_analysis figures; "
+        "sim_compiles_total and recompiles_per_new_tenant resolve to these "
+        "variants instead of bare counts"),
+    "(no profiler integration)": (
+        "device_profile_captures_total{reason} — bounded, rate-limited "
+        "jax.profiler.trace sessions armed by SLO-breach/tail retention "
+        "(or the Profilez RPC), capture dirs stamped with trace id + "
+        "journal cursor; hbm_oom_dumps_total counts the device-memory "
+        "pprof snapshots persisted on RESOURCE_EXHAUSTED dispatch "
+        "failures"),
+}
+
 # The reference UnremovableReason enum values our planner actually produces,
 # value-for-value (simulator/cluster.go:63-103). A dashboard filtering the
 # reference's unremovable_nodes_count{reason=...} re-points unchanged.

@@ -55,6 +55,7 @@ import hashlib
 
 import numpy as np
 
+from kubernetes_autoscaler_tpu.metrics import device
 from kubernetes_autoscaler_tpu.utils.canonical import canon_map, digest_strs
 
 MODES = ("delta", "row_refresh", "full")
@@ -132,6 +133,11 @@ class DevicePlaneStore:
         if seed_bytes:
             self.seed_uploads += 1
             self._charge("(seed)", ("seed", 0, int(seed_bytes)))
+        # HBM residency ledger (metrics/device.py): the resident world
+        # planes are the control loop's largest standing device allocation
+        if device.LEDGER is not None:
+            for key, dev in self._dev.items():
+                device.LEDGER.track("world_store", key, dev)
 
     # ---- dirty tracking (the delta program under construction) ----
 
@@ -182,6 +188,8 @@ class DevicePlaneStore:
             self._charge(key, ("replace", int(mirror.shape[0]),
                                int(mirror.nbytes)))
         self._dev[key] = dev
+        if device.LEDGER is not None:
+            device.LEDGER.track("world_store", key, dev)
         return dev
 
     def _charge(self, key: str, action: tuple) -> None:
@@ -232,6 +240,8 @@ class DevicePlaneStore:
         self._dev.clear()
         self._dirty.clear()
         self._dirty_rows.clear()
+        if device.LEDGER is not None:
+            device.LEDGER.release(owner="world_store")
 
     def stats(self) -> dict:
         return {

@@ -39,6 +39,7 @@ from kubernetes_autoscaler_tpu.models.cluster_state import (
     PodGroupTensors,
     ScheduledPodTensors,
 )
+from kubernetes_autoscaler_tpu.metrics import device
 from kubernetes_autoscaler_tpu.sidecar import faults
 
 
@@ -198,9 +199,23 @@ class StackCache:
         self.misses += 1
         val = build()
         self._d[key] = val
+        if device.LEDGER is not None:
+            # HBM residency ledger: stacked pytrees are device arrays held
+            # across windows; key by insertion identity so an evicted
+            # entry's registration is dropped with it
+            device.LEDGER.track("stack_cache", self._ledger_key(key), val)
         while len(self._d) > self.capacity:
-            self._d.popitem(last=False)
+            old_key, _old = self._d.popitem(last=False)
+            if device.LEDGER is not None:
+                device.LEDGER.release(owner="stack_cache",
+                                      key=self._ledger_key(old_key))
         return val
+
+    @staticmethod
+    def _ledger_key(key) -> str:
+        import hashlib
+
+        return hashlib.sha1(repr(key).encode()).hexdigest()[:12]
 
 
 class InFlightBatch:

@@ -39,6 +39,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from kubernetes_autoscaler_tpu.events import EventSink
+from kubernetes_autoscaler_tpu.metrics import device
 from kubernetes_autoscaler_tpu.metrics import trace
 from kubernetes_autoscaler_tpu.metrics.metrics import (
     Registry,
@@ -155,7 +156,12 @@ class SimulatorService:
                  journal_capacity: int = 256,
                  quarantine_ttl_s: float = 30.0,
                  max_world: tuple | None = None,
-                 rehydrate_dir: str = ""):
+                 rehydrate_dir: str = "",
+                 hbm_budget_frac: float = 0.0,
+                 hbm_limit_bytes: int = 0,
+                 device_profile_dir: str = "",
+                 profile_min_interval_s: float = 30.0,
+                 profile_max_captures: int = 8):
         self.dims = dims
         self.max_tenants = int(max_tenants)
         # fault-domain isolation (docs/ROBUSTNESS.md): quarantine TTL and
@@ -177,6 +183,29 @@ class SimulatorService:
         # in-process sidecar's series appear identically on both surfaces.
         self.registry = Registry(prefix="katpu_sidecar")
         register_exposition(self.registry)
+        # device-side observability (metrics/device.py): the HBM residency
+        # ledger (owner/tenant-tagged census of the resident device arrays —
+        # tenant export tiers, stack cache, world-store planes), the compile
+        # census (which shape signature compiled for which tenant, at what
+        # flop/temp-HBM cost), and the breach-armed device profiler.
+        # `hbm_budget_frac` > 0 turns residency into an ADMISSION dimension:
+        # a new tenant whose projected class-shaped residency would push
+        # tagged bytes past frac·limit is rejected with the `hbm-budget`
+        # validation reason instead of OOMing the window it joined.
+        device.enable_ledger()
+        self.hbm_budget_frac = float(hbm_budget_frac)
+        self.hbm_limit_bytes = int(hbm_limit_bytes)
+        self._hbm_limit_cache: int | None = None
+        # async analysis: the mode-"full" AOT compile for memory figures
+        # must not run inside the dispatch that just paid a real compile
+        self.census = device.CompileCensus(registry=self.registry,
+                                           sync_analysis=False)
+        if device_profile_dir:
+            device.install_profiler(
+                device_profile_dir,
+                min_interval_s=profile_min_interval_s,
+                max_captures=profile_max_captures,
+                registry=self.registry)
         # activate a chaos plan declared in the environment (KATPU_FAULTS);
         # a programmatically installed plan wins, absence costs one env
         # read. The registry rides as the plan's default so hook sites
@@ -329,6 +358,20 @@ class SimulatorService:
             tenant=tid)
         self.registry.counter("world_store_h2d_bytes_total").zero_matching(
             tenant=tid)
+        # device-residency families: the tenant's resident lanes die with
+        # the _Tenant object, so its HBM gauges zero NOW (not at the next
+        # ledger reconcile) and its census charge attribution is removed —
+        # the same zero_matching contract as the serving families above
+        self.registry.gauge("tenant_hbm_bytes").zero_matching(tenant=tid)
+        self.registry.gauge("resident_bytes").zero_matching(tenant=tid)
+        self.registry.counter("compile_census_total").zero_matching(
+            tenant=tid)
+        self.census.zero_tenant(tid)
+        if device.LEDGER is not None:
+            # owner-scoped: tenant="" is also how the NON-tenant owners
+            # (world_store / stack_cache / marshal) are tagged — dropping
+            # the default tenant must not deflate their census
+            device.LEDGER.release(owner="tenant_export", tenant=tid)
         # journal families are tenant-labelled too (TenantJournal); its ring
         # died with the _Tenant object, so its series must zero as well
         jt = tid or "default"
@@ -440,6 +483,16 @@ class SimulatorService:
             "window_failures_total",
             help="Batched dispatch windows that failed at dispatch or "
                  "harvest and entered bisection re-dispatch").inc()
+        if device.is_oom(error) and self.slo_dump_dir:
+            # a device OOM is an allocator post-mortem, not a poison world:
+            # persist the per-allocation pprof snapshot BEFORE bisection
+            # churns the heap, next to the SLO/backpressure evidence
+            path = device.dump_memory_profile(
+                self.slo_dump_dir, tag="window-oom", registry=self.registry)
+            if path:
+                with self._events_lock:
+                    self.events.emit("HbmOomDump", "sidecar", "window-oom",
+                                     message=path, now=_time.time())
         self._bisect(tickets, error, budget)
 
     def _bisect(self, tickets: list[Ticket], error: Exception,
@@ -592,6 +645,59 @@ class SimulatorService:
                     f"{section} section carries a negative resource "
                     f"request (min={int(arr['req'].min())})")
         ts.validated_key = key
+
+    # ---- HBM budget admission (docs/OBSERVABILITY.md "Device surfaces") ----
+
+    def _hbm_limit(self) -> int:
+        """The budget denominator: the configured override, else the
+        device's own bytes_limit (probed once — memory_stats is a device
+        call). 0 = unknown (CPU floor without an override) = budget off."""
+        if self.hbm_limit_bytes:
+            return self.hbm_limit_bytes
+        if self._hbm_limit_cache is None:
+            ms = device.memory_stats()
+            self._hbm_limit_cache = int((ms or {}).get("bytes_limit") or 0)
+        return self._hbm_limit_cache
+
+    def _check_hbm_budget(self, ts: _Tenant) -> None:
+        """Projected-residency admission gate; caller holds ts.lock with
+        export_np fresh at class shape. A tenant whose lanes are already
+        resident at the current keys re-admits free (steady path: two dict
+        probes); a tenant about to upload projects its class-shaped export
+        bytes on top of everyone else's live tagged bytes and is rejected
+        with the `hbm-budget` validation reason when the total would breach
+        frac·limit — a loud structured reject instead of an OOM that would
+        take the whole coalescing window (and its innocent co-tenants)
+        down."""
+        if self.hbm_budget_frac <= 0 or device.LEDGER is None:
+            return
+        if ts.dev_keys and all(
+                ts.dev_keys.get(s) == ts.export_keys.get(s)
+                for s in ("nodes", "groups", "pods")):
+            return      # resident at current keys: nothing new to admit
+        limit = self._hbm_limit()
+        if limit <= 0:
+            return      # no denominator (CPU floor, no override): gate off
+        projected = sum(
+            int(v.nbytes)
+            for s in ("nodes", "groups", "pods")
+            for v in ts.export_np.get(s, {}).values())
+        self._hbm_budget_screen(ts.tid, projected, limit)
+
+    def _hbm_budget_screen(self, tid: str, projected: int,
+                           limit: int) -> None:
+        """The shared core: reject when `projected` bytes for `tid` on top
+        of everyone ELSE's live tagged bytes would breach frac·limit."""
+        own = device.LEDGER.tenant_bytes(tid)
+        others = device.LEDGER.tagged_bytes() - own
+        budget = self.hbm_budget_frac * limit
+        if others + projected > budget:
+            raise WorldValidationError(
+                "hbm-budget",
+                f"projected residency {projected}b for tenant "
+                f"{tid or 'default'!r} on top of {others}b already "
+                f"tagged would breach the HBM budget "
+                f"({self.hbm_budget_frac:.2f} x {limit}b = {budget:.0f}b)")
 
     # ---- warm restart: checkpoint + rehydration (docs/ROBUSTNESS.md) ----
 
@@ -863,7 +969,24 @@ class SimulatorService:
                 ts.state, gt, nt.n, ts.aux,
                 max_zones=self.dims.max_zones)
         out = (nt, gt, pt, planes, has_c)
+        if self.hbm_budget_frac > 0 and device.LEDGER is not None:
+            # the serial/constrained tier passes the SAME admission gate as
+            # the batched path. The check is post-assembly (the tier has no
+            # class-shaped projection to price beforehand), so it refuses
+            # RESIDENCY — the over-budget world is neither cached nor
+            # tagged, and the transient arrays die with this call
+            limit = self._hbm_limit()
+            if limit > 0:
+                self._hbm_budget_screen(
+                    ts.tid, device.device_bytes((nt, gt, pt)), limit)
         ts.serial_cache = (key, out)
+        if device.LEDGER is not None:
+            # serial/constrained tenants hold their assembled world
+            # resident too (the version-keyed cache above) — same owner
+            # tag as the batched lanes, so the census sees every tier
+            device.LEDGER.track("tenant_export",
+                                f"{ts.tid or 'default'}/serial",
+                                (nt, gt, pt), tenant=ts.tid)
         return out
 
     def _encode_groups(self, ts: _Tenant, params: SimParams, bucket: int = 8):
@@ -940,7 +1063,12 @@ class SimulatorService:
             out = self._timed_sim(
                 lambda: scale_up_sim(nt, gt, pt, groups, self.dims,
                                      params.max_new_nodes, params.strategy,
-                                     planes=planes, with_constraints=has_c))
+                                     planes=planes, with_constraints=has_c),
+                census=("scale_up_sim", scale_up_sim,
+                        (nt, gt, pt, groups, self.dims,
+                         params.max_new_nodes, params.strategy),
+                        {"planes": planes, "with_constraints": has_c}),
+                tenant=ts.tid if not ts.dispatched else "")
         stamps.dispatched = _time.perf_counter_ns()
         best = int(out.best)
         resp = {
@@ -990,7 +1118,12 @@ class SimulatorService:
                 lambda: scale_down_sim(nt, gt, pt, params.threshold,
                                        planes=planes,
                                        max_zones=self.dims.max_zones,
-                                       with_constraints=has_c))
+                                       with_constraints=has_c),
+                census=("scale_down_sim", scale_down_sim,
+                        (nt, gt, pt, params.threshold),
+                        {"planes": planes, "max_zones": self.dims.max_zones,
+                         "with_constraints": has_c}),
+                tenant=ts.tid if not ts.dispatched else "")
         stamps.dispatched = _time.perf_counter_ns()
         valid = np.asarray(nt.valid)
         resp = {
@@ -1088,6 +1221,14 @@ class SimulatorService:
                                       for k, v in np_dict.items()}
                 ts.dev_keys[section] = key
                 uploaded += sum(int(v.nbytes) for v in np_dict.values())
+                if device.LEDGER is not None:
+                    # HBM residency ledger: the tenant's resident lanes,
+                    # per section (a refreshed section re-registers; the
+                    # old arrays expire from the census by weakref)
+                    device.LEDGER.track(
+                        "tenant_export",
+                        f"{ts.tid or 'default'}/{section}",
+                        ts.dev_np[section], tenant=ts.tid)
         if uploaded:
             labels = {"tenant": ts.tid} if ts.tid else {}
             self.registry.counter("world_store_h2d_bytes_total",
@@ -1142,6 +1283,10 @@ class SimulatorService:
             self._export_np(ts)
             try:
                 self._validate_world(ts)
+                # projected-residency screen rides the same taxonomy: a
+                # world too big for the HBM budget must never reach a
+                # window where its upload OOMs innocent co-tenants
+                self._check_hbm_budget(ts)
             except WorldValidationError as e:
                 self._note_validation_reject(ts.tid, e)
                 raise
@@ -1230,15 +1375,28 @@ class SimulatorService:
                             **({"batch_id": batch_id} if batch_id else {}))
         return resp
 
-    def _timed_sim(self, fn):
+    def _timed_sim(self, fn, census=None, tenant: str = ""):
         """Run one sim dispatch with compile accounting: when the call grew
         a jit cache, its wall clock is (almost entirely) XLA compilation —
         counted as `sim_compiles_total` / `sim_compile_seconds_total` so
         compile stalls on the serving path are a first-class series, not a
-        mystery latency spike."""
+        mystery latency spike.
+
+        `census` = (label, jit_fn, args, kwargs): on a compile, the
+        compile CENSUS records the variant — which entry point, which shape
+        signature, charged to which (fresh) tenant, at what flop/temp-HBM
+        cost — so the bare counters resolve to named executables
+        (metrics/device.CompileCensus; Statusz + /metrics).
+
+        An ARMED device profiler (breach-triggered or Profilez-armed) wraps
+        exactly this dispatch in a bounded jax.profiler.trace session;
+        disarmed costs two loads (the PR 12 guard contract)."""
+        prof = device.PROFILER
+        run = (lambda: prof.capture(fn)[0]) \
+            if prof is not None and prof.armed else fn
         c0 = self._sim_cache_size()
         t0 = _time.perf_counter()
-        out = fn()
+        out = run()
         grew = self._sim_cache_size() - c0
         if grew > 0:
             self.registry.counter(
@@ -1248,6 +1406,9 @@ class SimulatorService:
                 "sim_compile_seconds_total",
                 help="Wall clock of serving dispatches that compiled "
                      "(≈ compile time)").inc(_time.perf_counter() - t0)
+            if census is not None:
+                label, jfn, cargs, ckw = census
+                self.census.record(label, jfn, cargs, ckw, tenant=tenant)
         return out
 
     def _note_reject(self, tenant: str, e: QueueFull) -> None:
@@ -1340,8 +1501,13 @@ class SimulatorService:
         # device arrays (_export_dev), so a stack-cache miss re-stacks
         # on-device and moves no world bytes — uploads were already
         # charged, per dirty section, when the lanes refreshed.
-        with self._recompile_charge([self._tenant(t.tenant)
-                                     for t in tickets]):
+        tenant_objs = [self._tenant(t.tenant) for t in tickets]
+        # census attribution: a compile in this window is charged to the
+        # fresh tenant it first serves (the recompiles_per_new_tenant
+        # contract, now carrying a NAME); steady windows charge nobody
+        fresh_tenant = next(
+            (o.tid for o in tenant_objs if not o.dispatched), "")
+        with self._recompile_charge(tenant_objs):
             if faults.PLAN is not None:
                 faults.PLAN.fire("dispatch", tenants=tenants,
                                  registry=self.registry)
@@ -1352,7 +1518,11 @@ class SimulatorService:
                 _, _, _, max_new_nodes, strategy = key
                 out = self._timed_sim(
                     lambda: a.scale_up_sim_batch(nt, gt, pt, gr, self.dims,
-                                                 max_new_nodes, strategy))
+                                                 max_new_nodes, strategy),
+                    census=("scale_up_sim_batch", a.scale_up_sim_batch,
+                            (nt, gt, pt, gr, self.dims, max_new_nodes,
+                             strategy), {}),
+                    tenant=fresh_tenant)
                 fetch_tree = {
                     "best": out.best,
                     "node_count": out.estimate.node_count,
@@ -1373,7 +1543,11 @@ class SimulatorService:
                     [ln.threshold for ln in lanes_list], jnp.float32)
                 out = self._timed_sim(
                     lambda: a.scale_down_sim_batch(
-                        nt, gt, pt, th, max_zones=self.dims.max_zones))
+                        nt, gt, pt, th, max_zones=self.dims.max_zones),
+                    census=("scale_down_sim_batch", a.scale_down_sim_batch,
+                            (nt, gt, pt, th),
+                            {"max_zones": self.dims.max_zones}),
+                    tenant=fresh_tenant)
                 fetch_tree = {
                     "eligible": out.eligible,
                     "drainable": out.removal.drainable,
@@ -1430,6 +1604,44 @@ class SimulatorService:
             on_failure=on_failure,
             on_member_fault=lambda t, e: self._quarantine_tenant(
                 t.tenant, self._fault_reason(e), error=e))
+
+    def hbm_stats(self) -> dict:
+        """The residency-ledger reconciliation, published into this
+        service's registry: tagged census per (owner, tenant), the device's
+        own totals (hbm_bytes_in_use/limit/headroom) — or the host-RSS
+        fallback with `source: host-fallback` on backends without
+        memory_stats. Never null (the bench --device-stats contract)."""
+        if device.LEDGER is None:
+            return {"source": "disabled"}
+        return device.LEDGER.reconcile(registry=self.registry,
+                                       hbm_limit_bytes=self.hbm_limit_bytes)
+
+    def profilez(self, payload: bytes = b"") -> dict:
+        """The armed-handle device-profiler RPC (the /snapshotz pattern):
+        body `{"arm": true, "reason": "..."}` arms the profiler — the NEXT
+        sim dispatch is captured into a trace-id-stamped directory; an
+        empty body just reports state. Rate limits apply to manual arms
+        exactly like breach arms."""
+        req = {}
+        if payload:
+            try:
+                req = json.loads(payload.decode() or "{}")
+            except ValueError:
+                return {"enabled": device.PROFILER is not None,
+                        "error": "malformed Profilez body (want JSON)"}
+        prof = device.PROFILER
+        if prof is None:
+            return {"enabled": False,
+                    "error": "no device profiler installed "
+                             "(--device-profile-dir)"}
+        out = {"enabled": True}
+        if req.get("arm"):
+            tracer = trace.current_tracer()
+            out["armed_now"] = prof.arm(
+                str(req.get("reason") or "manual"),
+                trace_id=tracer.trace_id if tracer else "")
+        out.update(prof.stats())
+        return out
 
     def batch_stats(self) -> dict:
         """Bench/ops view of the batching layer."""
@@ -1608,6 +1820,43 @@ class SimulatorService:
             f"compile_s={self.registry.counter('sim_compile_seconds_total').value():.3f} "
             f"h2d_bytes={xfer.value(direction='h2d'):.0f} "
             f"d2h_bytes={xfer.value(direction='d2h'):.0f}")
+        # HBM residency ledger: tagged census vs device totals, per owner
+        # component and tenant (docs/OBSERVABILITY.md "Device surfaces")
+        hs = self.hbm_stats()
+        if hs.get("source") == "disabled":
+            lines.append("hbm: ledger disabled")
+        else:
+            head = hs.get("headroom_ratio")
+            lines.append(
+                f"hbm: source={hs['source']} in_use={hs['bytes_in_use']} "
+                f"limit={hs['bytes_limit']} tagged={hs['tagged_bytes']} "
+                f"untagged={hs['untagged_bytes']} "
+                f"headroom={f'{head:.3f}' if head is not None else '-'} "
+                f"budget_frac={self.hbm_budget_frac or '-'} "
+                f"budget_rejects={self.registry.counter('world_validation_rejects_total').value(reason='hbm-budget'):.0f}")
+            for k, v in hs.get("by_owner_tenant", {}).items():
+                lines.append(f"  {k:<28} {v} bytes")
+        # compile census: named variants instead of a bare compile count
+        variants = self.census.variants()
+        lines.append(f"compile census: {len(variants)} variants "
+                     f"(mode={self.census.mode})")
+        for e in variants[:16]:
+            lines.append(
+                f"  {e['fn']:<22} sig={e['shape_sig']:<22} "
+                f"compiles={e['compiles']} "
+                f"tenants={','.join(e['tenants']) or '-'}"
+                + (f" flops={e['flops']:.3g}" if "flops" in e else "")
+                + (f" temp_b={e['temp_bytes']}" if "temp_bytes" in e else ""))
+        prof = device.PROFILER
+        if prof is not None:
+            ps = prof.stats()
+            lines.append(
+                f"profiler: dir={ps['dir']} armed={ps['armed']} "
+                f"captures={ps['captures']}/{ps['max_captures']} "
+                f"throttled={ps['throttled']}"
+                + (f" last={ps['last']['path']}" if ps["last"] else ""))
+        else:
+            lines.append("profiler: disabled")
         # world-store section: encode modes aggregated across resident
         # tenants (delta = plane-granular refresh reused resident sections)
         emodes: dict[str, int] = {}
@@ -1664,6 +1913,16 @@ class SimulatorService:
                 if cur is not None:
                     snap["journal_seq"], snap["journal_digest"] = cur
             exemplar = self.tail.offer(snap, dt_s, reason)
+            if exemplar and device.PROFILER is not None:
+                # tail retention arms the device profiler: the NEXT sim
+                # dispatch runs under a bounded jax.profiler.trace session
+                # whose capture dir is stamped with THIS retained trace id
+                # + journal cursor (rate-limited inside arm(); a throttled
+                # arm is a counter bump, not a capture)
+                cur = (snap.get("journal_seq"), snap.get("journal_digest"))
+                device.PROFILER.arm(
+                    reason or "slow", trace_id=exemplar,
+                    journal_cursor=cur if cur[0] is not None else None)
         else:
             self.tail.observe_latency(dt_s)
         if reason in ("slo_breach", "backpressure") and self.slo_dump_dir \
@@ -1919,6 +2178,13 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
                               sample=False)
         return text.encode()
 
+    def _profilez(request: bytes, context):
+        resp, _ = traced_call(service, "Profilez",
+                              lambda: service.profilez(request),
+                              trace_id=_meta_of(context, TRACE_ID_HEADER),
+                              sample=False)
+        return json.dumps(resp).encode()
+
     ident = lambda b: b
 
     method_handlers = {
@@ -1940,6 +2206,9 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
             _metricz, request_deserializer=ident, response_serializer=ident),
         "Statusz": grpc.unary_unary_rpc_method_handler(
             _statusz, request_deserializer=ident, response_serializer=ident),
+        "Profilez": grpc.unary_unary_rpc_method_handler(
+            _profilez, request_deserializer=ident,
+            response_serializer=ident),
     }
     from concurrent.futures import ThreadPoolExecutor
 
@@ -2326,6 +2595,12 @@ class SimulatorClient:
         classes, dispatch gaps, tail-sampler budget)."""
         return self._call("Statusz", b"").decode()
 
+    def profilez(self, arm: bool = False, reason: str = "manual") -> dict:
+        """Device-profiler state; `arm=True` arms a capture of the NEXT
+        sim dispatch (the /snapshotz armed-handle pattern, rate-limited)."""
+        return self._call_json(
+            "Profilez", json.dumps({"arm": arm, "reason": reason}).encode())
+
 
 def main(argv=None):
     """Standalone sidecar: python -m kubernetes_autoscaler_tpu.sidecar.server
@@ -2360,6 +2635,22 @@ def main(argv=None):
     ap.add_argument("--quarantine-ttl-s", type=float, default=30.0,
                     help="poison-tenant quarantine sentence before "
                          "auto-parole")
+    ap.add_argument("--hbm-budget-frac", type=float, default=0.0,
+                    help="HBM admission budget as a fraction of the device "
+                         "memory limit: a new tenant whose projected "
+                         "residency would push tagged bytes past it is "
+                         "rejected with the hbm-budget validation reason "
+                         "(0 = gate off)")
+    ap.add_argument("--hbm-limit-bytes", type=int, default=0,
+                    help="budget denominator override for backends without "
+                         "memory_stats (0 = use the device's bytes_limit)")
+    ap.add_argument("--device-profile-dir", default="",
+                    help="enable breach-triggered device profiling: SLO-"
+                         "breach/tail-retained requests (or the Profilez "
+                         "RPC) arm a bounded, rate-limited "
+                         "jax.profiler.trace capture into this directory, "
+                         "stamped with the retained trace id + journal "
+                         "cursor")
     ap.add_argument("--grpc-cert", default="")
     ap.add_argument("--grpc-key", default="")
     ap.add_argument("--grpc-client-ca", default="")
@@ -2380,7 +2671,10 @@ def main(argv=None):
                                batch_window_max=args.batch_window_max or None,
                                queue_depth=args.queue_depth,
                                quarantine_ttl_s=args.quarantine_ttl_s,
-                               rehydrate_dir=args.checkpoint_dir)
+                               rehydrate_dir=args.checkpoint_dir,
+                               hbm_budget_frac=args.hbm_budget_frac,
+                               hbm_limit_bytes=args.hbm_limit_bytes,
+                               device_profile_dir=args.device_profile_dir)
     if args.checkpoint_dir and service.rehydration["restored"]:
         print(f"katpu-sidecar rehydrated "
               f"{service.rehydration['restored']} tenants from "

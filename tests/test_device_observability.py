@@ -1,0 +1,569 @@
+"""Device-side observability (ISSUE 14, metrics/device.py +
+docs/OBSERVABILITY.md "Device surfaces"): the HBM residency ledger
+(owner/tenant census, weakref expiry, reconciliation, drop_tenant sweep),
+the hbm-budget admission reject, the compile census, the leak watchdog,
+the breach-armed device profiler, OOM pprof evidence on a failed
+RunOnceStatus, and the disabled-path guard cost."""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_autoscaler_tpu.metrics import device
+from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+from kubernetes_autoscaler_tpu.sidecar import faults
+from kubernetes_autoscaler_tpu.sidecar.admission import WorldValidationError
+from kubernetes_autoscaler_tpu.sidecar.server import (
+    SimParams,
+    SimulatorService,
+)
+from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import (
+    build_test_node,
+    build_test_pod,
+)
+
+from test_runonce import make_options
+
+NGS = [{"id": "ng1", "template": {"name": "t", "capacity": {
+    "cpu": 4.0, "memory": 16384 * 1024 * 1024, "pods": 110}},
+    "max_new": 32, "price": 1.0}]
+
+
+def autoscaler_for(fake, **opts):
+    """Like test_runonce.autoscaler_for but with an ISOLATED registry:
+    these tests bump shared-absolute counters (loop_slo_breaches_total,
+    errors_total) that other files assert exact values for on the default
+    registry."""
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import (
+        StaticAutoscaler,
+    )
+
+    return StaticAutoscaler(fake.provider, fake, options=make_options(**opts),
+                            eviction_sink=fake, registry=Registry())
+
+
+@pytest.fixture(autouse=True)
+def _device_globals():
+    """The ledger and profiler are process globals (the PR 12 fault-plane
+    pattern): every test starts with a FRESH ledger (no census bleed from
+    other test files sharing the process) and leaves no armed profiler."""
+    faults.clear()
+    device.LEDGER = device.ResidencyLedger()
+    device.uninstall_profiler()
+    yield
+    faults.clear()
+    device.LEDGER = device.ResidencyLedger()
+    device.uninstall_profiler()
+
+
+def tenant_delta(i: int, nodes: int = 8, pods: int = 20) -> bytes:
+    w = DeltaWriter()
+    for k in range(nodes):
+        w.upsert_node(build_test_node(f"x{i}-n{k}", cpu_milli=2000,
+                                      mem_mib=8192, pods=110))
+    for k in range(pods):
+        w.upsert_pod(build_test_pod(
+            f"x{i}-p{k}", cpu_milli=300, mem_mib=256,
+            owner_name=f"x{i}-rs{k % 3}",
+            node_name=f"x{i}-n{k % nodes}" if k % 3 == 0 else ""))
+    return w.payload()
+
+
+# ------------------------------------------------------------- the ledger
+
+
+def test_ledger_census_tracks_and_expires_by_weakref():
+    led = device.ResidencyLedger()
+    a = jnp.ones((64, 64), jnp.float32)          # 16 KiB
+    b = {"x": jnp.ones((32,), jnp.int32)}
+    led.track("world_store", "plane-a", a)
+    led.track("tenant_export", "t1/nodes", b, tenant="t1")
+    c = led.census()
+    assert c["by_owner_tenant"][("world_store", "")] == a.nbytes
+    assert c["by_owner_tenant"][("tenant_export", "t1")] == 128
+    assert c["tagged_bytes"] == a.nbytes + 128
+    assert led.tenant_bytes("t1") == 128
+    # re-tracking a key REPLACES the registration, never double-counts
+    led.track("world_store", "plane-a", a)
+    assert led.census()["tagged_bytes"] == a.nbytes + 128
+    # a freed buffer falls out of the census by itself
+    del b
+    assert led.census()["by_owner_tenant"].get(("tenant_export", "t1")) \
+        in (None, 0)
+    assert led.tenant_bytes("t1") == 0
+    # explicit release drops the remaining entry
+    assert led.release(owner="world_store") == 1
+    assert led.census()["tagged_bytes"] == 0
+
+
+def test_ledger_ignores_host_numpy_leaves():
+    import numpy as np
+
+    led = device.ResidencyLedger()
+    dev = jnp.ones((8,), jnp.float32)
+    led.track("marshal", "mixed", {"dev": dev, "host": np.ones((1 << 20,))})
+    assert led.census()["tagged_bytes"] == 32   # only the device leaf
+    del dev
+
+
+def test_reconcile_publishes_gauges_and_zeroes_stale_series():
+    led = device.ResidencyLedger()
+    reg = Registry()
+    arr = jnp.ones((16, 16), jnp.float32)
+    led.track("stack_cache", "k1", arr)
+    led.track("tenant_export", "t9/nodes", arr, tenant="t9")
+    rec = led.reconcile(registry=reg)
+    # on the CPU backend memory_stats is absent: never-null host fallback
+    assert rec["source"] in ("device", "host-fallback")
+    assert rec["bytes_in_use"] > 0
+    assert rec["tagged_bytes"] == 2 * arr.nbytes
+    assert rec["untagged_bytes"] == max(
+        rec["bytes_in_use"] - rec["tagged_bytes"], 0)
+    assert reg.gauge("resident_bytes").value(
+        owner="tenant_export", tenant="t9") == arr.nbytes
+    assert reg.gauge("tenant_hbm_bytes").value(tenant="t9") == arr.nbytes
+    # the tenant's residency vanishes -> the next reconcile zeroes its
+    # series instead of letting them linger (the stale-label convention)
+    led.release(tenant="t9")
+    led.reconcile(registry=reg)
+    assert reg.gauge("resident_bytes").value(
+        owner="tenant_export", tenant="t9") == 0.0
+    assert reg.gauge("tenant_hbm_bytes").value(tenant="t9") == 0.0
+    assert reg.gauge("resident_bytes").value(
+        owner="stack_cache", tenant="default") == arr.nbytes
+
+
+def test_headroom_ratio_with_synthetic_limit():
+    led = device.ResidencyLedger()
+    rec = led.reconcile(hbm_limit_bytes=10 * rec_in_use_floor())
+    assert rec["bytes_limit"] == 10 * rec_in_use_floor()
+    assert rec["headroom_ratio"] is not None
+    assert 0.0 < rec["headroom_ratio"] < 1.0
+
+
+def rec_in_use_floor() -> int:
+    """A denominator comfortably above the process's RSS so the synthetic
+    headroom lands strictly inside (0, 1)."""
+    return max(device.host_rss_bytes(), 1 << 20)
+
+
+def test_world_store_planes_are_tagged():
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("n1", cpu_milli=4000,
+                                                  mem_mib=8192))
+    fake.add_pod(build_test_pod("p0", cpu_milli=500, mem_mib=256,
+                                owner_name="rs", node_name="n1"))
+    a = autoscaler_for(fake)
+    a.run_once(now=1000.0)
+    c = device.LEDGER.census()
+    ws = c["by_owner_tenant"].get(("world_store", ""), 0)
+    assert ws > 0, c["by_owner_tenant"]
+    # the per-loop reconcile published the census into the metrics registry
+    assert a.metrics.gauge("resident_bytes").value(
+        owner="world_store", tenant="default") > 0
+    assert a.last_hbm_report is not None
+    assert a.last_hbm_report["source"] in ("device", "host-fallback")
+    # device loss drops the owner's entries with the device state
+    a._world_store.device_store.drop_device_state()
+    assert device.LEDGER.census()["by_owner_tenant"].get(
+        ("world_store", ""), 0) == 0
+
+
+# ---------------------------------------------------- hbm-budget admission
+
+
+def test_hbm_budget_rejects_new_tenant_without_harming_innocents():
+    svc = SimulatorService(node_bucket=16, group_bucket=16, batch_lanes=2,
+                           batch_window_ms=5.0)
+    try:
+        assert svc.apply_delta(tenant_delta(0), tenant="ta")["error"] == ""
+        r = svc.scale_up_sim(SimParams(max_new_nodes=16, node_groups=NGS),
+                             tenant="ta")
+        r.pop("lifecycle", None)      # timings differ call to call
+        assert r["best"] is not None
+        # shrink the budget under the standing residency: the NEXT tenant's
+        # projected class-shaped export cannot fit
+        svc.hbm_budget_frac = 1e-12
+        svc.hbm_limit_bytes = 1
+        svc._hbm_limit_cache = None
+        assert svc.apply_delta(tenant_delta(1), tenant="tb")["error"] == ""
+        with pytest.raises(WorldValidationError) as ei:
+            svc.scale_up_sim(SimParams(max_new_nodes=16, node_groups=NGS),
+                             tenant="tb")
+        assert ei.value.reason == "hbm-budget"
+        assert svc.registry.counter(
+            "world_validation_rejects_total").value(reason="hbm-budget") == 1
+        # no OOM, no quarantine of innocents: ta (resident at its current
+        # keys) re-admits THROUGH the active gate, tb is not quarantined
+        r2 = svc.scale_up_sim(SimParams(max_new_nodes=16, node_groups=NGS),
+                              tenant="ta")
+        r2.pop("lifecycle", None)
+        assert r2 == r
+        assert svc.quarantine_stats() == {}
+        # the reject is on the event sink with the taxonomy reason
+        with svc._events_lock:
+            evs = svc.events.snapshot()
+        assert any(e["kind"] == "WorldValidationReject"
+                   and e["reason"] == "hbm-budget" for e in evs)
+    finally:
+        svc.close()
+
+
+def test_hbm_budget_gate_off_without_limit():
+    """No denominator (CPU floor, no override) = gate off: admission never
+    rejects on a backend that cannot report a limit."""
+    svc = SimulatorService(node_bucket=16, group_bucket=16, batch_lanes=2,
+                           batch_window_ms=5.0)
+    try:
+        svc.hbm_budget_frac = 0.9       # frac set, but limit unknown on CPU
+        assert svc.apply_delta(tenant_delta(3), tenant="tc")["error"] == ""
+        if device.memory_stats() is None:
+            r = svc.scale_up_sim(SimParams(max_new_nodes=16,
+                                           node_groups=NGS), tenant="tc")
+            assert "best" in r
+    finally:
+        svc.close()
+
+
+def test_reconcile_zeroes_stale_series_per_registry():
+    """The one process ledger reconciles into BOTH the control loop's and
+    the sidecar's registries: each registry's stale series must be zeroed
+    on ITS next reconcile, regardless of which reconciled first."""
+    led = device.ResidencyLedger()
+    ra, rb = Registry(), Registry()
+    arr = jnp.ones((8, 8), jnp.float32)
+    led.track("tenant_export", "tx/nodes", arr, tenant="tx")
+    led.reconcile(registry=ra)
+    led.reconcile(registry=rb)
+    led.release(tenant="tx")
+    led.reconcile(registry=ra)          # ra zeroed first...
+    led.reconcile(registry=rb)          # ...rb must STILL be zeroed
+    for reg in (ra, rb):
+        assert reg.gauge("tenant_hbm_bytes").value(tenant="tx") == 0.0
+        assert reg.gauge("resident_bytes").value(
+            owner="tenant_export", tenant="tx") == 0.0
+
+
+def test_hbm_budget_gates_serial_tier_and_refuses_residency():
+    """Review fix: the serial/constrained tier passes the same admission
+    gate — an over-budget world is rejected with the hbm-budget reason and
+    neither cached nor tagged into the ledger."""
+    svc = SimulatorService(node_bucket=16, group_bucket=16)   # no batching
+    try:
+        assert svc.apply_delta(tenant_delta(8), tenant="ts")["error"] == ""
+        svc.hbm_budget_frac = 1e-12
+        svc.hbm_limit_bytes = 1
+        svc._hbm_limit_cache = None
+        with pytest.raises(WorldValidationError) as ei:
+            svc.scale_up_sim(SimParams(max_new_nodes=16, node_groups=NGS),
+                             tenant="ts")
+        assert ei.value.reason == "hbm-budget"
+        assert svc.registry.counter(
+            "world_validation_rejects_total").value(reason="hbm-budget") == 1
+        ts = svc._tenant_peek("ts")
+        assert ts.serial_cache is None          # residency refused
+        assert device.LEDGER.tenant_bytes("ts") == 0
+        # lifting the budget admits the same tenant cleanly
+        svc.hbm_budget_frac = 0.0
+        r = svc.scale_up_sim(SimParams(max_new_nodes=16, node_groups=NGS),
+                             tenant="ts")
+        assert "best" in r
+        assert device.LEDGER.tenant_bytes("ts") > 0
+    finally:
+        svc.close()
+
+
+def test_drop_default_tenant_preserves_non_tenant_owners():
+    """Review fix: drop_tenant('') must release only the default tenant's
+    tenant_export entries — world_store/stack_cache/marshal registrations
+    also carry tenant '' and must survive (no census deflation, no false
+    leak-watchdog streak)."""
+    svc = SimulatorService(node_bucket=16, group_bucket=16)
+    try:
+        arr = jnp.ones((32, 32), jnp.float32)
+        device.LEDGER.track("world_store", "plane", arr)
+        device.LEDGER.track("stack_cache", "k", arr)
+        assert svc.apply_delta(tenant_delta(9), tenant="")["error"] == ""
+        svc.scale_up_sim(SimParams(max_new_nodes=16, node_groups=NGS))
+        assert device.LEDGER.tenant_bytes("") > 2 * arr.nbytes
+        assert svc.drop_tenant("")
+        by = device.LEDGER.census()["by_owner_tenant"]
+        assert by.get(("world_store", "")) == arr.nbytes
+        assert by.get(("stack_cache", "")) == arr.nbytes
+        assert ("tenant_export", "") not in by
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------------- compile census
+
+
+def test_compile_census_names_variant_and_tenant():
+    reg = Registry()
+    census = device.CompileCensus(registry=reg)
+    f = jax.jit(lambda x: x * 2.0 + 1.0)
+    x = jnp.ones((5, 7), jnp.float32)
+    out = census.dispatch("toy", f, (x,), tenant="tnew")
+    assert out.shape == (5, 7)
+    variants = census.variants()
+    assert len(variants) == 1
+    v = variants[0]
+    assert v["fn"] == "toy" and v["compiles"] == 1
+    assert v["shape_sig"].startswith("5x7/")
+    assert v["tenants"] == ["tnew"]
+    assert v.get("flops", 0) > 0                 # cost_analysis landed
+    assert "temp_bytes" in v                     # memory_analysis landed
+    assert reg.counter("compile_census_total").value(
+        fn="toy", shape_sig=v["shape_sig"], tenant="tnew") == 1
+    # a steady re-dispatch at the same shape compiles nothing
+    census.dispatch("toy", f, (x,), tenant="tnew")
+    assert census.variants()[0]["compiles"] == 1
+    # a NEW shape is a NEW named variant
+    census.dispatch("toy", f, (jnp.ones((3, 3)),), tenant="")
+    sigs = {v["shape_sig"] for v in census.variants()}
+    assert len(sigs) == 2
+    # drop sweep removes the tenant's charge attribution
+    census.zero_tenant("tnew")
+    assert all(v["tenants"] == [] for v in census.variants())
+
+
+def test_sidecar_census_charges_fresh_tenant_on_cold_service():
+    """The serving integration: a cold service's first batched dispatch
+    compiles, and the census entry names the shape signature AND the fresh
+    tenant the compile was charged to (recompiles_per_new_tenant resolved
+    to a name). Distinct world/lane shapes make the program cold even when
+    other tests warmed the module-level jit caches."""
+    svc = SimulatorService(node_bucket=32, group_bucket=32, batch_lanes=3,
+                           batch_window_ms=5.0)
+    try:
+        assert svc.apply_delta(tenant_delta(7, nodes=11, pods=40),
+                               tenant="tz")["error"] == ""
+        svc.scale_up_sim(SimParams(max_new_nodes=17, node_groups=NGS),
+                         tenant="tz")
+        ups = [v for v in svc.census.variants()
+               if v["fn"] == "scale_up_sim_batch"]
+        assert ups and ups[0]["compiles"] >= 1
+        assert ups[0]["tenants"] == ["tz"]
+        assert svc.registry.counter("compile_census_total").value(
+            fn="scale_up_sim_batch", shape_sig=ups[0]["shape_sig"],
+            tenant="tz") >= 1
+        # the statusz page names the variant
+        assert "compile census" in svc.statusz()
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------- leak watchdog
+
+
+def test_leak_watchdog_fires_within_k_loops_and_resets():
+    reg = Registry()
+    wd = device.LeakWatchdog(k=3, min_growth_bytes=1 << 20, registry=reg)
+    base = 100 << 20
+    assert wd.observe(base) is None              # first sample: baseline
+    assert wd.observe(base + (2 << 20)) is None  # streak 1
+    assert wd.observe(base + (4 << 20)) is None  # streak 2
+    report = wd.observe(base + (6 << 20))        # streak 3 == k: fire
+    assert report is not None
+    assert report["loops"] == 3
+    assert report["grew_bytes"] == 6 << 20
+    assert reg.counter("hbm_leak_suspects_total").value() == 1
+    # the streak restarts after firing: no once-per-loop alarm storm
+    assert wd.observe(base + (8 << 20)) is None
+    # sub-threshold jitter RESETS the streak
+    assert wd.observe(base + (8 << 20) + 100) is None
+    assert wd.observe(base + (10 << 20)) is None
+    assert wd.observe(base + (12 << 20)) is None
+    assert wd.observe(base + (14 << 20)) is not None
+
+
+def test_synthetic_leak_fires_watchdog_through_the_loop(tmp_path):
+    """End to end: untagged device growth (simulated via a patched
+    reconcile source) fires within K loops — event on the sink, flight
+    recorder dumped with reason hbm_leak."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("n1", cpu_milli=4000,
+                                                  mem_mib=8192))
+    a = autoscaler_for(fake, hbm_watchdog_loops=3,
+                       flight_recorder_dir=str(tmp_path))
+    # synthetic leak: monotonic untagged growth, +8 MiB per loop
+    leak = {"n": 0}
+    real_rss = device.host_rss_bytes
+
+    def leaking_rss():
+        leak["n"] += 1
+        return real_rss() + leak["n"] * (8 << 20)
+
+    device.host_rss_bytes, saved = leaking_rss, device.host_rss_bytes
+    try:
+        for i in range(5):
+            a.run_once(now=1000.0 + i)
+    finally:
+        device.host_rss_bytes = saved
+    assert a.metrics.counter("hbm_leak_suspects_total").value() >= 1
+    assert a._hbm_watchdog.fired >= 1
+    evs = a.event_sink.snapshot()
+    assert any(e["kind"] == "HbmLeakSuspect" for e in evs), evs
+    dumps = [f for f in os.listdir(tmp_path) if f.endswith(".trace.json")]
+    assert dumps, "the leak must dump the flight ring"
+    assert a.metrics.counter("flight_recorder_dumps_total").value(
+        reason="hbm_leak") >= 1
+
+
+# -------------------------------------------------------- device profiler
+
+
+def test_profiler_arm_capture_meta_and_rate_limit(tmp_path):
+    clock = {"t": 0.0}
+    prof = device.DeviceProfiler(str(tmp_path), min_interval_s=60.0,
+                                 max_captures=2, registry=Registry(),
+                                 clock=lambda: clock["t"])
+    assert prof.arm("slo_breach", trace_id="abc123",
+                    journal_cursor=(7, "d1g3st"))
+    assert prof.armed
+    assert not prof.arm("slow")          # one armed session at a time
+    assert prof.throttled == 1
+    out, path = prof.capture(lambda: jnp.dot(
+        jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready())
+    assert out is not None and path is not None
+    assert "abc123" in path
+    meta = json.load(open(os.path.join(path, "meta.json")))
+    assert meta["reason"] == "slo_breach"
+    assert meta["trace_id"] == "abc123"
+    assert meta["journal_cursor"] == [7, "d1g3st"]
+    # the profiler actually produced device-timeline artifacts
+    produced = [f for root, _d, fs in os.walk(path) for f in fs]
+    assert any(f != "meta.json" for f in produced), produced
+    # rate limit: inside the interval every arm is throttled
+    assert not prof.arm("slow")
+    clock["t"] = 61.0
+    assert prof.arm("slow", trace_id="def")
+    _out, _path = prof.capture(lambda: 1)
+    clock["t"] = 200.0
+    assert not prof.arm("slow")          # max_captures spent
+    assert prof.stats()["captures"] == 2
+    assert prof.registry.counter("device_profile_captures_total").value(
+        reason="slo_breach") == 1
+
+
+def test_tail_retention_arms_profiler_in_sidecar(tmp_path):
+    svc = SimulatorService(node_bucket=16, group_bucket=16,
+                           device_profile_dir=str(tmp_path),
+                           profile_min_interval_s=0.0,
+                           slo_default_budget_ms=1e-6)
+    try:
+        from kubernetes_autoscaler_tpu.sidecar.server import traced_call
+
+        assert svc.apply_delta(tenant_delta(5), tenant="tp")["error"] == ""
+        # every request breaches the absurd budget -> retained -> armed
+        traced_call(svc, "ScaleUpSim",
+                    lambda: svc.scale_up_sim(
+                        SimParams(max_new_nodes=16, node_groups=NGS),
+                        tenant="tp"), tenant="tp")
+        assert device.PROFILER is not None and device.PROFILER.armed
+        # the next dispatch is captured; the capture dir carries the
+        # RETAINED trace id
+        traced_call(svc, "ScaleUpSim",
+                    lambda: svc.scale_up_sim(
+                        SimParams(max_new_nodes=16, node_groups=NGS),
+                        tenant="tp"), tenant="tp")
+        st = device.PROFILER.stats()
+        assert st["captures"] >= 1
+        assert st["last"]["trace_id"] in st["last"]["path"]
+        assert st["last"]["reason"] in ("slo_breach", "slow")
+        # Profilez reports the capture; a manual arm works through it too
+        pz = svc.profilez(b"")
+        assert pz["enabled"] and pz["captures"] >= 1
+    finally:
+        svc.close()
+
+
+def test_disabled_path_guard_ns():
+    """The PR 12 zero-overhead contract for the device layer: with the
+    ledger and profiler OFF, each hot-path site costs one module-global
+    load + identity test — bounded in ns/op like the fault-plane guard."""
+    device.disable_ledger()
+    device.uninstall_profiler()
+    iters = 200_000
+    t0 = time.perf_counter_ns()
+    for _ in range(iters):
+        if device.LEDGER is not None:  # pragma: no cover
+            raise AssertionError("disabled ledger fired")
+        if device.PROFILER is not None:  # pragma: no cover
+            raise AssertionError("disabled profiler fired")
+    per_op = (time.perf_counter_ns() - t0) / iters
+    assert per_op < 1000.0, f"guard cost {per_op:.0f}ns/op"
+
+
+# ------------------------------------------------------------ OOM evidence
+
+
+def test_is_oom_classifier():
+    assert device.is_oom(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "17179869184 bytes"))
+    assert device.is_oom(RuntimeError("OOM when allocating tensor"))
+    assert not device.is_oom(ValueError("shape mismatch"))
+
+
+def test_oom_dump_surfaces_on_failed_runonce_status(tmp_path):
+    """ISSUE 14 satellite: a device RESOURCE_EXHAUSTED during dispatch
+    dumps a save_device_memory_profile pprof snapshot next to the
+    flight-recorder dir BEFORE the supervisor ladder takes over, and the
+    path rides the failed RunOnceStatus."""
+    from kubernetes_autoscaler_tpu.core.loop import LoopTrigger, run_loop
+
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("n1", cpu_milli=4000,
+                                                  mem_mib=8192))
+    fake.add_pod(build_test_pod("p0", cpu_milli=500, mem_mib=256,
+                                owner_name="rs"))
+    a = autoscaler_for(fake, flight_recorder_dir=str(tmp_path))
+    a.run_once(now=999.0)           # warm: the fault must hit a dispatch
+    faults.install([{"hook": "local_dispatch", "times": 1,
+                     "message": "RESOURCE_EXHAUSTED: Out of memory while "
+                                "trying to allocate 34359738368 bytes"}],
+                   seed=14, registry=a.metrics)
+    history = run_loop(a, LoopTrigger(scan_interval_s=0.01),
+                       max_iterations=2, error_backoff_initial_s=0.01)
+    assert not history[0].ran
+    assert "RESOURCE_EXHAUSTED" in history[0].error
+    assert history[0].hbm_dump_path, "the OOM evidence path must surface"
+    assert os.path.exists(history[0].hbm_dump_path)
+    assert os.path.getsize(history[0].hbm_dump_path) > 0
+    assert history[0].hbm_dump_path.endswith(".pprof")
+    assert a.metrics.counter("hbm_oom_dumps_total").value() == 1
+    # the loop recovered; the recovered loop carries no stale dump path
+    assert history[1].ran and history[1].hbm_dump_path == ""
+    evs = a.event_sink.snapshot()
+    assert any(e["kind"] == "HbmOomDump" for e in evs), evs
+
+
+def test_loop_slo_breach_arms_profiler_and_captures_next_loop(tmp_path):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=1, max_size=10)
+    fake.add_existing_node("ng1", build_test_node("n1", cpu_milli=4000,
+                                                  mem_mib=8192))
+    a = autoscaler_for(fake, device_profile_dir=str(tmp_path),
+                       loop_wallclock_budget_s=1e-9)   # every loop breaches
+    a.run_once(now=1000.0)
+    assert device.PROFILER is not None and device.PROFILER.armed
+    a.run_once(now=1001.0)          # the armed loop runs under the profiler
+    st = device.PROFILER.stats()
+    assert st["captures"] == 1
+    meta = json.load(open(os.path.join(st["last"]["path"], "meta.json")))
+    assert meta["reason"] == "loop_slo_breach"
+    assert meta["trace_id"]          # stamped with the breaching loop's id

@@ -130,7 +130,12 @@ def test_main_scenario_end_to_end(tmp_path):
     def run():
         rc_holder.append(main([
             "--scenario", str(path),
-            "--max-iterations", "2",
+            # enough post-first-status loops that the poller below cannot
+            # miss the serving window: with warm jit caches (late in the
+            # suite) everything after loop 1 runs in ~0.1s/loop, and at 2
+            # iterations the window between the first status write and
+            # process exit occasionally undercut the poll cadence (flake)
+            "--max-iterations", "8",
             "--scan-interval", "50ms",
             "--address", f"127.0.0.1:{port}",
             "--leader-elect-lease-file", str(tmp_path / "lease.lock"),
@@ -157,7 +162,7 @@ def test_main_scenario_end_to_end(tmp_path):
                     break
         except Exception:
             pass
-        time.sleep(0.2)
+        time.sleep(0.05)
     t.join(timeout=120)
     assert rc_holder == [0]
     assert status_doc is not None

@@ -308,11 +308,20 @@ def test_drop_tenant_zeroes_all_serving_series():
         assert hits.value(tenant="a", shape_class=sc) > 0
         assert phases.count(phase="encode", tenant="a") == 2
         assert breaches.value(tenant="a") == 1
+        # ISSUE 14 satellite: publish the residency gauges, then the sweep
+        # must zero the device families too (Gauge.zero_matching)
+        svc.hbm_stats()
+        hbm = svc.registry.gauge("tenant_hbm_bytes")
+        assert hbm.value(tenant="a") > 0
+        assert svc.registry.gauge("resident_bytes").value(
+            owner="tenant_export", tenant="a") > 0
         assert svc.drop_tenant("a")
         text = svc.registry.expose_text()
         for family in ("shape_class_hit_total", "shape_class_miss_total",
                        "request_phase_seconds", "tenant_slo_breaches_total",
-                       "rpc_total", "rpc_duration_seconds"):
+                       "rpc_total", "rpc_duration_seconds",
+                       "tenant_hbm_bytes", "resident_bytes",
+                       "compile_census_total"):
             for line in text.splitlines():
                 if line.startswith(f"katpu_sidecar_{family}") and \
                         'tenant="a"' in line:
@@ -513,6 +522,17 @@ def test_metricz_and_process_metrics_expose_identical_series():
         # registry, so the `ours <= both surfaces` containment above
         # already proves Metricz ≡ /metrics for them — assert they exist
         for fam in ("journal_records_total", "journal_bytes_total"):
+            assert any(fam in r and 'tenant="a"' in r for r in ours), fam
+        # ISSUE 14: the device families ride the same registry — publish a
+        # reconcile, then the containment above proves Metricz ≡ /metrics
+        # for them too; assert they exist with per-tenant attribution
+        svc.hbm_stats()
+        ours = set(svc.registry.expose_text().splitlines())
+        assert ours <= set(svc.metricz().splitlines())
+        assert ours <= set(m.expose_all_text().splitlines())
+        for fam in ("hbm_bytes_in_use", "hbm_bytes_limit"):
+            assert any(fam in r for r in ours), fam
+        for fam in ("resident_bytes", "tenant_hbm_bytes"):
             assert any(fam in r and 'tenant="a"' in r for r in ours), fam
     finally:
         svc.close()
