@@ -246,6 +246,10 @@ def run_floor_child(metric: str, args) -> int:
         # the control-loop chaos schedule is host-side orchestration — it
         # degrades WITH the floor instead of vanishing from the evidence
         cmd += ["--chaos-local"]
+    if getattr(args, "shadow_audit", False):
+        # the audit contract is host-side verification over whatever
+        # backend serves — it degrades WITH the floor
+        cmd += ["--shadow-audit"]
     if args.device_stats:
         # the residency census and compile census are host-side bookkeeping
         # over whatever backend serves; the block degrades WITH the floor
@@ -462,6 +466,15 @@ def main() -> None:
                          "unneeded-since timers — printed as a "
                          "local_chaos_control_loop JSON line (never-null "
                          "on the CPU floor)")
+    ap.add_argument("--shadow-audit", action="store_true",
+                    help="online shadow-audit smoke (audit/shadow.py): "
+                         "measured audit overhead fraction + zero "
+                         "divergence on a healthy run, a forced single-"
+                         "bit verdict corruption detected within one "
+                         "loop with a complete evidence bundle and the "
+                         "suspect ladder transition, post-heal decisions "
+                         "bit-identical to a cold encode, and the "
+                         "sidecar's per-window lane audit")
     ap.add_argument("--journal", default="", metavar="DIR",
                     help="record a short RunOnce sequence into a "
                          "deterministic flight journal under DIR, measure "
@@ -975,6 +988,19 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
                 "error": f"{type(e).__name__}: {e}",
             }), flush=True)
 
+    if getattr(args, "shadow_audit", False):
+        try:
+            with_timeout(lambda: bench_shadow_audit(args), seconds=600)()
+        except Exception as e:
+            print(f"[bench] shadow-audit phase failed: {type(e).__name__}: "
+                  f"{e}", file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "shadow_audit_smoke", "value": None,
+                "unit": "percent_overhead",
+                "error": f"{type(e).__name__}: {e}",
+            }), flush=True)
+
     if args.journal:
         try:
             with_timeout(lambda: bench_journal(args), seconds=600)()
@@ -1000,7 +1026,8 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
     if args.scaledown or args.e2e or args.trace or args.tenants \
             or args.journal or args.world_store \
             or getattr(args, "chaos_local", False) \
-            or getattr(args, "device_stats", False):
+            or getattr(args, "device_stats", False) \
+            or getattr(args, "shadow_audit", False):
         print(primary_line, flush=True)
 
 
@@ -2470,6 +2497,267 @@ def bench_journal(args) -> None:
             "replay_ms": round(replay_ms, 1),
             "backend": report["backend"],
         },
+    }), flush=True)
+
+
+def bench_shadow_audit(args) -> None:
+    """--shadow-audit: the online fidelity-verification contract as bench
+    evidence (audit/shadow.py; docs/OBSERVABILITY.md "Shadow audit").
+
+    Leg 1 (healthy): a journaled, audited control loop vs an UN-audited
+    cold-encode comparator over identical churned worlds — measured audit
+    overhead fraction (steady loops; the ≤1% acceptance bound CI asserts),
+    zero divergence, sample/skip accounting, and loop-for-loop decision
+    identity (the audit must be a pure observer).
+    Leg 2 (forced corruption): one `flip_bit` fault on the fetched verdict
+    plane — detected within ONE loop, complete evidence bundle (journal
+    cursor + per-bit diff + trace id), backend_transitions_total
+    {to=suspect,cause=audit_divergence}, a forced full/audit_divergence
+    re-encode, a clean re-audit of the same sample, and post-heal
+    decisions bit-identical to the cold-encode comparator.
+    Leg 3 (sidecar): the per-window round-robin lane audit over a small
+    batched fleet — checks flow, zero divergence, no quarantines.
+    Host-side orchestration throughout: never-null on the CPU floor."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from kubernetes_autoscaler_tpu.config.options import (
+        AutoscalingOptions,
+        NodeGroupDefaults,
+    )
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+    from kubernetes_autoscaler_tpu.sidecar import faults
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    adir = tempfile.mkdtemp(prefix="katpu-audit-")
+    jdir = os.path.join(adir, "journal")
+
+    def world():
+        fake = FakeCluster()
+        tmpl = build_test_node("tmpl", cpu_milli=16000, mem_mib=65536,
+                               pods=110, labels={"pool": "a", "disk": "ssd"})
+        fake.add_node_group("ng1", tmpl, min_size=0, max_size=64)
+        for i in range(16):
+            nd = build_test_node(
+                f"n{i}", cpu_milli=16000, mem_mib=65536, pods=110,
+                labels={"pool": "a" if i % 2 else "b",
+                        "disk": "ssd" if i % 3 else "hdd"})
+            fake.add_existing_node("ng1", nd)
+            for j in range(2):
+                fake.add_pod(build_test_pod(
+                    f"r{i}-{j}", cpu_milli=3000, mem_mib=1024,
+                    owner_name=f"rs{i % 5}", node_name=nd.name))
+        for i in range(40):
+            fake.add_pod(build_test_pod(
+                f"p{i}", cpu_milli=500, mem_mib=512,
+                owner_name=f"prs{i % 4}",
+                node_selector={"disk": "ssd"} if i % 4 == 0 else None))
+        return fake
+
+    plan_never = NodeGroupDefaults(scale_down_unneeded_time_s=3600.0,
+                                   scale_down_unready_time_s=3600.0)
+
+    def opts(**kw) -> AutoscalingOptions:
+        base = dict(
+            scale_down_delay_after_add_s=0.0,
+            node_shape_bucket=64, group_shape_bucket=16,
+            max_new_nodes_static=64, max_pods_per_node=32, drain_chunk=8,
+            enable_dynamic_resource_allocation=False,
+            enable_csi_node_aware_scheduling=False,
+            node_group_defaults=plan_never,
+        )
+        base.update(kw)
+        return AutoscalingOptions(**base)
+
+    worlds = [world(), world()]
+    audited = StaticAutoscaler(
+        worlds[0].provider, worlds[0], eviction_sink=worlds[0],
+        options=opts(shadow_audit=True, shadow_audit_dir=adir,
+                     flight_recorder_dir=os.path.join(adir, "flight"),
+                     journal_dir=jdir, journal_max_mb=16.0))
+    # the comparator COLD-encodes every loop (incremental off): the
+    # decision-identity baseline both legs compare against
+    cold = StaticAutoscaler(
+        worlds[1].provider, worlds[1], eviction_sink=worlds[1],
+        options=opts(incremental_encode=False))
+    for x in (audited, cold):
+        x.capture_verdicts = True
+
+    def decisions(x, st):
+        verdict = tuple(sorted(
+            (key, int(cnt)) for key, cnt in zip(
+                x.last_verdict_keys or [],
+                x.last_verdict_plane
+                if x.last_verdict_plane is not None else [])
+            if key is not None))
+        return (sorted(st.scale_up.increases.items()) if st.scale_up
+                else None,
+                sorted(st.unneeded_nodes), st.pending_pods, verdict)
+
+    # ---- leg 1: healthy loops, measured overhead, decision identity ----
+    loops, warmup = 20, 4
+    aud = audited.shadow_auditor
+    loop_ms, audit_ms = [], []
+    identical = True
+    seq = 0
+    for k in range(loops):
+        for w in worlds:
+            w.remove_pod(f"p{seq % 40}")
+            w.add_pod(build_test_pod(
+                f"p{40 + seq}", cpu_milli=500, mem_mib=512,
+                owner_name=f"prs{seq % 4}"))
+        seq += 1
+        a0 = aud.overhead_ns
+        t0 = time.perf_counter()
+        st_a = audited.run_once(now=1000.0 + 10.0 * k)
+        loop_ms.append((time.perf_counter() - t0) * 1000.0)
+        audit_ms.append((aud.overhead_ns - a0) / 1e6)
+        st_c = cold.run_once(now=1000.0 + 10.0 * k)
+        if k >= 1:   # loop 0 differs only in startup-recovery bookkeeping
+            identical = identical and (decisions(audited, st_a)
+                                       == decisions(cold, st_c))
+    steady_loop = sum(loop_ms[warmup:])
+    # the audit's own meter, minus forgiven jit/oracle warmup (the token
+    # bucket excludes it from the budget for the same reason)
+    steady_audit = sum(audit_ms[warmup:])
+    frac = steady_audit / steady_loop if steady_loop > 0 else 0.0
+    healthy = {
+        "loops": loops,
+        "audit_overhead_ms": round(steady_audit, 3),
+        "audit_overhead_frac": round(frac, 5),
+        "warmup_ms": round(aud.warmup_ms, 3),
+        "checks": {s: dict(c) for s, c in aud.checks.items()},
+        "samples": sum(c["ok"] + c["divergent"]
+                       for c in aud.checks.values()),
+        "skips": sum(c["skipped"] for c in aud.checks.values()),
+        "divergence": aud.divergences,
+        "identical_to_cold_encode": bool(identical),
+    }
+
+    # ---- leg 2: forced single-bit corruption of the fetched plane ----
+    faults.install([{"hook": "verdict_plane", "kind": "flip_bit",
+                     "times": 1}], seed=11, registry=audited.metrics)
+    try:
+        div_before = aud.divergences
+        st_a = audited.run_once(now=1000.0 + 10.0 * loops)
+        cold.run_once(now=1000.0 + 10.0 * loops)
+        detected = (aud.divergences == div_before + 1
+                    and st_a.audit_divergence)
+        bundle = {}
+        if st_a.audit_bundle_path:
+            with open(st_a.audit_bundle_path) as f:
+                b = json.load(f)
+            bundle = {
+                "path": st_a.audit_bundle_path,
+                "has_cursor": bool(b.get("journalCursor")),
+                "has_trace": bool(b.get("traceId")),
+                "has_bit_diff": any(
+                    d.get("xorBits") is not None or d.get("flipped")
+                    for d in b.get("divergences", [])),
+                "surfaces": sorted({d["surface"]
+                                    for d in b.get("divergences", [])}),
+            }
+        suspect = audited.metrics.counter(
+            "backend_transitions_total").value(
+            **{"from": "healthy", "to": "suspect",
+               "cause": "audit_divergence"})
+        # post-heal loop: forced full/audit_divergence re-encode + the
+        # single re-audit of the SAME sample (fault exhausted → clean)
+        for w in worlds:
+            w.add_pod(build_test_pod("q-heal", cpu_milli=500, mem_mib=512,
+                                     owner_name="prs0"))
+        st_a = audited.run_once(now=1000.0 + 10.0 * (loops + 1))
+        st_c = cold.run_once(now=1000.0 + 10.0 * (loops + 1))
+        injection = {
+            "detected_within_one_loop": bool(detected),
+            "bundle": bundle,
+            "suspect_transitions": suspect,
+            "flight_dump_reason_audit": audited.metrics.counter(
+                "flight_recorder_dumps_total").value(
+                reason="audit_divergence"),
+            "rebuild_cause_counter": audited.metrics.counter(
+                "encoder_encodes_total").value(
+                mode="full", cause="audit_divergence"),
+            "reaudit_clean": (aud.pending_recheck is None
+                              and not st_a.audit_divergence),
+            "backend_state_after": audited.supervisor.state,
+            "post_heal_identical": bool(
+                decisions(audited, st_a) == decisions(cold, st_c)),
+        }
+    finally:
+        faults.clear()
+
+    # ---- leg 3: sidecar per-window lane audit ----
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimParams,
+        SimulatorService,
+    )
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+
+    mib = 1024 * 1024
+    ngs = [{"id": "ng-4c", "template": {"name": "t4", "capacity": {
+        "cpu": 4.0, "memory": 16384 * mib, "pods": 110}},
+        "max_new": 32, "price": 1.0}]
+    svc = SimulatorService(node_bucket=16, group_bucket=16, batch_lanes=2,
+                           batch_window_ms=5.0, shadow_audit=True)
+    try:
+        for i in range(3):
+            w = DeltaWriter()
+            for k in range(8):
+                w.upsert_node(build_test_node(
+                    f"d{i}-n{k}", cpu_milli=2000 + 1000 * (k % 3),
+                    mem_mib=8192, pods=110))
+            for k in range(24):
+                w.upsert_pod(build_test_pod(
+                    f"d{i}-p{k}", cpu_milli=300, mem_mib=256,
+                    owner_name=f"d{i}-rs{k % 3}",
+                    node_name=f"d{i}-n{k % 8}" if k % 3 == 0 else ""))
+            ack = svc.apply_delta(w.payload(), tenant=f"aud{i}")
+            assert not ack.get("error"), ack
+
+        def one(i: int, kind: str) -> None:
+            if kind == "up":
+                svc.scale_up_sim(SimParams(max_new_nodes=16,
+                                           node_groups=ngs),
+                                 tenant=f"aud{i}")
+            else:
+                svc.scale_down_sim(SimParams(threshold=0.5),
+                                   tenant=f"aud{i}")
+
+        for _r in range(3):
+            for kind in ("up", "down"):
+                ths = [threading.Thread(target=one, args=(i, kind))
+                       for i in range(3)]
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join()
+        svc.audit_quiesce(60.0)   # audits run async on the worker thread
+        sstats = svc.audit_stats()
+        sidecar = {
+            "checks": sstats["checks"],
+            "divergence": sstats["divergences"],
+            "overhead_ms": sstats["overhead_ms"],
+            "quarantined": len(svc.quarantine_stats()),
+        }
+    finally:
+        svc.close()
+
+    print(json.dumps({
+        "metric": "shadow_audit_smoke",
+        "value": round(frac * 100.0, 4),
+        "unit": "percent_overhead",
+        "backend": jax.default_backend(),
+        "audit_overhead_frac": healthy["audit_overhead_frac"],
+        "healthy": healthy,
+        "injection": injection,
+        "sidecar": sidecar,
     }), flush=True)
 
 

@@ -1,0 +1,6 @@
+from kubernetes_autoscaler_tpu.audit.shadow import (  # noqa: F401
+    AUDIT_CHECKS_HELP,
+    AUDIT_SURFACES,
+    ShadowAuditor,
+    sample_indices,
+)

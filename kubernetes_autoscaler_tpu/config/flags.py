@@ -223,6 +223,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "arms a bounded jax.profiler.trace capture of the "
                         "next RunOnce into this directory, stamped with "
                         "trace id + journal cursor (empty = off)")
+    p.add_argument("--shadow-audit", type=_bool, default=False,
+                   help="online shadow audit: each loop, re-verify a "
+                        "deterministic journal-cursor-seeded sample of "
+                        "device verdicts against the host oracle; a "
+                        "divergence emits an evidence bundle and drives "
+                        "the backend supervisor ladder (audit/shadow.py)")
+    p.add_argument("--shadow-audit-samples", type=int, default=4,
+                   help="verdict samples per audited surface per loop")
+    p.add_argument("--shadow-audit-budget-ms", type=float, default=0.0,
+                   help="per-loop audit budget refill in ms; 0 = adaptive "
+                        "~0.5%% of the loop walltime (skipped samples are "
+                        "counted — the audit never becomes the hot path)")
+    p.add_argument("--shadow-audit-dir", default="",
+                   help="directory for divergence evidence bundles "
+                        "(default: --flight-recorder-dir)")
     p.add_argument("--restart-state-path", default="",
                    help="persist unneeded-since clocks + in-flight "
                         "scale-ups here each loop and rehydrate on start "
@@ -374,6 +389,10 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         device_ledger=args.device_ledger,
         hbm_watchdog_loops=args.hbm_watchdog_loops,
         device_profile_dir=args.device_profile_dir,
+        shadow_audit=args.shadow_audit,
+        shadow_audit_samples=args.shadow_audit_samples,
+        shadow_audit_budget_ms=args.shadow_audit_budget_ms,
+        shadow_audit_dir=args.shadow_audit_dir,
         restart_state_path=args.restart_state_path,
         restart_state_max_age_s=args.restart_state_max_age,
     )

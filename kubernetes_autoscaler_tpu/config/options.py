@@ -174,6 +174,19 @@ class AutoscalingOptions:
     # jax.profiler.trace capture of the NEXT RunOnce into this directory,
     # stamped with trace id + journal cursor; "" = off
     device_profile_dir: str = ""                   # --device-profile-dir
+    # online shadow audit (audit/shadow.py): continuous, budget-bounded,
+    # journal-cursor-seeded sampled re-verification of device verdicts
+    # against the host oracle — divergence drives the supervisor ladder
+    shadow_audit: bool = False                     # --shadow-audit
+    # samples per audited surface per loop (K)
+    shadow_audit_samples: int = 4                  # --shadow-audit-samples
+    # per-loop audit budget refill in ms; 0 = adaptive (~0.5% of the loop
+    # walltime EWMA — half the 1% overhead target). Exhausted budget skips
+    # samples (counted), never stalls the loop.
+    shadow_audit_budget_ms: float = 0.0            # --shadow-audit-budget-ms
+    # divergence evidence bundles land here; "" falls back to
+    # --flight-recorder-dir (bundle next to the Perfetto dump)
+    shadow_audit_dir: str = ""                     # --shadow-audit-dir
     # crash-consistent restart record (unneeded-since clocks + in-flight
     # scale-ups keyed to the journal cursor); "" = off
     restart_state_path: str = ""                   # --restart-state-path

@@ -80,6 +80,12 @@ def run_loop(
                 # (static_autoscaler dumps it before the supervisor ladder
                 # churns the heap)
                 hbm_dump_path=getattr(autoscaler, "last_oom_dump", ""),
+                # the most recent shadow-audit divergence bundle: a loop
+                # that raises AFTER a divergence still points its failed
+                # status at the evidence (the restart record carries the
+                # same pointer across a crash)
+                audit_bundle_path=getattr(
+                    autoscaler, "last_audit_bundle", ""),
             )
             # exponent clamped: a backend down for hours must not overflow
             # float range inside the very handler that keeps the driver alive

@@ -84,6 +84,12 @@ class RunOnceStatus:
     # device-memory pprof snapshot persisted by an OOM-failed loop (the
     # flight-recorder-adjacent evidence; "" = no OOM / no dump dir)
     hbm_dump_path: str = ""
+    # shadow audit (audit/shadow.py): True when this loop's sampled device
+    # verdicts diverged from the host oracle; the bundle path mirrors
+    # hbm_dump_path so run_loop's failed-status path and the restart
+    # record both carry the evidence pointer across a crash
+    audit_divergence: bool = False
+    audit_bundle_path: str = ""
 
 
 class StaticAutoscaler:
@@ -233,6 +239,27 @@ class StaticAutoscaler:
         # recent OOM-failed loop ("" = none); run_loop surfaces it on the
         # failed RunOnceStatus
         self.last_oom_dump: str = ""
+        # online shadow audit (audit/shadow.py): budget-bounded sampled
+        # re-verification of device verdicts against the host oracle each
+        # loop; a divergence writes an evidence bundle, drives the
+        # supervisor ladder (cause=audit_divergence) and forces a
+        # WorldStore heal + re-audit of the same sample
+        self.shadow_auditor = None
+        self.last_audit_bundle: str = ""
+        self._audit_divergent_loop = False
+        if self.options.shadow_audit:
+            from kubernetes_autoscaler_tpu.audit.shadow import ShadowAuditor
+
+            self.shadow_auditor = ShadowAuditor(
+                registry=self.metrics, event_sink=self.event_sink,
+                samples=self.options.shadow_audit_samples,
+                budget_ms=self.options.shadow_audit_budget_ms,
+                bundle_dir=(self.options.shadow_audit_dir
+                            or self.options.flight_recorder_dir))
+            # persistent divergence refuses scale-up: every option would
+            # be derived from a verdict plane the audit proved corrupt
+            self.scale_up_orchestrator.audit_gate = \
+                self.shadow_auditor.scale_up_untrusted
         # deterministic flight journal (replay/): every RunOnce recorded as
         # a self-contained snapshot/delta record, replayable bit-for-bit by
         # `python -m kubernetes_autoscaler_tpu.replay` (--journal-dir /
@@ -354,6 +381,7 @@ class StaticAutoscaler:
         error: Exception | None = None
         self._journal_cursor = None
         self.last_oom_dump = ""
+        self._audit_divergent_loop = False
         try:
             prof = device_obs.PROFILER
             if prof is not None and prof.armed:
@@ -395,6 +423,10 @@ class StaticAutoscaler:
             raise
         finally:
             loop_s = time.perf_counter() - t0
+            if self.shadow_auditor is not None:
+                # loop-walltime EWMA: the adaptive audit budget's
+                # denominator (the audit spends ~0.5% of this per loop)
+                self.shadow_auditor.note_loop_ms(loop_s * 1000.0)
             if self.journal is not None:
                 # a loop that raised or returned before its outputs existed
                 # leaves its staged record behind — drop it, counted
@@ -443,6 +475,8 @@ class StaticAutoscaler:
                     trace.activate(None)
                     reason = ("error" if error is not None
                               else "slo_breach" if breach
+                              else "audit_divergence"
+                              if self._audit_divergent_loop
                               else "hbm_leak" if leak is not None
                               else "snapshotz" if armed else "")
                     if self.flight_recorder.record(tracer, dump_reason=reason):
@@ -637,7 +671,21 @@ class StaticAutoscaler:
                     # instead of simming against stale planes
                     if self.supervisor.world_stale \
                             and self.supervisor.state != "degraded":
-                        healed = self._world_store.heal()
+                        # an unhealed audit divergence FORCES the rebuild:
+                        # a miscompiled kernel corrupts outputs, not the
+                        # resident planes, so an intact digest probe is
+                        # not an acquittal — the single re-audit of the
+                        # same sample must run against a cold re-encode
+                        force = (self.shadow_auditor is not None
+                                 and self.shadow_auditor.pending_recheck
+                                 is not None)
+                        healed = self._world_store.heal(force=force)
+                        if force:
+                            # the rebuild the re-audit protocol demanded
+                            # ran — the pending sample may now be
+                            # re-checked (and a second divergence really
+                            # means persistent)
+                            self.shadow_auditor.note_healed()
                         self.supervisor.world_healed(
                             healed["outcome"],
                             {"lostPlanes": healed["lostPlanes"][:8]})
@@ -663,7 +711,11 @@ class StaticAutoscaler:
                 else:
                     if self.supervisor.world_stale:
                         # nothing resident to distrust: every loop here
-                        # re-lowers + re-uploads the whole world anyway
+                        # re-lowers + re-uploads the whole world anyway —
+                        # which is also exactly the cold re-encode the
+                        # audit's re-check protocol demands
+                        if self.shadow_auditor is not None:
+                            self.shadow_auditor.note_healed()
                         self.supervisor.world_healed("full-encode")
 
                     def _full_encode():
@@ -688,6 +740,15 @@ class StaticAutoscaler:
                         "world_store_h2d_bytes_total", help=H2D_HELP).inc(
                         sum(int(v.nbytes)
                             for v in (enc.host_arrays or {}).values()))
+            if self.shadow_auditor is not None:
+                # pin the pre-placement tensors + mirrors the verdicts are
+                # computed from; the sample seed is the journal cursor at
+                # the TOP of this loop (record k-1's digest — the cursor a
+                # replay of this loop runs under; docs/REPLAY.md)
+                self.shadow_auditor.capture_world(
+                    enc, parent_digest=(self.journal._last_digest
+                                        if self.journal is not None
+                                        else ""))
             if self.quota is not None:
                 self.quota.registry = enc.registry
             self.scale_up_orchestrator.quota = self.quota
@@ -725,13 +786,28 @@ class StaticAutoscaler:
                 packed = self.supervisor.guard(
                     "dispatch", snapshot.schedule_pending_on_existing)
                 snapshot.apply_placement(packed.placed)
-            if self.journal is not None or self.capture_verdicts:
+            if self.journal is not None or self.capture_verdicts \
+                    or self.shadow_auditor is not None:
                 # the filter-out-schedulable verdict plane, byte-preserved
                 # into the journal record (one tiny int32[G] fetch, charged
                 # to the journal's overhead meter)
                 jt0 = time.perf_counter_ns()
-                self.last_verdict_plane = np.asarray(
-                    packed.scheduled).astype(np.int32)
+                plane = np.asarray(packed.scheduled).astype(np.int32)
+                from kubernetes_autoscaler_tpu.sidecar import faults
+
+                if faults.PLAN is not None:
+                    # the audit-visible corruption hook (sidecar/faults.py
+                    # `flip_bit`): corrupts the FETCHED copy every
+                    # downstream consumer reads while the device array
+                    # keeps the truth — exactly the silent-corruption
+                    # shape the shadow audit exists to catch
+                    plane = faults.PLAN.fire("verdict_plane",
+                                             payload=plane,
+                                             registry=self.metrics)
+                self.last_verdict_plane = plane
+                if self.shadow_auditor is not None:
+                    self.shadow_auditor.capture_verdict(
+                        packed.scheduled, plane)
                 if self.journal is not None:
                     self.journal.overhead_ns += time.perf_counter_ns() - jt0
                 if self.capture_verdicts:
@@ -818,13 +894,21 @@ class StaticAutoscaler:
                 # (events / status / registry gauge / snapshotz).
                 status.scale_down_withheld = True
                 status.unneeded_nodes = list(self.planner.state.unneeded)
+                # a backend degraded BY the shadow audit marks its victims
+                # with the audit's own reason — dashboards distinguish "the
+                # device hung" from "the device computed wrong bits"
+                audit_deg = (self.shadow_auditor is not None
+                             and self.shadow_auditor.degraded)
+                reason = "AuditDivergence" if audit_deg \
+                    else "BackendDegraded"
                 why = (f"scale-down withheld: backend "
                        f"{self.supervisor.state}"
+                       + (", shadow audit divergence unhealed"
+                          if audit_deg else "")
                        + (", world unverified"
                           if self.supervisor.world_stale else ""))
                 for name in status.unneeded_nodes:
-                    self.planner._mark(name, "BackendDegraded", now,
-                                       message=why)
+                    self.planner._mark(name, reason, now, message=why)
                 self.metrics.gauge("unneeded_nodes_count").set(
                     len(status.unneeded_nodes))
             elif sd_due:
@@ -942,6 +1026,28 @@ class StaticAutoscaler:
                 self.journal.overhead_ns += time.perf_counter_ns() - jt0
                 self._journal_cursor = self.journal.commit(outputs)
 
+            # online shadow audit (audit/shadow.py): re-verify the sampled
+            # device verdicts against the host oracle, AFTER the journal
+            # commit (the bundle names this loop's cursor) and BEFORE
+            # supervisor.end_loop (a divergent loop must not read as clean)
+            if self.shadow_auditor is not None:
+                tr = trace.current_tracer()
+                rep = self.shadow_auditor.run_once_audit(
+                    planner=self.planner, cursor=self._journal_cursor,
+                    now=now, trace_id=tr.trace_id if tr else "")
+                if rep is not None and rep["divergent"]:
+                    self._audit_divergent_loop = True
+                    status.audit_divergence = True
+                    status.audit_bundle_path = rep.get("bundlePath", "")
+                    if status.audit_bundle_path:
+                        self.last_audit_bundle = status.audit_bundle_path
+                    # the ladder: healthy→suspect on first divergence,
+                    # →degraded when the post-heal re-audit diverged again
+                    self.supervisor.audit_divergence(
+                        detail={"surfaces": sorted(
+                            {d["surface"] for d in rep["divergences"]})},
+                        persistent=rep["persistent"])
+
             if self.debugging_snapshotter is not None:
                 if self.debugging_snapshotter.is_data_collection_allowed():
                     self._feed_snapshot_observability(
@@ -976,7 +1082,8 @@ class StaticAutoscaler:
                         self.options.restart_state_path, now=now,
                         journal_cursor=self._journal_cursor,
                         unneeded_since=self.planner.unneeded_nodes.since,
-                        scale_up_requests=self.cluster_state.scale_up_requests)
+                        scale_up_requests=self.cluster_state.scale_up_requests,
+                        audit_bundle=self.last_audit_bundle)
                 except OSError:
                     self.metrics.counter(
                         "restart_state_errors_total",
@@ -1008,6 +1115,10 @@ class StaticAutoscaler:
             },
             "drainFailDetail": dict(self.planner.state.drain_fail_detail),
             "events": self.event_sink.snapshot(),
+            # shadow-audit section: check/divergence counts, the pending
+            # re-audit, the last evidence bundle (docs/OBSERVABILITY.md)
+            **({"audit": self.shadow_auditor.snapshot_payload()}
+               if self.shadow_auditor is not None else {}),
         })
         if tracer is not None:
             dbg.set_trace_id(tracer.trace_id)
@@ -1259,6 +1370,12 @@ class StaticAutoscaler:
                             ScaleUpRequest(gid, int(r["increase"]),
                                            float(r["time"]),
                                            float(r["expectedAddTime"]))
+                # inherit the predecessor's shadow-audit evidence pointer:
+                # without this, the first post-restart save would rewrite
+                # the record with auditBundle="" and erase the pointer the
+                # crash was supposed to preserve (docs/REPLAY.md)
+                self.last_audit_bundle = (rec.get("auditBundle", "")
+                                          or self.last_audit_bundle)
                 self._restored_restart = rec
                 self.metrics.counter("restart_state_total",
                                      help=rehydrate_help).inc(

@@ -146,6 +146,10 @@ class BackendSupervisor:
         self.world_stale = False
         self.last_incident: dict | None = None
         self.last_heal: dict | None = None
+        # set when an incident is booked on a loop that still COMPLETES
+        # (shadow-audit divergence): end_loop must not read that loop as
+        # clean and advance suspect→healthy in the same breath
+        self._loop_incident = False
         self.transitions: deque = deque(maxlen=64)
         # deadline-hit workers still wedged on the device op (daemon
         # threads); reaped as they die, capped by MAX_ABANDONED_WORKERS
@@ -240,9 +244,35 @@ class BackendSupervisor:
         elif self.state == "recovering":
             self._transition("degraded", full_cause)
 
+    def audit_divergence(self, detail: dict | None = None,
+                         persistent: bool = False) -> None:
+        """A shadow-audit divergence (audit/shadow.py) is a guarded-phase
+        incident in every way but its discovery path: the device answered
+        fast and WRONG instead of hanging. The resident world is untrusted
+        (the next loop heals it with a FORCED full re-encode) and the
+        ladder moves — healthy→suspect on first divergence, →degraded when
+        the post-heal re-audit of the same sample diverges again
+        (`persistent`): a divergence that survives a cold re-encode means
+        the backend itself cannot be trusted to actuate."""
+        self.probe_successes = 0
+        self.clean_loops = 0
+        self.world_stale = True
+        self.incidents += 1
+        self._loop_incident = True
+        self.last_incident = {"phase": "audit", "cause": "audit_divergence",
+                              "at": self.clock(), **(detail or {})}
+        if persistent:
+            if self.state != "degraded":
+                self._transition("degraded", "audit_divergence")
+        elif self.state == "healthy":
+            self._transition("suspect", "audit_divergence")
+        elif self.state == "recovering":
+            self._transition("degraded", "audit_divergence")
+
     def begin_loop(self) -> None:
         """Top-of-RunOnce hook: a healthy backend costs one attribute read;
         any other state runs the recovery probe under its deadline."""
+        self._loop_incident = False
         if self.state == "healthy":
             return
         ok = self.run_probe()
@@ -264,6 +294,11 @@ class BackendSupervisor:
 
     def end_loop(self) -> None:
         """A loop that completed without a guarded-phase incident."""
+        if self._loop_incident:
+            # the loop finished, but an audit divergence was booked on it —
+            # it is NOT clean evidence for suspect→healthy / hysteresis
+            self._loop_incident = False
+            return
         self.consecutive_failures = 0
         if self.state == "suspect":
             self._transition("healthy", "clean-loop")
@@ -373,16 +408,21 @@ RESTART_RECORD_VERSION = 1
 def save_restart_state(path: str, *, now: float,
                        journal_cursor: tuple | None,
                        unneeded_since: dict,
-                       scale_up_requests: dict) -> None:
+                       scale_up_requests: dict,
+                       audit_bundle: str = "") -> None:
     """Persist the restart record atomically (write + fsync + rename — a
     crash mid-save leaves the previous intact record, never a torn one).
     `now` is the RunOnce clock domain (wall or logical), and staleness at
-    load time is judged in the same domain."""
+    load time is judged in the same domain. `audit_bundle` is the most
+    recent shadow-audit divergence bundle path (mirroring the journal
+    cursor: a restarted process inherits the pointer to the evidence its
+    predecessor's last divergence produced)."""
     rec = {
         "version": RESTART_RECORD_VERSION,
         "savedAt": float(now),
         "journalCursor": (list(journal_cursor)
                           if journal_cursor is not None else None),
+        **({"auditBundle": audit_bundle} if audit_bundle else {}),
         "unneededSince": {str(k): float(v)
                           for k, v in unneeded_since.items()},
         "scaleUpRequests": [

@@ -54,6 +54,13 @@ class Counter:
                 if items <= set(key):
                     self._values[key] = 0.0
 
+    def items(self) -> list[tuple[tuple, float]]:
+        """Snapshot of every (label-key-tuple, value) series — the public
+        accessor for aggregations over a whole family (audit_stats and
+        kin), instead of reaching into the private storage."""
+        with self._lock:
+            return list(self._values.items())
+
 
 @dataclass
 class Gauge:

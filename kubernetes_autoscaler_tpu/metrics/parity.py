@@ -196,6 +196,37 @@ DEVICE_FAMILIES = {
         "failures"),
 }
 
+# ---- shadow-audit surfaces: reference trust model → online verification ----
+#
+# The reference TRUSTS its own arithmetic: scheduling verdicts are computed
+# by the same Go process that actuates them, so there is no "the computer
+# lied" failure class and no metric for it. This framework computes verdicts
+# on an accelerator behind a tunnel — a silently miscompiled kernel or a
+# corrupted HBM buffer emits wrong decisions with healthy-looking metrics —
+# so the shadow audit (audit/shadow.py; docs/OBSERVABILITY.md "Shadow
+# audit") adds the missing golden-output families. PARITY.md carries the
+# same table; the Metricz ≡ /metrics row-for-row parity test covers the
+# per-tenant families below.
+SHADOW_AUDIT_FAMILIES = {
+    # absent reference surface -> our online-verification accounting
+    "(no silent-data-corruption detection)": (
+        "shadow_audit_checks_total{surface,outcome} — sampled device "
+        "verdicts re-derived through the host oracle each loop (surface: "
+        "plane / scaleup / drain on the control loop; sidecar-up / "
+        "sidecar-down per batched window, tenant-labelled); outcome "
+        "divergent is the silent-corruption alarm"),
+    "(no verification cost accounting)": (
+        "shadow_audit_overhead_seconds_total + "
+        "shadow_audit_pending_recheck — the audit's budget spend (token-"
+        "bucket bounded, ~1% of loop walltime; exhausted budget counts "
+        "outcome=skipped, never stalls the loop) and the one-bit state of "
+        "the post-heal re-audit protocol"),
+    "(no corruption evidence artifact)": (
+        "shadow_audit_bundles_total — self-contained divergence evidence "
+        "bundles (journal cursor + sampled inputs + per-bit reason diff + "
+        "retained trace id), persisted next to the flight-recorder dumps"),
+}
+
 # The reference UnremovableReason enum values our planner actually produces,
 # value-for-value (simulator/cluster.go:63-103). A dashboard filtering the
 # reference's unremovable_nodes_count{reason=...} re-points unchanged.
@@ -223,6 +254,15 @@ UNREMOVABLE_REASONS_LOCAL = {
                        "supervisor distrusts the simulation (degraded/"
                        "recovering ladder state or an unverified resident "
                        "world, core/supervisor.py)",
+    # Dual-surface reason: rides unremovable_nodes_count{reason} on the
+    # scale-down side AND unschedulable_pods_count{reason} / NoScaleUp
+    # events on the scale-up side — both directions refuse to actuate on
+    # verdict bits the audit proved corrupt.
+    "AuditDivergence": "actuation refused while a shadow-audit divergence "
+                       "is unhealed: the device verdict plane diverged "
+                       "from the host oracle and the divergence survived "
+                       "a forced cold re-encode (audit/shadow.py; "
+                       "scale-down withheld, scale-up options refused)",
 }
 
 UNREMOVABLE_REASONS_NA = {

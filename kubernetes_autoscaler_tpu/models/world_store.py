@@ -60,7 +60,7 @@ from kubernetes_autoscaler_tpu.utils.canonical import canon_map, digest_strs
 
 MODES = ("delta", "row_refresh", "full")
 CAUSES = ("initial", "fingerprint_miss", "shape_overflow", "forced", "churn",
-          "device_lost")
+          "device_lost", "audit_divergence")
 
 ENCODES_HELP = ("World encodes by mode (delta = resident planes patched by "
                 "row scatters; row_refresh = ≥1 whole-plane re-upload; "
@@ -319,7 +319,7 @@ class WorldStore:
 
     # self-healing ---------------------------------------------------------
 
-    def heal(self) -> dict:
+    def heal(self, force: bool = False) -> dict:
         """Post-incident residency audit (docs/ROBUSTNESS.md "Control
         loop"): digest-probe every resident device plane against its host
         mirror. Intact planes keep their residency (the incident was a
@@ -328,18 +328,26 @@ class WorldStore:
         the next encode full with cause="device_lost", so the loop sims
         against a cold re-lowered world instead of stale planes. Decisions
         after the rebuild are bit-identical to a cold encode (pinned by
-        tests/test_supervisor.py)."""
+        tests/test_supervisor.py).
+
+        `force` is the shadow-audit path (audit/shadow.py): a divergence
+        proved the device computes WRONG bits even though every resident
+        plane may digest-match its mirror (a miscompiled kernel corrupts
+        outputs, not inputs) — drop the device state and rebuild anyway,
+        with cause="audit_divergence", so the single re-audit of the same
+        sample runs against a cold re-encode."""
         e = self.encoder
         if not getattr(e, "_seeded", False):
             # nothing resident (pre-first-encode, or already invalidated):
             # the next encode is full anyway
             return {"outcome": "not-resident", "lostPlanes": []}
         lost = e.device_store.verify_against(e._m)
-        if not lost:
+        if not lost and not force:
             return {"outcome": "intact", "lostPlanes": []}
         e.device_store.drop_device_state()
-        e.invalidate(cause="device_lost")
-        return {"outcome": "rebuilt", "lostPlanes": lost}
+        e.invalidate(cause="device_lost" if lost else "audit_divergence")
+        return {"outcome": "rebuilt" if lost else "rebuilt-forced",
+                "lostPlanes": lost}
 
     # fingerprints ---------------------------------------------------------
 

@@ -190,6 +190,83 @@ def host_predicate_row(label_hash: np.ndarray, taint_exact: np.ndarray,
     return ok
 
 
+def host_reason_row(planes: dict, gi: int,
+                    check_resources: bool = True) -> np.ndarray:
+    """Host-side (numpy) twin of ONE `reason_mask` row: uint16[N] packed
+    refusal bits for pod-group `gi` against every node, computed from the
+    incremental encoder's host mirrors with no device dispatch.
+
+    This is the shadow-audit oracle (audit/shadow.py): the device evaluates
+    `reason_mask` over its resident planes, this recomputes the same bits
+    from the same logical inputs on the host — bit-for-bit equal on a
+    healthy backend (pinned by tests/test_shadow_audit.py the same way the
+    fuzz suite pins `feasible ⇔ reason_bits == 0`). A silently miscompiled
+    predicate kernel, a corrupted resident plane, or a bad fetch shows up
+    as a per-bit diff the audit can name.
+
+    `planes` is the mirror dict (models/incremental.IncrementalEncoder._m
+    keys: "nodes.*" / "specs.*"). Same hash-equality contract as
+    `host_predicate_row` — the comparison is at the ENCODING level, so it
+    is exact for lossy specs too (both sides see the same hashes)."""
+    lh = planes["nodes.label_hash"]
+    te = planes["nodes.taint_exact"]
+    tk = planes["nodes.taint_key"]
+    up = planes["nodes.used_ports"]
+    n = lh.shape[0]
+    bits = np.zeros((n,), dtype=np.uint16)
+    # selector: every active AND-term needs >= 1 alternative present, and
+    # no must-be-absent hash present (host_predicate_row's contract)
+    sel_ok = np.ones((n,), dtype=bool)
+    sel_req = planes["specs.sel_req"][gi]
+    for s in range(sel_req.shape[0]):
+        alts = sel_req[s]
+        alts = alts[alts != 0]
+        if alts.size:
+            sel_ok &= np.isin(lh, alts).any(axis=1)
+    negs = planes["specs.sel_neg"][gi]
+    negs = negs[negs != 0]
+    if negs.size:
+        sel_ok &= ~np.isin(lh, negs).any(axis=1)
+    bits |= np.where(~sel_ok, np.uint16(REASON_SELECTOR), np.uint16(0))
+    # taints: every active taint covered by an exact or key-scoped hash
+    if bool(planes["specs.tolerate_all"][gi]):
+        t_ok = np.ones((n,), dtype=bool)
+    else:
+        tol_ex = planes["specs.tol_exact"][gi]
+        tol_ex = tol_ex[tol_ex != 0]
+        tol_ky = planes["specs.tol_key"][gi]
+        tol_ky = tol_ky[tol_ky != 0]
+        active = te != 0
+        covered = np.isin(te, tol_ex) | np.isin(tk, tol_ky)
+        t_ok = (~active | covered).all(axis=1)
+    bits |= np.where(~t_ok, np.uint16(REASON_TAINT), np.uint16(0))
+    # ports: any of the spec's hostPort hashes already occupied
+    ph = planes["specs.port_hash"][gi]
+    ph = ph[ph != 0]
+    if ph.size:
+        conflict = np.isin(up, ph).any(axis=1)
+        bits |= np.where(conflict, np.uint16(REASON_PORTS), np.uint16(0))
+    if check_resources:
+        free = (planes["nodes.cap"].astype(np.int64)
+                - planes["nodes.alloc"].astype(np.int64))
+        lack = planes["specs.req"][gi].astype(np.int64)[None, :] > free
+        bits |= np.where(lack[:, CPU], np.uint16(REASON_CPU), np.uint16(0))
+        bits |= np.where(lack[:, MEMORY], np.uint16(REASON_MEMORY),
+                         np.uint16(0))
+        bits |= np.where(lack[:, EPHEMERAL], np.uint16(REASON_EPHEMERAL),
+                         np.uint16(0))
+        bits |= np.where(lack[:, PODS], np.uint16(REASON_PODS), np.uint16(0))
+        bits |= np.where(lack[:, NUM_STANDARD:].any(axis=-1),
+                         np.uint16(REASON_EXTENDED), np.uint16(0))
+    gate = (planes["nodes.valid"].astype(bool)
+            & planes["nodes.ready"].astype(bool)
+            & planes["nodes.schedulable"].astype(bool))
+    bits |= np.where(~gate, np.uint16(REASON_NODE_UNAVAILABLE), np.uint16(0))
+    if not bool(planes["specs.valid"][gi]):
+        bits |= np.uint16(REASON_GROUP_INVALID)
+    return bits
+
+
 def feasibility_mask(
     nodes: NodeTensors,
     specs: PodGroupTensors,

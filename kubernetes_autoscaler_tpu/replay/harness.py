@@ -181,6 +181,10 @@ def options_from_meta(meta: dict, neutralize: bool = True):
         opts.journal_dir = ""
         opts.flight_recorder_dir = ""
         opts.loop_wallclock_budget_s = 0.0
+        # the replay's shadow audit re-runs the recorded sampling (same
+        # cursor seeds via parent_override) but must not write divergence
+        # bundles into the RECORDER's evidence directory
+        opts.shadow_audit_dir = ""
     return opts
 
 
@@ -340,6 +344,13 @@ def replay_journal(path: str, upto: int | None = None, diff: bool = False,
         if upto is not None and rec["loop"] > upto:
             break
         clock["now"] = rec["now"]
+        if getattr(autoscaler, "shadow_auditor", None) is not None:
+            # cursor-seeding contract (docs/REPLAY.md): the recorder
+            # seeded loop k's sample with record k-1's digest; the record
+            # carries exactly that as `parent`, so the replayed audit
+            # draws the SAME cells without a live journal
+            autoscaler.shadow_auditor.parent_override = rec.get(
+                "parent", "")
         src.set_world(world)
         # groups-only parse: snapshot_from_index would json-parse every
         # node/pod canon per loop just to discard them (ReplaySource
@@ -406,6 +417,16 @@ def replay_journal(path: str, upto: int | None = None, diff: bool = False,
         # not carry (PDBs, workloads, DRA/CSI…) — replay may legitimately
         # drift on loops where they influenced a decision
         report["fidelity"] = {"unrecordedSources": lossy}
+    aud = getattr(autoscaler, "shadow_auditor", None)
+    if aud is not None:
+        # the replayed audit's sample provenance: loop-for-loop equal to
+        # the recorder's sample_log when the journal is faithful (the
+        # determinism pin in tests/test_shadow_audit.py)
+        report["audit"] = {
+            "samples": list(aud.sample_log),
+            "checks": {s: dict(c) for s, c in aud.checks.items()},
+            "divergences": aud.divergences,
+        }
     if keep_autoscaler:
         report["_autoscaler"] = autoscaler
     return report

@@ -62,14 +62,24 @@ from dataclasses import dataclass
 HOOKS = ("codec_decode", "classify", "stack", "h2d", "dispatch",
          "harvest", "assembly", "grpc_reply", "scheduler_loop",
          # the local control loop's guarded phases (core/supervisor.py)
-         "local_encode", "local_dispatch", "local_fetch", "local_probe")
+         "local_encode", "local_dispatch", "local_fetch", "local_probe",
+         # the fetched filter-out-schedulable verdict plane, right after
+         # its device→host copy (core/static_autoscaler.py) — the
+         # shadow-audit-visible corruption point: a flip_bit spec here
+         # corrupts what every downstream consumer reads while the device
+         # buffer keeps the truth (audit/shadow.py detects the split)
+         "verdict_plane")
 
 # raise: typed InjectedFault; delay/hang: sleep delay_ms (hang is the same
 # mechanism with an alarming name — a bounded stall, so tests can assert
 # deadline behavior without wedging the suite); truncate: cut a bytes
 # payload in half (a torn KAD1 section); nan: NaN every float plane of a
-# dict-of-arrays payload (a poisoned world/result).
-KINDS = ("raise", "delay", "hang", "truncate", "nan")
+# dict-of-arrays payload (a poisoned world/result); flip_bit: XOR one bit
+# of one element of an integer ndarray payload (single-bit silent data
+# corruption — the canonical SDC shape the online shadow audit must
+# detect within one loop; element/bit picked by the spec's seeded RNG,
+# overridable via `index`/`bit`).
+KINDS = ("raise", "delay", "hang", "truncate", "nan", "flip_bit")
 
 ENV_VAR = "KATPU_FAULTS"
 
@@ -97,6 +107,8 @@ class FaultSpec:
     times: int = 1          # fire at most N times; 0 = unlimited
     prob: float = 1.0       # seeded Bernoulli per eligible invocation
     delay_ms: float = 0.0   # delay/hang sleep
+    index: int = -1         # flip_bit: element index (-1 = seeded pick)
+    bit: int = -1           # flip_bit: bit position (-1 = seeded pick)
     message: str = ""
     id: str = ""
 
@@ -162,10 +174,12 @@ class FaultPlan:
                     "seq": seq, "hook": hook, "kind": s.kind, "spec": s.id,
                     "tenant": s.tenant or tenant or ""})
             payload = self._act(s, hook, s.tenant or tenant,
-                                payload, registry or self.registry)
+                                payload, registry or self.registry,
+                                rng=self._rng[i])
         return payload
 
-    def _act(self, s: FaultSpec, hook: str, tenant: str, payload, registry):
+    def _act(self, s: FaultSpec, hook: str, tenant: str, payload, registry,
+             rng=None):
         self._stamp(s, hook, tenant, registry)
         if s.kind in ("delay", "hang"):
             time.sleep(max(s.delay_ms, 0.0) / 1000.0)
@@ -178,6 +192,8 @@ class FaultPlan:
             return payload
         if s.kind == "nan":
             return _nan_corrupt(payload)
+        if s.kind == "flip_bit":
+            return _flip_bit(payload, s, rng)
         return payload  # pragma: no cover — KINDS is exhaustive
 
     @staticmethod
@@ -232,6 +248,28 @@ def _nan_corrupt(payload):
         if isinstance(v, np.ndarray) and np.issubdtype(v.dtype, np.floating):
             v = np.full_like(v, np.nan)
         out[k] = v
+    return out
+
+
+def _flip_bit(payload, s: FaultSpec, rng):
+    """XOR one bit of one element of an integer ndarray (a COPY — the
+    caller's array may be a host mirror shared with other readers). The
+    single-bit-flip is the canonical silent-data-corruption shape: the
+    payload stays structurally valid, finite, plausible — only a
+    golden-output check (the shadow audit) can tell."""
+    import numpy as np
+
+    if not isinstance(payload, np.ndarray) or payload.size == 0 \
+            or not np.issubdtype(payload.dtype, np.integer):
+        return payload
+    out = payload.copy()
+    flat = out.reshape(-1)
+    idx = s.index if 0 <= s.index < flat.size else \
+        (rng.randrange(flat.size) if rng is not None else 0)
+    nbits = out.dtype.itemsize * 8 - 1   # spare the sign bit
+    bit = s.bit if 0 <= s.bit < nbits else \
+        (rng.randrange(nbits) if rng is not None else 0)
+    flat[idx] = int(flat[idx]) ^ (1 << bit)
     return out
 
 

@@ -30,6 +30,8 @@ from __future__ import annotations
 import contextlib
 import hashlib
 import json
+import os
+import queue as _queue
 import threading
 import time as _time
 import uuid
@@ -161,7 +163,8 @@ class SimulatorService:
                  hbm_limit_bytes: int = 0,
                  device_profile_dir: str = "",
                  profile_min_interval_s: float = 30.0,
-                 profile_max_captures: int = 8):
+                 profile_max_captures: int = 8,
+                 shadow_audit: bool = False):
         self.dims = dims
         self.max_tenants = int(max_tenants)
         # fault-domain isolation (docs/ROBUSTNESS.md): quarantine TTL and
@@ -259,6 +262,37 @@ class SimulatorService:
                 gap_cb=self._note_gap,
                 on_batch_failure=self._batch_failure,
                 on_crash=self._scheduler_crash).start()
+        # online shadow audit, serving edition (docs/OBSERVABILITY.md
+        # "Shadow audit"): one ROUND-ROBIN member lane per batched window
+        # is re-simulated through the serial (unbatched) reference program
+        # and its assembled response compared bit-for-bit — the online form
+        # of test_batched_sim's serial≡batched identity. A divergence is a
+        # DEVICE/BACKEND fault by construction (same inputs, independent
+        # executable), so it rides the supervisor/backend evidence path —
+        # counter + AuditDivergence event + tail-retained trace
+        # (reason=audit) + tenant-journal persist — and NEVER convicts the
+        # tenant (contrast: PR 12's poison-member quarantine, which fires
+        # on per-member validation/NaN faults, i.e. BAD INPUTS).
+        self.shadow_audit = bool(shadow_audit)
+        self._audit_rr = 0
+        self.audit_divergences = 0
+        self.audit_last: dict | None = None
+        self.audit_overhead_ns = 0
+        # the audit runs on its OWN worker, never the scheduler thread:
+        # the reference re-sim (and its first-window compile, seconds)
+        # must not stall the next coalescing window's dispatch. Bounded
+        # queue; a full queue drops the window's audit (counted skipped).
+        self._audit_q: "_queue.Queue | None" = None
+        self._audit_stop = threading.Event()
+        self._audit_worker: threading.Thread | None = None
+        # batch-compat keys whose serial reference variant has already
+        # compiled: later audits at the key dispatch lock-free
+        self._audit_warmed: set = set()
+        if self.shadow_audit:
+            self._audit_q = _queue.Queue(maxsize=4)
+            self._audit_worker = threading.Thread(
+                target=self._audit_loop, daemon=True, name="ka-shadow-audit")
+            self._audit_worker.start()
         # warm restart: rehydrate per-tenant serving records persisted by
         # checkpoint() — steady tenants serve batched sims again without a
         # full world re-send (docs/ROBUSTNESS.md)
@@ -270,6 +304,10 @@ class SimulatorService:
         if self._scheduler is not None:
             self._scheduler.stop()
             self._scheduler = None
+        if self._audit_worker is not None:
+            self._audit_stop.set()
+            self._audit_worker.join(timeout=2.0)
+            self._audit_worker = None
         unregister_exposition(self.registry)
 
     def _note_gap(self, gap_s: float, cause: str) -> None:
@@ -372,6 +410,10 @@ class SimulatorService:
             # (world_store / stack_cache / marshal) are tagged — dropping
             # the default tenant must not deflate their census
             device.LEDGER.release(owner="tenant_export", tenant=tid)
+        # per-tenant shadow-audit families: the audited lanes died with the
+        # tenant; its check/divergence series must not linger either
+        self.registry.counter("shadow_audit_checks_total").zero_matching(
+            tenant=tid)
         # journal families are tenant-labelled too (TenantJournal); its ring
         # died with the _Tenant object, so its series must zero as well
         jt = tid or "default"
@@ -1599,11 +1641,212 @@ class SimulatorService:
         on_failure = ((lambda tks, e: self._bisect(
             tks, e, bisect_budget, bisect_tried))
             if bisect_budget is not None else self._batch_failure)
+        # shadow audit rides on_done (post-harvest, every member already
+        # resolved — audit latency never sits on a client's critical
+        # path); bisection re-dispatches are excluded: their windows exist
+        # to LOCALIZE a failure, not to re-verify healthy lanes
+        on_done = (self._shadow_audit_window
+                   if self.shadow_audit and bisect_budget is None else None)
         return b.InFlightBatch(
             tickets, fetch, assemble, batch_info,
+            on_done=on_done,
             on_failure=on_failure,
             on_member_fault=lambda t, e: self._quarantine_tenant(
                 t.tenant, self._fault_reason(e), error=e))
+
+    # ---- online shadow audit (one round-robin lane per window) ----------
+
+    def _audit_reference(self, t: "Ticket") -> dict:
+        """The independent reference verdict for one member: the SERIAL
+        (unbatched) sim program over the member's own lane tensors,
+        assembled into the same JSON shape the batched path produced.
+        Different compiled executable, same inputs — bit-identical by the
+        serial≡batched contract (tests/test_batched_sim.py), so any
+        difference is backend corruption, not modeling."""
+        from kubernetes_autoscaler_tpu.ops import autoscale_step as a
+        from kubernetes_autoscaler_tpu.sidecar import batch as b
+
+        ln = t.lane
+        nt = b.node_tensors(ln.nodes)
+        gt = b.podgroup_tensors(ln.groups)
+        pt = b.sched_tensors(ln.pods)
+        if t.kind == "up":
+            gr = b.nodegroup_tensors(ln.ng)
+            _, _, _, max_new, strategy = t.key
+            out = a.scale_up_sim(nt, gt, pt, gr, self.dims, max_new,
+                                 strategy)
+            host = {
+                "best": np.asarray(out.best)[None],
+                "node_count": np.asarray(out.estimate.node_count)[None],
+                "pods": np.asarray(out.scores.pods)[None],
+                "waste": np.asarray(out.scores.waste)[None],
+                "price": np.asarray(out.scores.price)[None],
+                "valid": np.asarray(out.scores.valid)[None],
+                "fits": np.asarray(out.fits_existing.sum(-1))[None],
+                "remaining": np.asarray(out.remaining.sum(-1))[None],
+            }
+            return b.assemble_up_one(host, ln, 0)
+        out = a.scale_down_sim(nt, gt, pt, ln.threshold,
+                               max_zones=self.dims.max_zones)
+        host = {
+            "eligible": np.asarray(out.eligible)[None],
+            "drainable": np.asarray(out.removal.drainable)[None],
+            "util": np.asarray(out.utilization)[None],
+        }
+        return b.assemble_down_one(host, ln, 0)
+
+    def _shadow_audit_window(self, batch) -> None:
+        """InFlightBatch.on_done hook (scheduler thread): pick ONE resolved
+        member of this window (round-robin over members, so every tenant's
+        lane is audited over time), snapshot its verdict, and hand it to
+        the audit worker — the scheduler thread pays a dict copy, never a
+        reference re-sim. Best-effort by contract."""
+        from kubernetes_autoscaler_tpu.audit.shadow import AUDIT_CHECKS_HELP
+
+        try:
+            tickets = [t for t in batch.tickets
+                       if isinstance(t.result, dict)]
+            if not tickets or self._audit_q is None:
+                return
+            t = tickets[self._audit_rr % len(tickets)]
+            self._audit_rr += 1
+            # verdict snapshot, taken NOW: the handler thread this ticket
+            # woke is concurrently annotating the same dict in place with
+            # per-request metadata (`lifecycle` stamps) the reference path
+            # never computes — retry the copy across that single-key
+            # insert instead of letting RuntimeError eat the audit
+            got = None
+            for _attempt in range(3):
+                try:
+                    got = {k: v for k, v in (t.result or {}).items()
+                           if k != "lifecycle"}
+                    break
+                except RuntimeError:
+                    continue
+            counter = self.registry.counter("shadow_audit_checks_total",
+                                            help=AUDIT_CHECKS_HELP)
+            if got is None:
+                counter.inc(surface=f"sidecar-{t.kind}",
+                            outcome="skipped", tenant=t.tenant)
+                return
+            try:
+                self._audit_q.put_nowait(
+                    (t, got, dict(batch.batch_info)))
+            except _queue.Full:
+                # the worker is behind (a reference compile in flight):
+                # drop THIS window's audit, accounted — never block the
+                # scheduler loop on verification
+                counter.inc(surface=f"sidecar-{t.kind}",
+                            outcome="skipped", tenant=t.tenant)
+        except Exception:  # noqa: BLE001 — best-effort evidence path
+            pass
+
+    def _audit_loop(self) -> None:
+        while not self._audit_stop.is_set():
+            try:
+                item = self._audit_q.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            t0 = _time.perf_counter_ns()
+            try:
+                self._audit_one(*item)
+            except Exception:  # noqa: BLE001 — the worker must survive
+                pass
+            finally:
+                self.audit_overhead_ns += _time.perf_counter_ns() - t0
+                self._audit_q.task_done()
+
+    def audit_quiesce(self, timeout_s: float = 30.0) -> bool:
+        """Wait for every enqueued audit to finish (tests/bench — audits
+        run async on the worker; asserting counters right after a window
+        resolves would race it). True when the queue drained."""
+        if self._audit_q is None:
+            return True
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            if self._audit_q.unfinished_tasks == 0:
+                return True
+            _time.sleep(0.02)
+        return False
+
+    def _audit_one(self, t: "Ticket", got: dict, batch_info: dict) -> None:
+        """Worker-thread body: the serial reference re-sim + compare for
+        one snapshotted member verdict."""
+        from kubernetes_autoscaler_tpu.audit.shadow import AUDIT_CHECKS_HELP
+
+        surface = f"sidecar-{t.kind}"
+        # compile-attribution hygiene: only the FIRST audit at a given
+        # batch-compat key can compile the serial reference variant — that
+        # one runs under _account_lock so the new-tenant charge window
+        # never sees audit-grown jit cache; every later audit at the key
+        # is a cache hit by construction and runs lock-free (the audit
+        # worker must never stall the scheduler's fresh-tenant windows in
+        # steady state)
+        if t.key in self._audit_warmed:
+            ref = self._audit_reference(t)
+        else:
+            with self._account_lock:
+                ref = self._audit_reference(t)
+            self._audit_warmed.add(t.key)
+        if ref == got:
+            self.registry.counter(
+                "shadow_audit_checks_total",
+                help=AUDIT_CHECKS_HELP).inc(
+                surface=surface, outcome="ok", tenant=t.tenant)
+            return
+        self.audit_divergences += 1
+        diff = sorted(k for k in set(ref) | set(got)
+                      if ref.get(k) != got.get(k))
+        self.audit_last = {
+            "tenant": t.tenant or "default", "kind": t.kind,
+            "batch": batch_info.get("batch_id", ""),
+            "fields": diff, "trace": t.trace_id or "",
+        }
+        self.registry.counter(
+            "shadow_audit_checks_total", help=AUDIT_CHECKS_HELP).inc(
+            surface=surface, outcome="divergent", tenant=t.tenant)
+        with self._events_lock:
+            self.events.emit(
+                "AuditDivergence", obj=t.tenant or "default",
+                reason=surface,
+                message=(f"batched verdict diverged from the serial "
+                         f"reference (fields: {', '.join(diff)}; "
+                         f"batch {self.audit_last['batch']}) — "
+                         f"backend fault, tenant NOT quarantined"))
+        # evidence: a retained trace (reason=audit) + the tenant's
+        # provenance ring persisted next to the SLO dumps
+        tr = trace.Tracer(trace_id=t.trace_id or None)
+        tr.add_span("shadow_audit_divergence", cat="audit",
+                    tenant=t.tenant or "default", kind=t.kind,
+                    batch=self.audit_last["batch"],
+                    fields=diff)
+        snap = tr.snapshot()
+        snap["tenant"] = t.tenant
+        self.tail.offer(snap, 0.0, reason="audit")
+        if self.slo_dump_dir:
+            ts = self._tenant_peek(t.tenant)
+            if ts is not None and ts.journal is not None:
+                try:
+                    os.makedirs(self.slo_dump_dir, exist_ok=True)
+                    ts.journal.maybe_persist(self.slo_dump_dir,
+                                             reason="audit_divergence")
+                except OSError:
+                    pass
+
+    def audit_stats(self) -> dict:
+        checks: dict[str, float] = {}
+        for key, v in self.registry.counter(
+                "shadow_audit_checks_total").items():
+            d = dict(key)
+            k = f"{d.get('surface', '?')}/{d.get('outcome', '?')}"
+            checks[k] = checks.get(k, 0.0) + v
+        return {
+            "enabled": self.shadow_audit,
+            "checks": checks,
+            "divergences": self.audit_divergences,
+            "last": self.audit_last,
+            "overhead_ms": round(self.audit_overhead_ns / 1e6, 3),
+        }
 
     def hbm_stats(self) -> dict:
         """The residency-ledger reconciliation, published into this
@@ -1761,6 +2004,24 @@ class SimulatorService:
             f"tail sampler: offered={tstats['offered']} "
             f"retained={tstats['retained']} evicted={tstats['evicted']} "
             f"held={tstats['held']} reasons={json.dumps(tstats['reasons'], sort_keys=True)}")
+        # shadow audit (docs/OBSERVABILITY.md "Shadow audit"): one
+        # round-robin lane per window re-verified against the serial
+        # reference — divergence is a backend fault, never a quarantine
+        au = self.audit_stats()
+        if au["enabled"]:
+            lines.append(
+                f"shadow audit: checks={json.dumps(au['checks'], sort_keys=True)} "
+                f"divergences={au['divergences']} "
+                f"overhead_ms={au['overhead_ms']}")
+            if au["last"]:
+                la = au["last"]
+                lines.append(
+                    f"  last divergence: tenant={la['tenant']} "
+                    f"kind={la['kind']} batch={la['batch']} "
+                    f"fields={','.join(la['fields'])} "
+                    f"trace={la['trace'] or '-'}")
+        else:
+            lines.append("shadow audit: disabled")
         # fault-domain isolation (docs/ROBUSTNESS.md): quarantine table,
         # window-failure/bisection accounting, rehydration + chaos plane
         qs = self.quarantine_stats()
@@ -2651,6 +2912,15 @@ def main(argv=None):
                          "jax.profiler.trace capture into this directory, "
                          "stamped with the retained trace id + journal "
                          "cursor")
+    ap.add_argument("--shadow-audit", action="store_true",
+                    help="online shadow audit: one round-robin member "
+                         "lane per batched window is re-simulated through "
+                         "the serial reference program on a dedicated "
+                         "worker and compared bit-for-bit — divergence is "
+                         "surfaced as a backend fault (counter + event + "
+                         "retained trace + tenant-journal persist), never "
+                         "a tenant quarantine (docs/OBSERVABILITY.md "
+                         "\"Shadow audit\")")
     ap.add_argument("--grpc-cert", default="")
     ap.add_argument("--grpc-key", default="")
     ap.add_argument("--grpc-client-ca", default="")
@@ -2674,7 +2944,8 @@ def main(argv=None):
                                rehydrate_dir=args.checkpoint_dir,
                                hbm_budget_frac=args.hbm_budget_frac,
                                hbm_limit_bytes=args.hbm_limit_bytes,
-                               device_profile_dir=args.device_profile_dir)
+                               device_profile_dir=args.device_profile_dir,
+                               shadow_audit=args.shadow_audit)
     if args.checkpoint_dir and service.rehydration["restored"]:
         print(f"katpu-sidecar rehydrated "
               f"{service.rehydration['restored']} tenants from "

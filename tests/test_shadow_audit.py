@@ -1,0 +1,538 @@
+"""Online shadow audit (ISSUE 15, audit/shadow.py): the host oracle twin,
+deterministic cursor-seeded sampling, forced-corruption detection with the
+complete evidence bundle, the supervisor coupling (suspect → forced heal →
+re-audit → degrade-on-persistence), budget/skip accounting, replay
+reproduction of the exact sample, and the sidecar's per-window lane audit
+(divergence is a backend fault — never a tenant conviction)."""
+
+import json
+import os
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from kubernetes_autoscaler_tpu.audit.shadow import ShadowAuditor, sample_indices
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.core.supervisor import (
+    BackendSupervisor,
+    load_restart_state,
+    save_restart_state,
+)
+from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+from kubernetes_autoscaler_tpu.models.api import Taint, Toleration
+from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.ops import predicates as preds
+from kubernetes_autoscaler_tpu.sidecar import faults
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import (
+    build_test_node,
+    build_test_pod,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---- the host oracle twin (ops/predicates.host_reason_row) --------------
+
+def test_host_reason_row_matches_device_reason_mask_fuzz():
+    """The audit's host oracle must be the BIT-FOR-BIT twin of the device
+    reason kernel over the same encoded planes — the exactness that makes
+    a divergence mean corruption, never modeling slack."""
+    rng = random.Random(20260804)
+    keys = ["disk", "pool", "arch"]
+    vals = ["a", "b", "c"]
+    for _trial in range(6):
+        nodes = []
+        for i in range(rng.randint(2, 6)):
+            labels = {k: rng.choice(vals) for k in keys
+                      if rng.random() < 0.5}
+            taints = [Taint(rng.choice(keys), rng.choice(vals + [""]),
+                            rng.choice(["NoSchedule", "NoExecute"]))
+                      for _ in range(rng.randint(0, 2))]
+            nodes.append(build_test_node(
+                f"n{i}", cpu_milli=rng.choice([500, 1000, 4000]),
+                mem_mib=rng.choice([512, 4096]), labels=labels,
+                taints=taints, ready=rng.random() > 0.2))
+        pods = []
+        for i in range(rng.randint(2, 7)):
+            sel = {k: rng.choice(vals) for k in keys
+                   if rng.random() < 0.3}
+            tols = []
+            if rng.random() < 0.5:
+                op = rng.choice(["Equal", "Exists"])
+                tols = [Toleration(
+                    key=rng.choice(keys), operator=op,
+                    value=rng.choice(vals) if op == "Equal" else "",
+                    effect=rng.choice(["NoSchedule", ""]))]
+            pods.append(build_test_pod(
+                f"p{i}", cpu_milli=rng.choice([100, 600, 2000]),
+                mem_mib=rng.choice([64, 1024]), node_selector=sel,
+                tolerations=tols, owner_name=f"rs{i}",
+                host_port=rng.choice([0, 0, 8080])))
+        for i in range(rng.randint(0, 2)):
+            q = build_test_pod(f"r{i}", cpu_milli=300, mem_mib=128,
+                               node_name=rng.choice(nodes).name,
+                               host_port=rng.choice([0, 8080]))
+            q.phase = "Running"
+            q.tolerations = [Toleration(key="", operator="Exists")]
+            pods.append(q)
+        enc = encode_cluster(nodes, pods)
+        dev = np.asarray(preds.reason_mask(enc.nodes, enc.specs))
+        for gi in range(dev.shape[0]):
+            host = preds.host_reason_row(enc.host_arrays, gi)
+            assert (host == dev[gi]).all(), (
+                gi, host.tolist(), dev[gi].tolist())
+
+
+def test_host_reason_row_names_a_flipped_bit():
+    nodes = [build_test_node("n0", cpu_milli=1000, mem_mib=1024)]
+    pods = [build_test_pod("p0", cpu_milli=4000, mem_mib=64,
+                           owner_name="rs")]
+    enc = encode_cluster(nodes, pods)
+    row = preds.host_reason_row(enc.host_arrays, 0)
+    assert preds.reason_bit_names(int(row[0])) == ["cpu"]
+
+
+# ---- deterministic sampling --------------------------------------------
+
+def test_sample_indices_deterministic_distinct_and_bounded():
+    a = sample_indices("seed:3", "scaleup-row", 8, 100)
+    b = sample_indices("seed:3", "scaleup-row", 8, 100)
+    assert a == b
+    assert len(a) == 8 and len(set(a)) == 8
+    assert all(0 <= x < 100 for x in a)
+    # different tag / seed → different draw (overwhelmingly)
+    assert a != sample_indices("seed:3", "drain", 8, 100)
+    assert a != sample_indices("seed:4", "scaleup-row", 8, 100)
+    # small populations: every index, no hang
+    assert sorted(sample_indices("s", "t", 8, 3)) == [0, 1, 2]
+    assert sample_indices("s", "t", 4, 0) == []
+
+
+# ---- supervisor coupling (unit) ----------------------------------------
+
+def test_supervisor_audit_divergence_ladder_and_clean_loop_guard():
+    reg = Registry()
+    sup = BackendSupervisor(registry=reg, probe=lambda: True)
+    sup.begin_loop()
+    sup.audit_divergence()
+    assert sup.state == "suspect" and sup.world_stale
+    assert reg.counter("backend_transitions_total").value(
+        **{"from": "healthy", "to": "suspect",
+           "cause": "audit_divergence"}) == 1
+    # the divergent loop COMPLETES — end_loop must not read it as clean
+    sup.end_loop()
+    assert sup.state == "suspect"
+    # the next loop really is clean → suspect resolves
+    sup.begin_loop()
+    sup.end_loop()
+    assert sup.state == "healthy"
+    # persistent divergence degrades from any non-degraded state
+    sup.begin_loop()
+    sup.audit_divergence(persistent=True)
+    assert sup.state == "degraded"
+    assert not sup.scale_down_safe()
+
+
+def test_restart_record_carries_audit_bundle(tmp_path):
+    path = str(tmp_path / "restart.json")
+    save_restart_state(path, now=100.0, journal_cursor=(3, "abc"),
+                       unneeded_since={"n1": 90.0}, scale_up_requests={},
+                       audit_bundle="/evidence/audit-000003.json")
+    rec = load_restart_state(path, now=110.0, max_age_s=600.0)
+    assert rec is not None
+    assert rec["auditBundle"] == "/evidence/audit-000003.json"
+
+
+# ---- end-to-end control-loop audit -------------------------------------
+
+def _world(n_nodes=8, pending=10, unfittable=0):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384, pods=64)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=100)
+    for i in range(n_nodes):
+        nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384,
+                             pods=64)
+        fake.add_existing_node("ng1", nd)
+        fake.add_pod(build_test_pod(
+            f"r{i}", cpu_milli=5000, mem_mib=2048,
+            owner_name=f"rs{i % 3}", node_name=nd.name))
+    for i in range(pending):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=400, mem_mib=256,
+                                    owner_name="prs"))
+    for i in range(unfittable):
+        # bigger than any existing node's free capacity AND the template —
+        # stays pending, so the scale-up path has a refusal to attribute
+        fake.add_pod(build_test_pod(f"big{i}", cpu_milli=32000,
+                                    mem_mib=512, owner_name="bigrs"))
+    return fake
+
+
+def _autoscaler(fake, holder, tmp_path, **kw):
+    base = dict(
+        shadow_audit=True,
+        shadow_audit_dir=str(tmp_path / "audit"),
+        shadow_audit_budget_ms=50.0,
+        journal_dir=str(tmp_path / "journal"),
+        flight_recorder_dir=str(tmp_path / "flight"),
+        node_shape_bucket=64, group_shape_bucket=16,
+        max_new_nodes_static=64, max_pods_per_node=16,
+        enable_dynamic_resource_allocation=False,
+        enable_csi_node_aware_scheduling=False,
+        scale_down_delay_after_add_s=0.0,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=3600.0),
+    )
+    base.update(kw)
+    reg = Registry()
+    return StaticAutoscaler(
+        fake.provider, fake, options=AutoscalingOptions(**base),
+        registry=reg, eviction_sink=fake,
+        walltime=lambda: holder["now"]), reg
+
+
+def test_healthy_loops_audit_with_zero_divergence(tmp_path):
+    fake = _world()
+    holder = {"now": 1000.0}
+    a, reg = _autoscaler(fake, holder, tmp_path)
+    for k in range(3):
+        holder["now"] = 1000.0 + 10 * k
+        st = a.run_once(now=holder["now"])
+        assert not st.audit_divergence
+    aud = a.shadow_auditor
+    assert aud.divergences == 0
+    assert aud.checks["plane"]["ok"] == 3
+    assert aud.checks["scaleup"]["ok"] > 0
+    assert aud.sample_log and aud.sample_log[-1]["seed"].endswith(":2")
+    # registry families flow
+    assert reg.counter("shadow_audit_checks_total").value(
+        surface="plane", outcome="ok") == 3
+    assert a.supervisor.state == "healthy"
+
+
+def test_flip_bit_detected_within_one_loop_with_full_bundle(tmp_path):
+    fake = _world()
+    holder = {"now": 1000.0}
+    a, reg = _autoscaler(fake, holder, tmp_path)
+    for k in range(2):
+        holder["now"] = 1000.0 + 10 * k
+        a.run_once(now=holder["now"])
+    faults.install([{"hook": "verdict_plane", "kind": "flip_bit",
+                     "times": 1}], seed=7)
+    holder["now"] = 1020.0
+    st = a.run_once(now=holder["now"])
+    # detected within the SAME loop the corruption appeared
+    assert st.audit_divergence and st.audit_bundle_path
+    assert a.supervisor.state == "suspect"
+    assert reg.counter("backend_transitions_total").value(
+        **{"from": "healthy", "to": "suspect",
+           "cause": "audit_divergence"}) == 1
+    # the complete evidence bundle
+    with open(st.audit_bundle_path) as f:
+        b = json.load(f)
+    assert b["kind"] == "shadow-audit-divergence"
+    assert b["journalCursor"] and b["journalCursor"][0] == 2
+    assert b["traceId"]
+    assert b["divergences"] and b["divergences"][0]["surface"] == "plane"
+    assert b["divergences"][0]["xorBits"] is not None
+    # the flight recorder dumped the ring with the audit reason
+    assert reg.counter("flight_recorder_dumps_total").value(
+        reason="audit_divergence") == 1
+    # the event surface carries the verdict
+    kinds = {e["kind"] for e in a.event_sink.snapshot()}
+    assert "AuditDivergence" in kinds
+    # next loop: forced full/audit_divergence re-encode + clean re-audit
+    holder["now"] = 1030.0
+    st2 = a.run_once(now=holder["now"])
+    assert not st2.audit_divergence
+    assert reg.counter("encoder_encodes_total").value(
+        mode="full", cause="audit_divergence") == 1
+    assert a.shadow_auditor.pending_recheck is None
+    assert a.supervisor.state == "healthy"
+    # the restart-record pointer mirrors hbm_dump_path semantics
+    assert a.last_audit_bundle == st.audit_bundle_path
+
+
+def test_persistent_divergence_degrades_and_refuses_both_directions(
+        tmp_path):
+    fake = _world(unfittable=1)
+    holder = {"now": 1000.0}
+    a, reg = _autoscaler(fake, holder, tmp_path)
+    for k in range(2):
+        holder["now"] = 1000.0 + 10 * k
+        a.run_once(now=holder["now"])
+    # every loop flips a bit: the post-heal re-audit diverges AGAIN
+    faults.install([{"hook": "verdict_plane", "kind": "flip_bit",
+                     "times": 0}], seed=7)
+    holder["now"] = 1020.0
+    a.run_once(now=holder["now"])
+    assert a.supervisor.state == "suspect"
+    holder["now"] = 1030.0
+    st = a.run_once(now=holder["now"])
+    assert st.audit_divergence
+    assert a.supervisor.state == "degraded"
+    assert a.shadow_auditor.degraded
+    # scale-up refused with the AuditDivergence reason on the gauge,
+    # status histogram and event surfaces; scale-down withheld with the
+    # same reason marking the would-be victims
+    holder["now"] = 1040.0
+    st2 = a.run_once(now=holder["now"])
+    assert "AuditDivergence" in a.scale_up_orchestrator.last_noscaleup
+    assert reg.gauge("unschedulable_pods_count").value(
+        reason="AuditDivergence") > 0
+    assert st2.scale_down_withheld
+    assert st2.scale_up is None or not st2.scale_up.scaled_up
+    kinds = {(e["kind"], e["reason"]) for e in a.event_sink.snapshot()}
+    assert ("NoScaleUp", "AuditDivergence") in kinds
+    # recovery: stop the corruption — probes pass, the forced heal runs,
+    # the re-audit comes back clean, and both directions re-enable
+    faults.clear()
+    for k in range(6):
+        holder["now"] = 1050.0 + 10 * k
+        a.run_once(now=holder["now"])
+    assert a.supervisor.state == "healthy"
+    assert not a.shadow_auditor.degraded
+    assert "AuditDivergence" not in a.scale_up_orchestrator.last_noscaleup
+
+
+def test_drain_surface_verifies_claimed_placements(tmp_path):
+    """A drainable verdict's claimed per-pod destinations replay clean
+    through the ConfirmOracle reference path (outcome=ok, not skipped):
+    the unsafe direction — the verdict that deletes a node — is what the
+    audit actually re-checks."""
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384, pods=64)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=100)
+    for i in range(4):
+        nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384,
+                             pods=64)
+        fake.add_existing_node("ng1", nd)
+    # two movable pods on n0 (low utilization ⇒ candidate; they fit n1-n3)
+    for j in range(2):
+        fake.add_pod(build_test_pod(f"m{j}", cpu_milli=500, mem_mib=256,
+                                    owner_name="rs", node_name="n0"))
+    holder = {"now": 1000.0}
+    a, _reg = _autoscaler(fake, holder, tmp_path)
+    for k in range(3):
+        holder["now"] = 1000.0 + 10 * k
+        a.run_once(now=holder["now"])
+    aud = a.shadow_auditor
+    assert aud.checks["drain"]["ok"] > 0, aud.checks
+    assert aud.checks["drain"]["divergent"] == 0
+    assert aud.sample_log[-1]["drain"] or aud.sample_log[-2]["drain"]
+
+
+def test_budget_exhaustion_skips_are_accounted(tmp_path):
+    fake = _world()
+    holder = {"now": 1000.0}
+    # a microscopic explicit budget: after the forgiven warmup, the
+    # sampled surfaces must SKIP (counted), while the always-on plane
+    # check keeps running every loop
+    a, reg = _autoscaler(fake, holder, tmp_path,
+                         shadow_audit_budget_ms=0.0001)
+    for k in range(4):
+        holder["now"] = 1000.0 + 10 * k
+        a.run_once(now=holder["now"])
+    aud = a.shadow_auditor
+    assert aud.checks["plane"]["ok"] == 4
+    skipped = (aud.checks["scaleup"]["skipped"]
+               + aud.checks["drain"]["skipped"])
+    assert skipped > 0
+    assert reg.counter("shadow_audit_checks_total").value(
+        surface="scaleup", outcome="skipped") > 0
+    assert aud.divergences == 0
+
+
+def test_replay_reproduces_exact_sample_indices(tmp_path):
+    """docs/REPLAY.md cursor-seeding contract: same cursor ⇒ same cells —
+    a recorded journal replays with loop-for-loop identical sample
+    provenance, so a recorded divergence is re-examinable offline."""
+    from kubernetes_autoscaler_tpu.replay.harness import replay_journal
+
+    fake = _world(n_nodes=6, pending=8)
+    holder = {"now": 1000.0}
+    a, _reg = _autoscaler(fake, holder, tmp_path)
+    for k in range(4):
+        holder["now"] = 1000.0 + 10 * k
+        if k == 2:   # churn so deltas exist
+            fake.remove_pod("p0")
+            fake.add_pod(build_test_pod("p99", cpu_milli=400, mem_mib=256,
+                                        owner_name="prs"))
+        a.run_once(now=holder["now"])
+    recorded = list(a.shadow_auditor.sample_log)
+    assert len(recorded) == 4
+    report = replay_journal(str(tmp_path / "journal"))
+    assert report["zeroDrift"] is True
+    assert report["audit"]["samples"] == recorded
+    assert report["audit"]["divergences"] == 0
+
+
+# ---- the flip_bit fault kind (unit) ------------------------------------
+
+def test_flip_bit_fault_flips_exactly_one_bit_deterministically():
+    plan = faults.install([{"hook": "verdict_plane", "kind": "flip_bit",
+                            "index": 2, "bit": 3, "times": 0}], seed=1)
+    payload = np.arange(8, dtype=np.int32)
+    out = plan.fire("verdict_plane", payload=payload)
+    assert out is not payload            # a copy — mirrors stay shared
+    assert (payload == np.arange(8)).all()
+    diff = np.nonzero(out != payload)[0]
+    assert diff.tolist() == [2]
+    assert int(out[2]) == 2 ^ (1 << 3)
+    # seeded pick is deterministic per spec
+    p2 = faults.FaultPlan([{"hook": "verdict_plane", "kind": "flip_bit",
+                            "times": 0}], seed=9)
+    a = p2.fire("verdict_plane", payload=np.zeros(16, np.int32))
+    p3 = faults.FaultPlan([{"hook": "verdict_plane", "kind": "flip_bit",
+                            "times": 0}], seed=9)
+    b = p3.fire("verdict_plane", payload=np.zeros(16, np.int32))
+    assert (a == b).any() and (a != 0).sum() == 1 and (a == b).all()
+    # non-integer / non-array payloads pass through untouched
+    f = np.zeros(4, np.float32)
+    assert plan.fire("verdict_plane", payload=f) is f
+    assert plan.fire("verdict_plane", payload=b"x") == b"x"
+
+
+# ---- sidecar per-window lane audit -------------------------------------
+
+_MIB = 1024 * 1024
+_NGS = [{"id": "ng-4c", "template": {"name": "t4", "capacity": {
+    "cpu": 4.0, "memory": 16384 * _MIB, "pods": 110}},
+    "max_new": 32, "price": 1.0}]
+
+
+def _tenant_delta(i):
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+
+    w = DeltaWriter()
+    for k in range(8):
+        w.upsert_node(build_test_node(
+            f"d{i}-n{k}", cpu_milli=2000 + 1000 * (k % 3), mem_mib=8192,
+            pods=110))
+    for k in range(24):
+        w.upsert_pod(build_test_pod(
+            f"d{i}-p{k}", cpu_milli=300, mem_mib=256,
+            owner_name=f"d{i}-rs{k % 3}",
+            node_name=f"d{i}-n{k % 8}" if k % 3 == 0 else ""))
+    return w.payload()
+
+
+def _drive(svc, rounds=2, tenants=3):
+    from kubernetes_autoscaler_tpu.sidecar.server import SimParams
+
+    def one(i, kind):
+        if kind == "up":
+            svc.scale_up_sim(SimParams(max_new_nodes=16,
+                                       node_groups=_NGS), tenant=f"t{i}")
+        else:
+            svc.scale_down_sim(SimParams(threshold=0.5), tenant=f"t{i}")
+
+    for _r in range(rounds):
+        for kind in ("up", "down"):
+            ths = [threading.Thread(target=one, args=(i, kind))
+                   for i in range(tenants)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+    # audits run async on the worker thread: drain before asserting
+    assert svc.audit_quiesce(60.0)
+
+
+def test_sidecar_window_audit_healthy_then_divergence_not_a_conviction(
+        tmp_path):
+    from kubernetes_autoscaler_tpu.metrics import metrics as m
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorService
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16, batch_lanes=2,
+                           batch_window_ms=5.0, shadow_audit=True,
+                           slo_dump_dir=str(tmp_path))
+    try:
+        for i in range(3):
+            ack = svc.apply_delta(_tenant_delta(i), tenant=f"t{i}")
+            assert not ack.get("error"), ack
+        _drive(svc)
+        st = svc.audit_stats()
+        assert st["divergences"] == 0
+        assert sum(st["checks"].values()) > 0
+        # Metricz ≡ /metrics: the per-tenant audit family appears in BOTH
+        # expositions identically (the row-for-row parity contract)
+        rows = [ln for ln in svc.metricz().splitlines()
+                if "shadow_audit_checks_total{" in ln]
+        assert rows
+        mux = m.expose_all_text()
+        for ln in rows:
+            assert ln in mux, ln
+        # statusz audit section
+        assert "shadow audit:" in svc.statusz()
+
+        # forced divergence: a corrupted reference — the backend path
+        # fires (counter + event + retained trace + journal persist) and
+        # the tenant is NOT quarantined
+        svc._audit_reference = lambda t: {"corrupt": True}
+        _drive(svc, rounds=1)
+        st = svc.audit_stats()
+        assert st["divergences"] >= 1
+        assert st["last"]["fields"]
+        assert len(svc.quarantine_stats()) == 0
+        retained = [s for s in svc.tail.traces()
+                    if s.get("retain_reason") == "audit"]
+        assert retained
+        with svc._events_lock:
+            kinds = {e["kind"] for e in svc.events.snapshot()}
+        assert "AuditDivergence" in kinds
+        dumps = [f for f in os.listdir(str(tmp_path))
+                 if f.startswith("journal-")]
+        assert dumps, "tenant journal not persisted on audit divergence"
+
+        # drop_tenant sweeps the per-tenant audit families
+        audited_tenant = st["last"]["tenant"]
+        tid = "" if audited_tenant == "default" else audited_tenant
+        assert svc.drop_tenant(tid)
+        for key, v in svc.registry.counter(
+                "shadow_audit_checks_total").items():
+            if ("tenant", tid) in key:
+                assert v == 0.0, (key, v)
+    finally:
+        svc.close()
+
+
+def test_sidecar_audit_disabled_by_default():
+    from kubernetes_autoscaler_tpu.sidecar.server import SimulatorService
+
+    svc = SimulatorService(node_bucket=16, group_bucket=16, batch_lanes=2,
+                           batch_window_ms=5.0)
+    try:
+        assert not svc.shadow_audit
+        assert "shadow audit: disabled" in svc.statusz()
+    finally:
+        svc.close()
+
+
+# ---- parity classification ---------------------------------------------
+
+def test_shadow_audit_families_classified_against_reference_taxonomy():
+    from kubernetes_autoscaler_tpu.metrics import parity
+
+    doc = " ".join(parity.SHADOW_AUDIT_FAMILIES.values())
+    for fam in ("shadow_audit_checks_total",
+                "shadow_audit_overhead_seconds_total",
+                "shadow_audit_bundles_total",
+                "shadow_audit_pending_recheck"):
+        assert fam in doc, fam
+    assert "AuditDivergence" in parity.UNREMOVABLE_REASONS_LOCAL
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    parity_md = open(os.path.join(root, "PARITY.md")).read()
+    assert "SHADOW_AUDIT_FAMILIES" in parity_md
+    assert "AuditDivergence" in parity_md
