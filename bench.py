@@ -255,6 +255,10 @@ def run_floor_child(metric: str, args) -> int:
         # over whatever backend serves; the block degrades WITH the floor
         # (device_stats_source flips to host-fallback) instead of vanishing
         cmd += ["--device-stats"]
+    if getattr(args, "fused", False):
+        # fused-vs-phased identity and round-trip evidence is backend-
+        # independent composition — it degrades WITH the floor
+        cmd += ["--fused"]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     print(f"[bench] degrading to CPU floor metric: {' '.join(cmd[1:])}",
@@ -484,6 +488,18 @@ def main() -> None:
                          "drift report (never-null on the CPU floor — "
                          "journaling and replay are host-side; "
                          "docs/REPLAY.md)")
+    ap.add_argument("--fused", action="store_true",
+                    help="fused single-dispatch loop smoke (ISSUE 17 / "
+                         "docs/FUSED_LOOP.md): drive twin worlds through "
+                         "identical churn plus a steady window — fused "
+                         "one-program loop vs the phased three-dispatch "
+                         "path — assert loop-for-loop decision identity, "
+                         "and print a fused_loop_e2e JSON line with both "
+                         "p50s, the speedup ratio, per-loop device round "
+                         "trips, the speculative hit rate on the steady "
+                         "window and steady-state recompiles (never-null "
+                         "on the CPU floor — the fused program is backend-"
+                         "independent composition)")
     ap.add_argument("--require-tpu", action="store_true",
                     help="disable the CPU-floor degradation: a missing/hung "
                          "TPU backend emits the null-value error JSON and "
@@ -988,6 +1004,18 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
                 "error": f"{type(e).__name__}: {e}",
             }), flush=True)
 
+    if getattr(args, "fused", False):
+        try:
+            with_timeout(lambda: bench_fused(args), seconds=600)()
+        except Exception as e:
+            print(f"[bench] fused phase failed: {type(e).__name__}: "
+                  f"{e}", file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "fused_loop_e2e", "value": None, "unit": "ms",
+                "error": f"{type(e).__name__}: {e}",
+            }), flush=True)
+
     if getattr(args, "shadow_audit", False):
         try:
             with_timeout(lambda: bench_shadow_audit(args), seconds=600)()
@@ -1027,7 +1055,8 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
             or args.journal or args.world_store \
             or getattr(args, "chaos_local", False) \
             or getattr(args, "device_stats", False) \
-            or getattr(args, "shadow_audit", False):
+            or getattr(args, "shadow_audit", False) \
+            or getattr(args, "fused", False):
         print(primary_line, flush=True)
 
 
@@ -2080,6 +2109,157 @@ def bench_world_store(args) -> None:
             h2d_full / max(h2d_delta_p50, 1e-9), 2),
         "modes": store.stats()["modes"],
         "verdicts_identical": identical,
+        "steady_state_recompiles": steady_recompiles,
+    }), flush=True)
+
+
+def bench_fused(args) -> None:
+    """--fused: the single-dispatch fused RunOnce as bench-evidenced
+    contract (ISSUE 17 / docs/FUSED_LOOP.md). Twin worlds under identical
+    deterministic churn — fused one-program loop vs the phased
+    three-dispatch path — must agree loop for loop on every decision
+    surface digest, then a steady no-churn window measures what the fusion
+    is for: loop p50 both ways, device round trips per loop (budget: <=2),
+    the speculative next-loop hit rate, and zero steady-state recompiles
+    of the fused program."""
+    import numpy as np
+
+    from kubernetes_autoscaler_tpu.config.options import (
+        AutoscalingOptions,
+        NodeGroupDefaults,
+    )
+    from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+    from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+    from kubernetes_autoscaler_tpu.ops import autoscale_step
+    from kubernetes_autoscaler_tpu.replay import journal as rj
+    from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    n_nodes = min(args.nodes, 192)
+    # pending must FIT existing capacity: a steady window only exists when
+    # the loop neither scales up nor actuates, so the speculative program's
+    # world fingerprint holds from one loop to the next
+    n_pend = min(max(args.pods // 4, 200), 2000)
+    churn_loops, steady_loops, churn = 6, 8, 8
+
+    def mk_pending(i: int):
+        return build_test_pod(f"p{i}", cpu_milli=250, mem_mib=256,
+                              owner_name=f"prs{i % 12}")
+
+    def build():
+        fake = FakeCluster()
+        tmpl = build_test_node("tmpl", cpu_milli=16000, mem_mib=65536,
+                               pods=110)
+        fake.add_node_group("ng1", tmpl, min_size=0, max_size=4 * n_nodes)
+        for i in range(n_nodes):
+            nd = build_test_node(f"n{i}", cpu_milli=16000, mem_mib=65536,
+                                 pods=110)
+            fake.add_existing_node("ng1", nd)
+            for j in range(2):
+                fake.add_pod(build_test_pod(
+                    f"r{i}-{j}", cpu_milli=3200, mem_mib=1024,
+                    owner_name=f"rs{i % 17}", node_name=nd.name))
+        for i in range(n_pend):
+            fake.add_pod(mk_pending(i))
+        return fake
+
+    def opts(fused: bool) -> AutoscalingOptions:
+        return AutoscalingOptions(
+            fused_loop=fused,
+            node_shape_bucket=64, group_shape_bucket=16,
+            max_new_nodes_static=64, max_pods_per_node=16, drain_chunk=64,
+            # plan-only shape: no deletions AND no soft-taint actuation —
+            # a tainted node is a changed world composition, which would
+            # (correctly) discard every speculative dispatch and leave the
+            # steady window with nothing to measure
+            max_bulk_soft_taint_count=0,
+            node_group_defaults=NodeGroupDefaults(
+                scale_down_unneeded_time_s=3600.0,   # plan, never actuate
+                scale_down_unready_time_s=3600.0),
+        )
+
+    worlds = [build(), build()]
+    autos = [StaticAutoscaler(w.provider, w, options=opts(fused),
+                              registry=Registry(), eviction_sink=w)
+             for w, fused in zip(worlds, (True, False))]
+    for a in autos:
+        a.capture_verdicts = True
+
+    wall_ms = [[], []]            # per-loop wall, fused / phased
+    steady_wall_ms = [[], []]     # the no-churn window only
+    round_trips: list[int] = []
+    steady_trips: list[int] = []
+    spec_hits = spec_discards = 0
+    identical = True
+    fused_loops = 0
+    seq = 0
+    cache_after_warm = None
+    for loop in range(churn_loops + steady_loops):
+        steady = loop >= churn_loops
+        if not steady:
+            for w in worlds:
+                for k in range(churn):
+                    w.remove_pod(f"p{(seq + k) % n_pend}")
+                    w.add_pod(mk_pending(n_pend + seq + k))
+            seq += churn
+        now = 1000.0 + 10.0 * loop
+        digests = []
+        for idx, a in enumerate(autos):
+            t0 = time.perf_counter()
+            st = a.run_once(now=now)
+            dt = (time.perf_counter() - t0) * 1000.0
+            wall_ms[idx].append(dt)
+            if steady:
+                steady_wall_ms[idx].append(dt)
+            digests.append(rj.surface_digests(rj.collect_outputs(a, st)))
+            if idx == 0:
+                fused_loops += st.fused_mode == "fused"
+                round_trips.append(st.loop_device_round_trips)
+                if steady:
+                    steady_trips.append(st.loop_device_round_trips)
+                    spec_hits += st.speculation == "hit"
+                spec_discards += st.speculation == "discard"
+        identical = identical and digests[0] == digests[1]
+        if loop == 0:
+            cache_after_warm = autoscale_step.run_once_fused._cache_size()
+    steady_recompiles = (autoscale_step.run_once_fused._cache_size()
+                         - cache_after_warm)
+
+    p50_fused = float(np.percentile(steady_wall_ms[0], 50))
+    p50_phased = float(np.percentile(steady_wall_ms[1], 50))
+    hit_rate = spec_hits / max(len(steady_wall_ms[0]), 1)
+    print(f"[bench-fused] nodes={n_nodes} pending={n_pend} "
+          f"loops={churn_loops}+{steady_loops} fused_loops={fused_loops} "
+          f"steady_p50_ms fused={p50_fused:.2f} phased={p50_phased:.2f} "
+          f"({p50_phased / max(p50_fused, 1e-9):.2f}x) "
+          f"round_trips max={max(round_trips)} "
+          f"steady_max={max(steady_trips)} "
+          f"spec hits={spec_hits}/{len(steady_wall_ms[0])} "
+          f"discards={spec_discards} recompiles={steady_recompiles} "
+          f"identical={identical}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "fused_loop_e2e",
+        "value": round(p50_fused, 3),
+        "unit": "ms",
+        "backend": ("cpu-floor" if args.smoke or args.floor_for
+                    else __import__("jax").default_backend()),
+        "nodes": n_nodes,
+        "pending": n_pend,
+        "loops": churn_loops + steady_loops,
+        "fused_loops": fused_loops,
+        "fused_p50_ms": round(p50_fused, 3),
+        "phased_p50_ms": round(p50_phased, 3),
+        "fused_speedup_vs_phased": round(
+            p50_phased / max(p50_fused, 1e-9), 2),
+        "loop_device_round_trips_max": max(round_trips),
+        "steady_round_trips_max": max(steady_trips),
+        "speculative_hits": spec_hits,
+        "speculative_hit_rate_steady": round(hit_rate, 3),
+        "speculative_discards": spec_discards,
+        "decisions_identical": identical,
         "steady_state_recompiles": steady_recompiles,
     }), flush=True)
 

@@ -253,6 +253,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-nodes-static", type=int, default=1024)
     p.add_argument("--drain-chunk", type=int, default=32)
     p.add_argument("--max-pods-per-node", type=int, default=128)
+    p.add_argument("--fused-loop", type=_bool, default=True,
+                   help="run filter/scale-up/scale-down as ONE fused device "
+                        "program with a single batched decision fetch "
+                        "(docs/FUSED_LOOP.md); false = phased dispatches")
     p.add_argument("--incremental-encode", type=_bool, default=True,
                    help="maintain the tensor snapshot across loops and apply "
                         "only deltas (reference rationale: DeltaSnapshotStore)")
@@ -373,6 +377,7 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         scale_down_simulation_timeout_s=args.scale_down_simulation_timeout,
         force_delete_unregistered_nodes=args.force_delete_unregistered_nodes,
         async_node_deletion=args.async_node_deletion,
+        fused_loop=args.fused_loop,
         incremental_encode=args.incremental_encode,
         incremental_resync_loops=args.incremental_resync_loops,
         incremental_verify_loops=args.incremental_verify_loops,

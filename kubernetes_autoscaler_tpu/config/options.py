@@ -220,6 +220,11 @@ class AutoscalingOptions:
     group_shape_bucket: int = 64
     drain_chunk: int = 32
     max_pods_per_node: int = 128
+    # single-dispatch fused RunOnce (docs/FUSED_LOOP.md): the loop's three
+    # device phases as one compiled program harvested in one batched fetch,
+    # with speculative next-loop overlap; False = phased dispatches (the
+    # comparison oracle — decisions are bit-identical either way)
+    fused_loop: bool = True
     # incremental tensor-snapshot maintenance across loops (the reference's
     # DeltaSnapshotStore rationale, store/delta.go:33-54, moved to the
     # string→tensor boundary); False = full encode_cluster every loop

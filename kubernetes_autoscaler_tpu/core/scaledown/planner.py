@@ -54,6 +54,20 @@ from kubernetes_autoscaler_tpu.resourcequotas.tracker import QuotaTracker
 _ALWAYS_FETCH = ("nodes.alloc", "specs.count")
 
 
+@dataclass
+class FusedScaleDown:
+    """Scale-down inputs harvested from the fused RunOnce decision fetch
+    (docs/FUSED_LOOP.md): the post-placement utilization vector (host) and
+    the device-resident all-nodes drain sweep. `Planner.update` consumes
+    these instead of dispatching its own utilization + simulate_removals
+    programs; the candidate SUBSET verdict the confirmation pass needs is
+    gathered from `removal_dev` rows and fetched in one transfer — the
+    loop's second (and last) device round trip."""
+
+    util: np.ndarray      # f32[N] raw node_utilization of the fused world
+    removal_dev: object   # RemovalResult (device), C == N, candidate i=row i
+
+
 def _mirror_hit(enc: "EncodedCluster", key: str, dev) -> bool:
     """One definition of the mirror-substitution contract, shared by
     `_hostarr` and the batched `Planner._fetch_host`: the mirror stands in
@@ -205,9 +219,19 @@ class Planner:
         # occupancy-plane prefetch heuristic: start optimistic, then track
         # whether the previous loop actually produced eligible candidates
         self._prefetch_occupancy = True
+        # per-loop host copies harvested from the fused decision fetch
+        # (docs/FUSED_LOOP.md): key → (device array identity, host copy).
+        # `nodes.alloc`/`specs.count` are _ALWAYS_FETCH under the mirror
+        # contract (post-placement state), but the fused decision already
+        # shipped exactly those post-placement planes — seeding them here
+        # makes nodes_to_delete's big host view transfer-free. The identity
+        # check self-invalidates on the next encode.
+        self._fused_host_overrides: dict[str, tuple] = {}
 
-    @staticmethod
-    def _split_mirror_hits(enc: EncodedCluster, items: dict
+    def seed_fused_overrides(self, items: dict[str, tuple]) -> None:
+        self._fused_host_overrides = dict(items)
+
+    def _split_mirror_hits(self, enc: EncodedCluster, items: dict
                            ) -> tuple[dict, dict]:
         """Partition `items` into (mirror hits as host arrays, misses) —
         the ONE definition of which reads are free; both the sync and async
@@ -215,7 +239,10 @@ class Planner:
         hits: dict[str, np.ndarray] = {}
         miss: dict[str, object] = {}
         for key, dev in items.items():
-            if _mirror_hit(enc, key, dev):
+            ov = self._fused_host_overrides.get(key)
+            if ov is not None and ov[0] is dev:
+                hits[key] = ov[1]
+            elif _mirror_hit(enc, key, dev):
                 hits[key] = np.asarray(enc.host_arrays[key])
             else:
                 miss[key] = dev
@@ -387,7 +414,8 @@ class Planner:
 
     def update(self, enc: EncodedCluster, nodes: list[Node],
                now: float | None = None,
-               inject_pods: list | None = None) -> PlannerState:
+               inject_pods: list | None = None,
+               precomputed: FusedScaleDown | None = None) -> PlannerState:
         now = time.time() if now is None else now
         self.state.evictions_injected = 0
         self.state.evictions_uninjectable = 0
@@ -401,8 +429,15 @@ class Planner:
         self.unremovable.update(now)
         if inject_pods:
             self._inject_evicted(enc, nodes, inject_pods)
+            # evicted-pod injection mutates enc.nodes.alloc AFTER the fused
+            # program ran — its utilization/drain outputs describe a world
+            # that no longer exists; fall back to phased dispatches (the
+            # phased oracle takes the same branch, so decisions still match)
+            precomputed = None
         n_real = len(nodes)
-        util = self._utilization(enc, nodes)
+        util = self._utilization(
+            enc, nodes,
+            precomputed_util=None if precomputed is None else precomputed.util)
         defaults = _ng_defaults(self.options)
 
         # Double buffer: the candidate-pool sort below needs the scheduled-pod
@@ -510,22 +545,32 @@ class Planner:
         # The per-candidate device verdict is "in isolation"; the sequential
         # confirmation pass in nodes_to_delete() resolves interactions.
         dest_allowed = np.ones((enc.nodes.n,), dtype=bool)
-        with self.phases.phase("dispatch", candidates=len(eligible_idx)):
-            removal = simulate_removals(
-                enc.nodes, enc.specs, enc.scheduled,
-                jnp.asarray(cand), jnp.asarray(dest_allowed),
-                max_pods_per_node=self.options.max_pods_per_node,
-                chunk=self.options.drain_chunk,
-                planes=enc.planes,
-                max_zones=enc.dims.max_zones,
-                with_constraints=enc.has_constraints,
-            )
-        # ONE device->host transfer for the whole verdict (the fields are
-        # consumed host-side here and in nodes_to_delete; per-leaf
-        # device_get costs one tunnel round trip EACH — 7 leaves ≈ 0.5 s
-        # per loop over the TPU tunnel)
-        with self.phases.phase("fetch"):
-            removal = fetch_result(removal, phases=self.phases)
+        if precomputed is not None:
+            # fused path: the all-nodes sweep already ran inside the fused
+            # program; gather the candidate rows on device and fetch them in
+            # one transfer. Per-candidate verdicts are computed in isolation,
+            # so row i of the all-N sweep IS the verdict the phased subset
+            # dispatch would produce (tests/test_fused_loop.py pins this).
+            with self.phases.phase("fetch", candidates=len(eligible_idx),
+                                   fused=1):
+                removal = self._subset_removal(precomputed.removal_dev, cand)
+        else:
+            with self.phases.phase("dispatch", candidates=len(eligible_idx)):
+                removal = simulate_removals(
+                    enc.nodes, enc.specs, enc.scheduled,
+                    jnp.asarray(cand), jnp.asarray(dest_allowed),
+                    max_pods_per_node=self.options.max_pods_per_node,
+                    chunk=self.options.drain_chunk,
+                    planes=enc.planes,
+                    max_zones=enc.dims.max_zones,
+                    with_constraints=enc.has_constraints,
+                )
+            # ONE device->host transfer for the whole verdict (the fields are
+            # consumed host-side here and in nodes_to_delete; per-leaf
+            # device_get costs one tunnel round trip EACH — 7 leaves ≈ 0.5 s
+            # per loop over the TPU tunnel)
+            with self.phases.phase("fetch"):
+                removal = fetch_result(removal, phases=self.phases)
         drainable = np.asarray(removal.drainable)
         # LAZY reason pass over the FAILED candidates only (ops/drain.
         # failure_reasons): which pod shape found no destination, or shape
@@ -586,6 +631,39 @@ class Planner:
         self.state.removal = removal
         self.state.candidate_indices = cand
         return self.state
+
+    def _subset_removal(self, removal_dev, cand: np.ndarray) -> RemovalResult:
+        """Gather the candidate rows out of the fused all-nodes drain sweep
+        and fetch them in ONE batched transfer. The gather index is padded to
+        a drain_chunk multiple (repeating the last candidate) so the tiny
+        device gather keys one executable shape per chunk bucket, mirroring
+        simulate_removals' own cache-stability contract."""
+        chunk = max(self.options.drain_chunk, 1)
+        c = int(cand.shape[0])
+        pad_c = max(((c + chunk - 1) // chunk) * chunk, chunk)
+        idx = np.zeros((pad_c,), np.int32)
+        idx[:c] = cand
+        if c:
+            idx[c:] = cand[-1]
+        gidx = jnp.asarray(idx)
+        sub = RemovalResult(
+            drainable=removal_dev.drainable[gidx],
+            has_blocker=removal_dev.has_blocker[gidx],
+            n_moved=removal_dev.n_moved[gidx],
+            n_failed=removal_dev.n_failed[gidx],
+            dest_node=removal_dev.dest_node[gidx],
+            pod_slot=removal_dev.pod_slot[gidx],
+            feas=removal_dev.feas,
+        )
+        host = fetch_result(sub, phases=self.phases)
+        return host.replace(
+            drainable=host.drainable[:c],
+            has_blocker=host.has_blocker[:c],
+            n_moved=host.n_moved[:c],
+            n_failed=host.n_failed[:c],
+            dest_node=host.dest_node[:c],
+            pod_slot=host.pod_slot[:c],
+        )
 
     def _mark(self, name: str, reason: str, now: float,
               message: str = "") -> None:
@@ -1035,15 +1113,22 @@ class Planner:
             qmin[slot] = int(limiter.min_for(name, 0))
         return qmin
 
-    def _utilization(self, enc: EncodedCluster, nodes: list[Node]) -> np.ndarray:
+    def _utilization(self, enc: EncodedCluster, nodes: list[Node],
+                     precomputed_util: np.ndarray | None = None) -> np.ndarray:
         """Per-node dominant-resource utilization, with daemonset and mirror
         pod usage excluded per the flags (reference: utilization/info.go
-        CalculateUtilization skipDaemonSetPods/skipMirrorPods)."""
+        CalculateUtilization skipDaemonSetPods/skipMirrorPods).
+
+        `precomputed_util` is the fused decision's host copy of the same
+        `node_utilization` program output — identical values, no dispatch."""
         n_real = len(nodes)
-        with self.phases.phase("dispatch"):
-            util_dev = util_ops.node_utilization(enc.nodes)
-        with self.phases.phase("fetch"):
-            util = np.asarray(util_dev)[:n_real]
+        if precomputed_util is not None:
+            util = np.asarray(precomputed_util)[:n_real]
+        else:
+            with self.phases.phase("dispatch"):
+                util_dev = util_ops.node_utilization(enc.nodes)
+            with self.phases.phase("fetch"):
+                util = np.asarray(util_dev)[:n_real]
         defaults = _ng_defaults(self.options)
         ignore_mirror = self.options.ignore_mirror_pods_utilization
         ignore_ds_ids: set[int] = set()

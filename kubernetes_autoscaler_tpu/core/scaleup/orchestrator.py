@@ -61,6 +61,41 @@ class ScaleUpResult:
     best: Option | None = None
 
 
+@dataclass
+class ScaleUpPrep:
+    """Host-side scale-up inputs assembled BEFORE the fused dispatch
+    (docs/FUSED_LOOP.md): the valid-group list, the marshalled group
+    tensors, and the limiter cap vector the fused program applies on
+    device. `limit_cap` is `combined_limit_vec` composed on the host with
+    numpy — all three built-in limiters are pure integer functions of the
+    cluster size and each group's max_new, both of which the host already
+    knows — so the fused program needs no limiter objects inside the trace
+    and the cap doubles as a stable speculation-key component."""
+
+    groups: list
+    upcoming_only: bool
+    templates: list
+    group_tensors: object        # NodeGroupTensors (device)
+    estimator: BinpackingEstimator
+    gpu_slot: int | None
+    limit_cap: np.ndarray        # i32[NG] host copy
+    limit_cap_dev: object        # i32[NG] device upload (jit input)
+
+
+@dataclass
+class FusedScaleUp:
+    """Precomputed scale-up decision inputs harvested from a FusedDecision:
+    host numpy est rows + scores. `ScaleUpOrchestrator.scale_up` consumes
+    these instead of dispatching its estimate/score program — the rest of
+    the policy path (options, expander, balancing, quota, execution,
+    refusal reasons) is byte-for-byte the phased code."""
+
+    prep: ScaleUpPrep
+    est: object                  # .node_count i32[NG], .scheduled i32[NG, G]
+    scores: object               # OptionScores with host numpy leaves
+    pending_total: int = 0       # post-filter pending pods (decision tensor)
+
+
 class ScaleUpOrchestrator:
     def __init__(
         self,
@@ -120,6 +155,9 @@ class ScaleUpOrchestrator:
         # accepted scale-up and are refreshed as two small arrays instead of
         # re-encoding + re-uploading the whole NodeGroupTensors per loop
         self._group_tensor_cache: tuple | None = None
+        # last group-tensor fingerprint, exported as a value-based
+        # speculation-key component (docs/FUSED_LOOP.md)
+        self._last_group_fp: tuple | None = None
         # composition-fingerprint memos (utils/canonical.IdentityMemo): the
         # template-tensor cache key used to re-walk every template's labels/
         # taints/capacity and every DaemonSet's spec each loop; per-object
@@ -151,25 +189,8 @@ class ScaleUpOrchestrator:
 
     # ---- the main entry (reference: ScaleUp :88) ----
 
-    def scale_up(self, enc: EncodedCluster, nodes_count: int,
-                 now: float | None = None) -> ScaleUpResult:
-        now = time.time() if now is None else now
-        self.last_noscaleup = {}
-        self.last_noscaleup_groups = []
-        pending_total = int(np.asarray(enc.specs.count).sum())
-        if pending_total == 0:
-            return ScaleUpResult(scaled_up=False)
-
-        if self.audit_gate is not None and self.audit_gate():
-            # persistent shadow-audit divergence: refuse rather than scale
-            # on corrupt verdict bits. Every pending group gets the
-            # AuditDivergence verdict on all four reason surfaces (event /
-            # status / unschedulable_pods_count{reason} / snapshotz) — no
-            # device dispatch, the plane is exactly what is not trusted.
-            self._refuse_all_pending(enc, "AuditDivergence", now)
-            return ScaleUpResult(scaled_up=False,
-                                 pods_remaining=pending_total)
-
+    def _candidate_groups(self, enc: EncodedCluster,
+                          now: float) -> tuple[list[NodeGroup], bool]:
         groups = self._valid_groups(now)
         # candidate extension (reference: NodeGroupListProcessor — the
         # autoprovisioning variant appends not-yet-existing groups)
@@ -184,17 +205,10 @@ class ScaleUpOrchestrator:
             groups = [g for g in before
                       if not self.async_creator.is_upcoming(g.id())]
             upcoming_only = bool(before) and not groups
-        if not groups:
-            # no candidate group exists — every pending group gets the
-            # summary reason without any device dispatch. If candidates
-            # exist but are all still being created, "no node group can
-            # help" would be false — capacity for these pods is in flight —
-            # so no refusal verdict is recorded.
-            if not upcoming_only:
-                self._note_no_groups(enc, now)
-            return ScaleUpResult(scaled_up=False, pods_remaining=pending_total)
+        return groups, upcoming_only
 
-        estimator = BinpackingEstimator(
+    def _build_estimator(self, enc: EncodedCluster) -> BinpackingEstimator:
+        return BinpackingEstimator(
             enc.dims,
             max_new_nodes_static=self.options.max_new_nodes_static,
             limiters=[
@@ -207,6 +221,8 @@ class ScaleUpOrchestrator:
             with_constraints=enc.has_constraints,
             mesh=self.mesh,
         )
+
+    def _templates_for(self, groups: list[NodeGroup]) -> list:
         templates = []
         for g in groups:
             tmpl = g.template_node_info()
@@ -216,17 +232,116 @@ class ScaleUpOrchestrator:
                 tmpl.unschedulable = False
             templates.append((tmpl, g.max_size() - g.target_size(),
                               getattr(g, "price_per_node", 1.0)))
+        return templates
+
+    def prepare_fused(self, enc: EncodedCluster, nodes_count: int,
+                      now: float) -> ScaleUpPrep | None:
+        """Assemble the scale-up half of the fused program's inputs before
+        dispatch. Returns None when no candidate node group exists (the
+        fused loop then runs phased — there are no group tensors to trace
+        over). The host-composed `limit_cap` replicates
+        `combined_limit_vec` over the three built-in limiters exactly;
+        tests/test_fused_loop.py pins the equivalence."""
+        import jax.numpy as jnp
+
+        groups, upcoming_only = self._candidate_groups(enc, now)
+        if not groups:
+            return None
+        estimator = self._build_estimator(enc)
+        templates = self._templates_for(groups)
         with self.phases.phase("encode", groups=len(groups)):
             group_tensors = self._group_tensors(templates, enc)
-        with self.phases.phase("dispatch", groups=len(groups),
-                               pending=pending_total):
-            est = estimator.estimate_all_groups(enc.specs, group_tensors,
-                                                nodes_count)
-            scores = scoring.score_options(est, group_tensors, specs=enc.specs)
-        # non-allocating lookup: try_slot_for would BURN one of the four
-        # extended slots for the GPU name even on GPU-less clusters (any
-        # GPU-bearing template/node already allocated it at encode time)
+        max_new = np.zeros((int(group_tensors.ng),), np.int32)
+        for i, (_tmpl, mx, _pr) in enumerate(templates):
+            max_new[i] = mx
+        cap = np.full_like(max_new, np.int32(1 << 30))
+        cap = np.minimum(cap, np.int32(self.options.max_nodes_per_scaleup))
+        if self.options.max_nodes_total > 0:
+            cap = np.minimum(cap, np.int32(
+                max(self.options.max_nodes_total - nodes_count, 0)))
+        cap = np.minimum(cap, np.maximum(max_new, 0))
         gpu_slot = enc.registry.slots.get(self.provider.gpu_resource_name())
+        # cache the device upload on the cap BYTES: steady loops reuse the
+        # same buffer (zero h2d), and a byte change is a speculation-key miss
+        cached = getattr(self, "_limit_cap_cache", None)
+        if cached is not None and np.array_equal(cached[0], cap):
+            cap_dev = cached[1]
+        else:
+            cap_dev = jnp.asarray(cap)
+            self._limit_cap_cache = (cap, cap_dev)
+        return ScaleUpPrep(groups=groups, upcoming_only=upcoming_only,
+                           templates=templates, group_tensors=group_tensors,
+                           estimator=estimator, gpu_slot=gpu_slot,
+                           limit_cap=cap, limit_cap_dev=cap_dev)
+
+    def scale_up(self, enc: EncodedCluster, nodes_count: int,
+                 now: float | None = None,
+                 precomputed: FusedScaleUp | None = None) -> ScaleUpResult:
+        now = time.time() if now is None else now
+        self.last_noscaleup = {}
+        self.last_noscaleup_groups = []
+        if precomputed is not None:
+            # the fused decision tensors carry the post-filter pending count;
+            # reading enc.specs.count here would force a device sync
+            pending_total = int(precomputed.pending_total)
+        else:
+            pending_total = int(np.asarray(enc.specs.count).sum())
+        if pending_total == 0:
+            return ScaleUpResult(scaled_up=False)
+
+        if self.audit_gate is not None and self.audit_gate():
+            # persistent shadow-audit divergence: refuse rather than scale
+            # on corrupt verdict bits. Every pending group gets the
+            # AuditDivergence verdict on all four reason surfaces (event /
+            # status / unschedulable_pods_count{reason} / snapshotz) — no
+            # device dispatch, the plane is exactly what is not trusted.
+            self._refuse_all_pending(enc, "AuditDivergence", now)
+            return ScaleUpResult(scaled_up=False,
+                                 pods_remaining=pending_total)
+
+        if precomputed is not None:
+            groups = precomputed.prep.groups
+            upcoming_only = precomputed.prep.upcoming_only
+        else:
+            groups, upcoming_only = self._candidate_groups(enc, now)
+        if not groups:
+            # no candidate group exists — every pending group gets the
+            # summary reason without any device dispatch. If candidates
+            # exist but are all still being created, "no node group can
+            # help" would be false — capacity for these pods is in flight —
+            # so no refusal verdict is recorded.
+            if not upcoming_only:
+                self._note_no_groups(enc, now)
+            return ScaleUpResult(scaled_up=False, pods_remaining=pending_total)
+
+        if precomputed is not None:
+            # fused path: est/scores were computed INSIDE the fused program
+            # and harvested with the loop's single decision fetch — no
+            # dispatch here. The estimator still re-estimates for lossy
+            # winner verification; point it at the post-placement world the
+            # phased estimator would have been built from.
+            estimator = precomputed.prep.estimator
+            estimator.planes = enc.planes
+            estimator.nodes = enc.nodes
+            group_tensors = precomputed.prep.group_tensors
+            gpu_slot = precomputed.prep.gpu_slot
+            est = precomputed.est
+            scores = precomputed.scores
+        else:
+            estimator = self._build_estimator(enc)
+            templates = self._templates_for(groups)
+            with self.phases.phase("encode", groups=len(groups)):
+                group_tensors = self._group_tensors(templates, enc)
+            with self.phases.phase("dispatch", groups=len(groups),
+                                   pending=pending_total):
+                est = estimator.estimate_all_groups(enc.specs, group_tensors,
+                                                    nodes_count)
+                scores = scoring.score_options(est, group_tensors,
+                                               specs=enc.specs)
+            # non-allocating lookup: try_slot_for would BURN one of the four
+            # extended slots for the GPU name even on GPU-less clusters (any
+            # GPU-bearing template/node already allocated it at encode time)
+            gpu_slot = enc.registry.slots.get(self.provider.gpu_resource_name())
         with self.phases.phase("fetch"):
             options = options_from_scores(scores, [g.id() for g in groups],
                                           groups=groups, gpu_slot=gpu_slot,
@@ -529,6 +644,10 @@ class ScaleUpOrchestrator:
             enc.dims,
             tuple(self._workload_sig_memo.refresh(self.daemonsets)),
         )
+        # value-based fingerprint for the speculation key: the cache-hit
+        # path below rebuilds max_new/price arrays every loop, so object
+        # identity on the tensors never holds across loops
+        self._last_group_fp = fp
         cached = self._group_tensor_cache
         if cached is not None and cached[0] == fp:
             gt = cached[1]

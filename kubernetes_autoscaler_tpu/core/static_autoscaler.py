@@ -27,8 +27,12 @@ from kubernetes_autoscaler_tpu.config.options import AutoscalingOptions
 from kubernetes_autoscaler_tpu.core.scaledown.actuator import Actuator
 from kubernetes_autoscaler_tpu.core.scaledown.latencytracker import NodeLatencyTracker
 from kubernetes_autoscaler_tpu.core.scaledown.pdb import RemainingPdbTracker
-from kubernetes_autoscaler_tpu.core.scaledown.planner import Planner
+from kubernetes_autoscaler_tpu.core.scaledown.planner import (
+    FusedScaleDown,
+    Planner,
+)
 from kubernetes_autoscaler_tpu.core.scaleup.orchestrator import (
+    FusedScaleUp,
     ScaleUpOrchestrator,
     ScaleUpResult,
 )
@@ -39,6 +43,7 @@ from kubernetes_autoscaler_tpu.metrics.metrics import HealthCheck, Registry, def
 from kubernetes_autoscaler_tpu.metrics.trace import FlightRecorder
 from kubernetes_autoscaler_tpu.models.api import Node, Pod
 from kubernetes_autoscaler_tpu.models.encode import encode_cluster
+from kubernetes_autoscaler_tpu.ops import hostfetch
 from kubernetes_autoscaler_tpu.observers.nodegroupchange import (
     NodeGroupChangeObserverList,
 )
@@ -90,6 +95,13 @@ class RunOnceStatus:
     # record both carry the evidence pointer across a crash
     audit_divergence: bool = False
     audit_bundle_path: str = ""
+    # fused single-dispatch loop (docs/FUSED_LOOP.md): which mode this loop
+    # actually ran ("fused" / "phased"), the device round trips it cost
+    # (counted at the hostfetch layer), and the speculation outcome for the
+    # fused program harvested this loop ("hit" / "discard" / "none")
+    fused_mode: str = "phased"
+    loop_device_round_trips: int = 0
+    speculation: str = "none"
 
 
 class StaticAutoscaler:
@@ -317,6 +329,17 @@ class StaticAutoscaler:
         self._world_store = None
         self._encoder = None
         self._last_lowering_key = None
+        # fused-loop state (docs/FUSED_LOOP.md): the per-loop context built
+        # by _fused_dispatch, the in-flight speculative dispatch issued at
+        # the END of the previous loop, the last discarded speculation
+        # (kept for the mismatch-injection test to compare against the
+        # committed decision), and the fused program's last observed
+        # compile-cache size (growth = a recompile event)
+        self._fused_ctx = None
+        self._speculation = None
+        self.last_speculation = None
+        self._fused_cache_size = 0
+        self._fused_census = None
 
         # ProvisioningRequest wiring (reference: builder/autoscaler.go wraps
         # the scale-up orchestrator when ProvReq support is on) — active when
@@ -486,6 +509,10 @@ class StaticAutoscaler:
     def _run_once_inner(self, now: float) -> RunOnceStatus:
         status = RunOnceStatus()
         status.backend_state = self.supervisor.state
+        # per-loop device round-trip meter (counted where the transfers
+        # actually happen — the hostfetch layer; docs/FUSED_LOOP.md)
+        hostfetch.reset_round_trips()
+        self._fused_ctx = None
         self.event_sink.begin_loop()
         # recovery probe when the ladder is off healthy (no-op otherwise);
         # may advance degraded → recovering or demote suspect → degraded
@@ -782,17 +809,42 @@ class StaticAutoscaler:
                 })
 
             # filter-out-schedulable (reference: PodListProcessor.Process :530)
-            with self.metrics.time_function("filter_out_schedulable"):
-                packed = self.supervisor.guard(
-                    "dispatch", snapshot.schedule_pending_on_existing)
-                snapshot.apply_placement(packed.placed)
+            # — under --fused-loop the filter, the scale-up sim across every
+            # expansion option and the scale-down drain screen run as ONE
+            # compiled device program whose compact decision tensors are
+            # harvested in a single batched fetch (docs/FUSED_LOOP.md);
+            # host code below becomes pure policy over ~KB of numpy
+            fused = None
+            if self.options.fused_loop:
+                fused = self._fused_dispatch(enc, snapshot, nodes, pods, now)
+            self._fused_ctx = fused
+            if fused is None:
+                with self.metrics.time_function("filter_out_schedulable"):
+                    packed = self.supervisor.guard(
+                        "dispatch", snapshot.schedule_pending_on_existing)
+                    snapshot.apply_placement(packed.placed)
+                packed_scheduled = packed.scheduled
+            else:
+                # the fused program already applied the placement on device;
+                # swap its post-placement resident tensors into the snapshot
+                # (same arithmetic as apply_placement — pinned by
+                # tests/test_fused_loop.py)
+                snapshot.state.nodes = fused["nodes"]
+                snapshot.state.specs = fused["specs"]
+                packed_scheduled = fused["resident"].verdict
             if self.journal is not None or self.capture_verdicts \
                     or self.shadow_auditor is not None:
                 # the filter-out-schedulable verdict plane, byte-preserved
                 # into the journal record (one tiny int32[G] fetch, charged
                 # to the journal's overhead meter)
                 jt0 = time.perf_counter_ns()
-                plane = np.asarray(packed.scheduled).astype(np.int32)
+                if fused is not None:
+                    # the verdict already rode the decision fetch — this is
+                    # a host-side copy, not a device read
+                    plane = np.asarray(
+                        fused["decision"].verdict).astype(np.int32)
+                else:
+                    plane = np.asarray(packed_scheduled).astype(np.int32)
                 from kubernetes_autoscaler_tpu.sidecar import faults
 
                 if faults.PLAN is not None:
@@ -807,7 +859,7 @@ class StaticAutoscaler:
                 self.last_verdict_plane = plane
                 if self.shadow_auditor is not None:
                     self.shadow_auditor.capture_verdict(
-                        packed.scheduled, plane)
+                        packed_scheduled, plane)
                 if self.journal is not None:
                     self.journal.overhead_ns += time.perf_counter_ns() - jt0
                 if self.capture_verdicts:
@@ -822,12 +874,18 @@ class StaticAutoscaler:
                                 enc.pending_pods[idxs[0]])
                     self.last_verdict_keys = keys
             # the loop's first device→host sync point: a hung tunnel that
-            # survived the (async) dispatch manifests HERE
-            remaining = self.supervisor.guard(
-                "fetch",
-                lambda: int(np.asarray(snapshot.state.specs.count).sum()))
+            # survived the (async) dispatch manifests HERE (the fused path
+            # already paid it inside _fused_dispatch's guarded harvest)
+            if fused is not None:
+                remaining = int(fused["decision"].pending_after.sum())
+            else:
+                remaining = self.supervisor.guard(
+                    "fetch",
+                    lambda: int(np.asarray(snapshot.state.specs.count).sum()))
             if dbg is not None and dbg.is_data_collection_allowed():
-                scheduled_counts = np.asarray(packed.scheduled)
+                scheduled_counts = (
+                    np.asarray(fused["decision"].verdict) if fused is not None
+                    else np.asarray(packed_scheduled))
                 fitting = [
                     p for gi, slots in enumerate(enc.group_pods)
                     if gi < scheduled_counts.shape[0] and scheduled_counts[gi] > 0
@@ -854,7 +912,10 @@ class StaticAutoscaler:
             scaled_up = False
             if remaining > 0:
                 with self.metrics.time_function("scale_up"):
-                    result = self._dispatch_scale_up(enc, snapshot, nodes, now)
+                    result = self._dispatch_scale_up(
+                        enc, snapshot, nodes, now,
+                        precomputed=(fused["fused_up"]
+                                     if fused is not None else None))
                 status.scale_up = result
                 scaled_up = result.scaled_up
                 for cb in self.processors.on_scale_up_status:
@@ -916,7 +977,9 @@ class StaticAutoscaler:
                     self.planner.update(
                         enc, nodes, now,
                         inject_pods=self._evicted_pods_to_inject(
-                            source_pods, now))
+                            source_pods, now),
+                        precomputed=(fused["fused_down"]
+                                     if fused is not None else None))
                 status.unneeded_nodes = list(self.planner.state.unneeded)
                 # persist scale-down intent as soft taints (reference:
                 # actuation/softtaint.go UpdateSoftDeletionTaints) so a
@@ -1020,7 +1083,25 @@ class StaticAutoscaler:
             # commit the journal record once every decision surface is
             # settled, so the cursor exists before /snapshotz flushes and
             # before the trace root span closes
+            # fused-loop surfaces settle before the journal commit so the
+            # record carries them (top-level annotations — surface digests
+            # stay mode-independent, so a record written fused replays
+            # clean on the phased oracle; docs/REPLAY.md)
+            status.fused_mode = "fused" if fused is not None else "phased"
+            status.speculation = (fused["spec_outcome"]
+                                  if fused is not None else "none")
+            status.loop_device_round_trips = hostfetch.round_trips()
+            self.metrics.gauge(
+                "loop_device_round_trips",
+                help="Device round trips this loop, counted at the "
+                     "hostfetch layer (fused steady state: 1)").set(
+                float(status.loop_device_round_trips))
             if self.journal is not None:
+                self.journal.loop_annotations = {
+                    "fusedMode": status.fused_mode,
+                    "loopDeviceRoundTrips": status.loop_device_round_trips,
+                    "speculation": status.speculation,
+                }
                 jt0 = time.perf_counter_ns()
                 outputs = self._journal_mod.collect_outputs(self, status)
                 self.journal.overhead_ns += time.perf_counter_ns() - jt0
@@ -1032,9 +1113,12 @@ class StaticAutoscaler:
             # supervisor.end_loop (a divergent loop must not read as clean)
             if self.shadow_auditor is not None:
                 tr = trace.current_tracer()
-                rep = self.shadow_auditor.run_once_audit(
-                    planner=self.planner, cursor=self._journal_cursor,
-                    now=now, trace_id=tr.trace_id if tr else "")
+                # audit-only fetches are observability overhead, not part of
+                # the decision loop's round-trip budget
+                with hostfetch.suppress_counting():
+                    rep = self.shadow_auditor.run_once_audit(
+                        planner=self.planner, cursor=self._journal_cursor,
+                        now=now, trace_id=tr.trace_id if tr else "")
                 if rep is not None and rep["divergent"]:
                     self._audit_divergent_loop = True
                     status.audit_divergence = True
@@ -1090,6 +1174,13 @@ class StaticAutoscaler:
                         help="Restart-record writes that failed (the "
                              "previous intact record stays)").inc()
 
+            # speculative next-loop overlap (docs/FUSED_LOOP.md): dispatch
+            # loop k+1's fused program on the current resident world NOW so
+            # it computes while the host actuates; harvested next loop only
+            # on an exact composition-fingerprint match
+            if fused is not None:
+                self._maybe_speculate(now)
+
             # a loop that reached here had no guarded-phase incident: it
             # advances suspect → healthy / the recovering hysteresis count
             self.supervisor.end_loop()
@@ -1097,6 +1188,210 @@ class StaticAutoscaler:
             self.health.mark_active(now)
             self.event_sink.end_loop()
         return status
+
+    # ---- fused single-dispatch loop (docs/FUSED_LOOP.md) ----
+
+    def _fused_statics(self, enc) -> dict:
+        """The fused program's static (compile-keying) arguments — all
+        process-stable except `dims`, which moves only on a shape-bucket
+        regrowth (itself a recompile event on every path)."""
+        return {
+            "dims": enc.dims,
+            "max_new_nodes": self.options.max_new_nodes_static,
+            "max_pods_per_node": self.options.max_pods_per_node,
+            "chunk": self.options.drain_chunk,
+            "with_constraints": enc.has_constraints,
+        }
+
+    def _fused_group_sig(self, prep) -> tuple:
+        """Value signature of everything the scale-up half of the fused
+        program read from the group side. Object identity cannot gate a
+        speculation harvest here — the group-tensor cache refreshes
+        max_new/price as fresh device uploads every loop — so the signature
+        digests VALUES: the template/registry fingerprint plus the raw
+        max_new / price vectors and the composed limiter cap."""
+        mx = np.asarray([t[1] for t in prep.templates], np.int64)
+        pr = np.asarray([t[2] for t in prep.templates], np.float64)
+        return (self.scale_up_orchestrator._last_group_fp,
+                mx.tobytes(), pr.tobytes(), prep.limit_cap.tobytes())
+
+    def _fused_dispatch(self, enc, snapshot, nodes: list[Node],
+                        pods: list[Pod], now: float) -> dict | None:
+        """Dispatch run_once_fused — or harvest last loop's speculative
+        dispatch of it — and build the precomputed consumables for the host
+        policy path. Returns None when the fused program cannot run this
+        loop (multi-device mesh sharding, or no candidate node group to
+        trace over); the caller then takes the phased path, which remains
+        decision-identical (tests/test_fused_loop.py)."""
+        from kubernetes_autoscaler_tpu.ops import autoscale_step
+
+        if self.scale_up_orchestrator.mesh is not None:
+            # the sharded estimator owns mesh placement; the fused program
+            # is a single-device composition
+            return None
+        prep = self.scale_up_orchestrator.prepare_fused(enc, len(nodes), now)
+        if prep is None:
+            return None
+        import jax
+
+        st = snapshot.state
+        statics = self._fused_statics(enc)
+        world_fp = (self._world_store.composition_fingerprint(nodes, pods)
+                    if self._world_store is not None else None)
+        key = (world_fp, self._fused_group_sig(prep))
+        leaves = jax.tree_util.tree_leaves(
+            (st.nodes, st.specs, st.scheduled, st.planes))
+
+        spec, self._speculation = self._speculation, None
+        spec_outcome = "none"
+        decision = resident = None
+        if spec is not None:
+            # harvest gate: exact key match AND every traced input leaf is
+            # the very same device buffer the speculative program read —
+            # anything else discards, and a discard never influences a
+            # decision (the mismatch-injection test pins this)
+            match = (world_fp is not None
+                     and spec["key"] == key
+                     and spec["statics"] == statics
+                     and len(spec["leaves"]) == len(leaves)
+                     and all(a is b
+                             for a, b in zip(spec["leaves"], leaves)))
+            if match:
+                with self.metrics.time_function("fused_harvest"), \
+                        self.planner.phases.phase("fetch", fused=1,
+                                                  speculative=1):
+                    decision = self.supervisor.guard(
+                        "fetch", spec["handle"].get)
+                resident = spec["resident"]
+                spec_outcome = "hit"
+                self.metrics.counter(
+                    "speculative_hits_total",
+                    help="Speculative fused dispatches harvested on an "
+                         "exact composition-fingerprint match").inc()
+            else:
+                spec_outcome = "discard"
+                self.metrics.counter(
+                    "speculative_discards_total",
+                    help="Speculative fused dispatches discarded on a "
+                         "fingerprint/input mismatch").inc()
+                self.last_speculation = {"outcome": "discard",
+                                         "handle": spec["handle"],
+                                         "resident": spec["resident"],
+                                         "key": spec["key"]}
+        if decision is None:
+            if self._fused_census is None:
+                import os
+
+                self._fused_census = device_obs.CompileCensus(
+                    registry=self.metrics,
+                    mode=os.environ.get("KA_DEVICE_CENSUS", "cost"),
+                    sync_analysis=False)
+            args = (st.nodes, st.specs, st.scheduled, prep.group_tensors,
+                    prep.limit_cap_dev)
+            kwargs = dict(statics, planes=st.planes)
+            with self.metrics.time_function("fused_dispatch"), \
+                    self.planner.phases.phase("dispatch", fused=1):
+                dec_dev, resident = self.supervisor.guard(
+                    "dispatch",
+                    lambda: self._fused_census.dispatch(
+                        "run_once_fused", autoscale_step.run_once_fused,
+                        args, kwargs))
+            size = autoscale_step.run_once_fused._cache_size()
+            if size > self._fused_cache_size:
+                self.metrics.counter(
+                    "fused_program_compiles_total",
+                    help="Compiles of the fused RunOnce program (steady "
+                         "state: 0 growth)").inc(
+                    size - self._fused_cache_size)
+                self._fused_cache_size = size
+            # the loop's ONE decision fetch: ~KB of bit-packed verdict /
+            # option / drain tensors in a single batched transfer
+            with self.metrics.time_function("fused_harvest"), \
+                    self.planner.phases.phase("fetch", fused=1):
+                decision = self.supervisor.guard(
+                    "fetch",
+                    lambda: hostfetch.fetch_pytree(
+                        dec_dev, phases=self.planner.phases))
+
+        from types import SimpleNamespace
+
+        fused_up = FusedScaleUp(
+            prep=prep,
+            est=SimpleNamespace(node_count=decision.est_node_count,
+                                scheduled=decision.est_scheduled),
+            scores=decision.scores,
+            pending_total=int(decision.pending_after.sum()))
+        fused_down = FusedScaleDown(util=decision.util,
+                                    removal_dev=resident.removal)
+        # post-placement resident tensors, built like apply_placement: only
+        # alloc/count swap for the program's outputs; every OTHER leaf stays
+        # the original encoder array so the planner's host-mirror identity
+        # checks keep hitting (the jit returns fresh buffers for all outputs,
+        # including value-unchanged passthroughs — wholesale adoption of
+        # resident.nodes would silently turn every mirror read back into a
+        # device round trip)
+        res_nodes = st.nodes.replace(alloc=resident.nodes.alloc)
+        res_specs = st.specs.replace(count=resident.specs.count)
+        # host mirrors for the planner's always-fetch views: nodes_to_delete
+        # reads post-placement alloc + pending counts, both already in the
+        # decision tensors — seeding them makes that read transfer-free
+        self.planner.seed_fused_overrides({
+            "nodes.alloc": (resident.nodes.alloc,
+                            np.asarray(decision.alloc_after)),
+            "specs.count": (resident.specs.count,
+                            np.asarray(decision.pending_after)),
+        })
+        return {"prep": prep, "decision": decision, "resident": resident,
+                "nodes": res_nodes, "specs": res_specs,
+                "inputs": (st.nodes, st.specs, st.scheduled, st.planes),
+                "leaves": leaves, "statics": statics, "key": key,
+                "spec_outcome": spec_outcome,
+                "fused_up": fused_up, "fused_down": fused_down}
+
+    def _maybe_speculate(self, now: float) -> None:
+        """Speculative next-loop overlap: dispatch loop k+1's fused program
+        on the CURRENT resident world (the pre-placement tensors loop k
+        just ran on) so the device computes during host actuation time.
+        Issued only from a healthy backend over a verified world; harvested
+        next loop only through _fused_dispatch's exact-match gate."""
+        ctx = self._fused_ctx
+        if ctx is None or self._world_store is None:
+            return
+        if self.supervisor.state != "healthy" or self.supervisor.world_stale:
+            return
+        from kubernetes_autoscaler_tpu.ops import autoscale_step
+
+        nodes_t, specs_t, sched_t, planes_t = ctx["inputs"]
+        prep = ctx["prep"]
+
+        def _issue():
+            dec_dev, resident = autoscale_step.run_once_fused(
+                nodes_t, specs_t, sched_t, prep.group_tensors,
+                prep.limit_cap_dev,
+                planes=planes_t, **ctx["statics"])
+            # trace=False: the loop's trace spans close LIFO before the
+            # speculative result exists — the fetch span rides next loop's
+            # harvest instead
+            return (hostfetch.AsyncFetch(dec_dev, phases=None, trace=False),
+                    resident)
+
+        # under the SAME dispatch guard the phased loop uses: with
+        # speculation on, this is where the loop's program dispatch actually
+        # happens, so a hung device must book its incident here (PR 13
+        # semantics) and propagate like any other guarded-phase abort
+        try:
+            handle, resident = self.supervisor.guard("dispatch", _issue)
+        except Exception:
+            self.metrics.counter(
+                "speculative_errors_total",
+                help="Speculative fused dispatches that failed to issue").inc()
+            raise
+        self._speculation = {"key": ctx["key"], "statics": ctx["statics"],
+                             "leaves": ctx["leaves"], "handle": handle,
+                             "resident": resident, "issued_at": now}
+        self.metrics.counter(
+            "speculative_dispatches_total",
+            help="Speculative fused dispatches issued").inc()
 
     def _feed_snapshot_observability(self, dbg, tracer) -> None:
         """Attach the loop's phase breakdown + trace id + reason plane to an
@@ -1232,8 +1527,12 @@ class StaticAutoscaler:
         return out
 
     def _dispatch_scale_up(self, enc, snapshot, nodes: list[Node],
-                           now: float) -> ScaleUpResult:
-        result = self.scale_up_orchestrator.scale_up(enc, len(nodes), now)
+                           now: float, precomputed=None) -> ScaleUpResult:
+        # round 1 consumes the fused decision tensors when available; salvo
+        # rounds re-inject capacity and re-dispatch, so they always run the
+        # phased estimate/score path against the updated snapshot
+        result = self.scale_up_orchestrator.scale_up(enc, len(nodes), now,
+                                                     precomputed=precomputed)
         if not self.options.scale_up_salvo_enabled or not result.scaled_up:
             return result
         deadline = time.monotonic() + self.options.salvo_time_budget_s

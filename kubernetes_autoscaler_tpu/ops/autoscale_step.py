@@ -179,6 +179,121 @@ def scale_down_sim_batch(
     return jax.vmap(one)(nodes, specs, scheduled, thresholds)
 
 
+class FusedDecision(struct.PyTreeNode):
+    """Compact decision tensors of one fused RunOnce step — the ONLY thing
+    the host fetches on the fused hot path (docs/FUSED_LOOP.md). Everything
+    here is O(G + NG + N) — a few KB at the 50k-pod shape cut — and rides a
+    single bit-packed `ops/hostfetch.fetch_pytree` transfer. Host code
+    consumes these as pure policy inputs: the verdict bitplane feeds the
+    journal/shadow-audit surfaces, the estimate/score rows feed
+    `options_from_scores` + the expander unchanged, and the utilization +
+    drain verdict planes feed the scale-down planner's host screen."""
+
+    verdict: jax.Array        # i32[G] pods of each group placed on existing
+                              #   capacity (filter-out-schedulable verdicts)
+    pending_after: jax.Array  # i32[G] pod counts still pending after the
+                              #   filter placement (the scale-up problem)
+    est_node_count: jax.Array # i32[NG] nodes each expansion option adds
+    est_scheduled: jax.Array  # i32[NG, G] pods each option schedules
+    scores: OptionScores      # expander inputs incl. helped_req f32[NG, R]
+    util: jax.Array           # f32[N] post-placement node utilization
+    drainable: jax.Array      # bool[N] scale-down candidate screen verdicts
+    has_blocker: jax.Array    # bool[N] drain refused by a blocking pod
+    alloc_after: jax.Array    # i32[N, R] post-placement allocations — seeds
+                              #   the planner's host view so nodes_to_delete
+                              #   needs no extra `nodes.alloc` fetch
+
+
+class FusedResident(struct.PyTreeNode):
+    """Device-resident outputs of the fused step: the post-placement world
+    the rest of the loop continues from (snapshot.state.nodes/specs), the
+    full drain sweep for the planner's confirmation subset gather, and the
+    device verdict plane for shadow-audit sampling. Never fetched whole."""
+
+    nodes: NodeTensors
+    specs: PodGroupTensors
+    removal: drain.RemovalResult  # C == N (all-nodes sweep)
+    verdict: jax.Array            # i32[G] device copy of decision.verdict
+
+
+@partial(jax.jit, static_argnames=("dims", "max_new_nodes",
+                                   "max_pods_per_node", "chunk",
+                                   "with_constraints"))
+def run_once_fused(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors,
+    groups: NodeGroupTensors,
+    limit_cap: jax.Array,       # i32[NG] host-composed scale-up limiter cap
+    dims: Dims,
+    max_new_nodes: int = 256,
+    max_pods_per_node: int = 128,
+    chunk: int = 32,
+    planes=None,
+    with_constraints: bool = False,
+) -> tuple[FusedDecision, FusedResident]:
+    """The whole control-loop device content as ONE compiled program.
+
+    Composes the LIVE loop's three phases exactly as StaticAutoscaler runs
+    them phased (not the `run_once_sim` research shape): filter-out-
+    schedulable, then the scale-up estimate on the POST-placement world with
+    the group caps pre-composed on host (`limit_cap` replicates
+    BinpackingEstimator.combined_limit_vec — integer min of the static,
+    cluster-capacity and SNG limiters), then the scale-down drain sweep over
+    every node of the post-placement world. All integer/predicate arithmetic,
+    so decisions are bit-identical to the phased path by construction
+    (tests/test_fused_loop.py pins this per loop).
+
+    Inputs are NOT donated: the resident planes live in the WorldStore and
+    back the speculative next-loop dispatch (docs/FUSED_LOOP.md §speculation),
+    so every input buffer outlives the call by design.
+
+    The `jax.named_scope` blocks keep the three phases visible as separate
+    ranges inside the single fused span on device profiles."""
+    with jax.named_scope("fused_filter"):
+        packed = schedule.schedule_pending_on_existing(
+            nodes, specs, scheduled, planes=planes, max_zones=dims.max_zones,
+            with_constraints=with_constraints)
+        # identical arithmetic to TensorClusterSnapshot.apply_placement
+        add = jnp.einsum("gn,gr->nr",
+                         packed.placed.astype(jnp.int32), specs.req)
+        nodes2 = nodes.replace(alloc=nodes.alloc + add)
+        specs2 = specs.replace(
+            count=jnp.maximum(specs.count - packed.placed.sum(axis=1), 0))
+    with jax.named_scope("fused_scale_up"):
+        capped = groups.replace(
+            max_new=jnp.minimum(groups.max_new, limit_cap))
+        est = estimate_all(specs2, capped, dims, max_new_nodes,
+                           planes=planes, nodes=nodes2,
+                           with_constraints=with_constraints)
+        # scores on the UNCAPPED group tensors + post-placement specs —
+        # exactly ScaleUpOrchestrator's phased score_options call
+        sc = scoring.score_options(est, groups, specs=specs2)
+    with jax.named_scope("fused_scale_down"):
+        util = utilization.node_utilization(nodes2)
+        removal = drain.simulate_removals(
+            nodes2, specs2, scheduled,
+            jnp.arange(nodes.n, dtype=jnp.int32),
+            dest_allowed=jnp.ones((nodes.n,), bool),
+            max_pods_per_node=max_pods_per_node, chunk=chunk,
+            planes=planes, max_zones=dims.max_zones,
+            with_constraints=with_constraints)
+    decision = FusedDecision(
+        verdict=packed.scheduled,
+        pending_after=specs2.count,
+        est_node_count=est.node_count,
+        est_scheduled=est.scheduled,
+        scores=sc,
+        util=util,
+        drainable=removal.drainable,
+        has_blocker=removal.has_blocker,
+        alloc_after=nodes2.alloc,
+    )
+    resident = FusedResident(nodes=nodes2, specs=specs2, removal=removal,
+                             verdict=packed.scheduled)
+    return decision, resident
+
+
 @partial(jax.jit, static_argnames=("dims", "max_new_nodes", "strategy",
                                    "max_pods_per_node", "with_constraints"))
 def run_once_sim(

@@ -55,6 +55,47 @@ from kubernetes_autoscaler_tpu.sidecar import faults as _faults
 _SUPPORTED = ("bool", "int8", "int16", "int32", "uint8", "uint16",
               "float32")
 
+# Device round-trip accounting (docs/FUSED_LOOP.md): every synchronous
+# fetch and async harvest is one device→host round trip, counted here at
+# the layer where the transfer actually happens so no caller can forget to
+# report one. StaticAutoscaler resets the counter at loop start and stamps
+# the total into the journal record and the `loop_device_round_trips`
+# gauge; CI asserts <=2 on the fused steady state. Side-band transfers
+# that are not part of the decision path (shadow-audit samples, debugging
+# captures) run under `suppress_counting()` so sampled overhead does not
+# break the budget assertion.
+_ROUND_TRIPS = 0
+_COUNT_SUPPRESSED = 0
+
+
+def reset_round_trips() -> None:
+    global _ROUND_TRIPS
+    _ROUND_TRIPS = 0
+
+
+def round_trips() -> int:
+    return _ROUND_TRIPS
+
+
+def _bump_round_trip() -> None:
+    global _ROUND_TRIPS
+    if not _COUNT_SUPPRESSED:
+        _ROUND_TRIPS += 1
+
+
+class suppress_counting:
+    """Context manager: fetches inside do not count as loop round trips."""
+
+    def __enter__(self):
+        global _COUNT_SUPPRESSED
+        _COUNT_SUPPRESSED += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _COUNT_SUPPRESSED
+        _COUNT_SUPPRESSED -= 1
+        return False
+
 
 @jax.jit
 def _packed(tree):
@@ -131,6 +172,12 @@ def fetch_pytree(tree, phases=None):
     if _faults.PLAN is not None:
         _faults.PLAN.fire("local_fetch")
     leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if leaves and all(isinstance(x, np.ndarray) for x in leaves):
+        # already on host (fused harvest hands precomputed numpy scores to
+        # downstream consumers): no transfer, no round trip, and crucially
+        # no bounce through the pack program
+        return tree
+    _bump_round_trip()
     if len(leaves) <= 1:
         # one leaf is one transfer either way — skip the pack program (and
         # its per-structure jit cache entry; the planner's batched host
@@ -155,8 +202,20 @@ class AsyncFetch:
     __slots__ = ("_leaves", "_treedef", "_bufs", "_result", "_done",
                  "_tracer", "_span")
 
-    def __init__(self, tree, phases=None, span_name: str = "fetch"):
+    def __init__(self, tree, phases=None, span_name: str = "fetch",
+                 trace: bool = True):
         self._leaves, self._treedef = jax.tree_util.tree_flatten(tree)
+        if self._leaves and all(isinstance(x, np.ndarray)
+                                for x in self._leaves):
+            # every leaf already host-resident (planner mirror hits): no
+            # transfer, no round trip — and no bounce through the device
+            # pack program (same short-circuit as fetch_pytree)
+            self._result = tree
+            self._done = True
+            self._bufs = None
+            self._tracer = None
+            self._span = None
+            return
         self._bufs = _packed(tree)
         _account(phases, self._bufs, self._leaves)
         for buf in self._bufs:
@@ -165,7 +224,10 @@ class AsyncFetch:
                 start()
         self._result = None
         self._done = False
-        self._tracer = _trace.current_tracer()
+        # trace=False is for speculative issues (docs/FUSED_LOOP.md): the
+        # handle may be harvested a full loop later — or never — so it must
+        # not hold a slot on the LIFO span stack of the issuing loop's tracer
+        self._tracer = _trace.current_tracer() if trace else None
         self._span = (self._tracer.begin(span_name, cat="fetch",
                                          **{"async": True})
                       if self._tracer is not None else None)
@@ -177,6 +239,7 @@ class AsyncFetch:
             return self._result
         if _faults.PLAN is not None:
             _faults.PLAN.fire("local_fetch")
+        _bump_round_trip()
         b, i, f = jax.device_get(self._bufs)
         self._result = _unflatten(self._leaves, self._treedef, b, i, f)
         self._done = True
@@ -187,6 +250,6 @@ class AsyncFetch:
         return self._result
 
 
-def fetch_pytree_async(tree, phases=None) -> AsyncFetch:
+def fetch_pytree_async(tree, phases=None, trace: bool = True) -> AsyncFetch:
     """Issue a batched fetch without blocking; see AsyncFetch."""
-    return AsyncFetch(tree, phases=phases)
+    return AsyncFetch(tree, phases=phases, trace=trace)

@@ -315,12 +315,17 @@ def _verdict_diff(rec: dict, outputs: dict) -> list[dict]:
 
 
 def replay_journal(path: str, upto: int | None = None, diff: bool = False,
-                   keep_autoscaler: bool = False) -> dict:
+                   keep_autoscaler: bool = False,
+                   options_override: dict | None = None) -> dict:
     """Re-execute a journal; → drift report. `upto` stops after that loop
     index (earlier loops still replay — the autoscaler's cross-loop state
     is part of the recorded history). `diff=True` adds the reason-plane
     localization even for clean loops' drifted groups (drifted loops always
-    get it)."""
+    get it). `options_override` force-sets option fields AFTER the recorded
+    options are rebuilt — the fused-loop cross-oracle records with
+    --fused-loop and replays with {"fused_loop": False} (or vice versa) to
+    prove the two execution modes make bit-identical decisions
+    (docs/FUSED_LOOP.md)."""
     from kubernetes_autoscaler_tpu.cloudprovider.test_provider import (
         TestCloudProvider,
     )
@@ -330,6 +335,8 @@ def replay_journal(path: str, upto: int | None = None, diff: bool = False,
 
     meta, records, problems = load_journal(path)
     options = options_from_meta(meta)
+    for k, v in (options_override or {}).items():
+        setattr(options, k, v)
     provider = TestCloudProvider()
     src = ReplaySource()
     clock = {"now": 0.0}
@@ -365,7 +372,22 @@ def replay_journal(path: str, upto: int | None = None, diff: bool = False,
                          if digests.get(k) != rec["digests"][k])
         entry: dict = {"loop": rec["loop"], "record": rec["digest"],
                        "kind": rec["kind"], "surfaces": digests,
-                       "drift": drifted}
+                       "drift": drifted,
+                       # execution-mode provenance, recorded vs replayed
+                       # (docs/FUSED_LOOP.md): surface digests are mode-
+                       # independent, so a fusedMode mismatch here is
+                       # informational, never drift — it also lets the
+                       # report verify the phased twin saw identical worlds
+                       # even when the recorder harvested a SPECULATIVE
+                       # result for the loop
+                       "fusedMode": {"recorded": rec.get("fusedMode", ""),
+                                     "replayed": status.fused_mode},
+                       "loopDeviceRoundTrips": {
+                           "recorded": rec.get("loopDeviceRoundTrips"),
+                           "replayed": status.loop_device_round_trips},
+                       "speculation": {"recorded": rec.get("speculation",
+                                                           ""),
+                                       "replayed": status.speculation}}
         if drifted:
             drift_loops.append(rec["loop"])
             vdiff = _verdict_diff(rec, outputs)

@@ -389,6 +389,9 @@ class JournalWriter:
         self._last_digest = ""
         self._staged: dict | None = None
         self._staged_index: _WorldIndex | None = None
+        # per-loop top-level record annotations, set by the autoscaler
+        # before commit() and cleared after each sealed record
+        self.loop_annotations: dict = {}
         # canonical-form cache keyed by OBJECT IDENTITY (value holds the
         # object reference, so a freed id can never alias — the
         # host_mirror_token pattern). Valid under the repo-wide
@@ -474,6 +477,15 @@ class JournalWriter:
             self._staged = None
             rec["outputs"] = outputs
             rec["digests"] = surface_digests(outputs)
+            # loop-scoped annotations (fused-loop provenance: fusedMode /
+            # loopDeviceRoundTrips / speculation — docs/FUSED_LOOP.md) ride
+            # the record TOP LEVEL, not `outputs`: the surface digests the
+            # replay drift comparison checks stay mode-independent, so a
+            # record written fused replays clean on the phased oracle
+            if self.loop_annotations:
+                for k, v in self.loop_annotations.items():
+                    rec.setdefault(k, v)
+                self.loop_annotations = {}
             seal_record(rec)
             line = canonical(rec) + "\n"
             try:

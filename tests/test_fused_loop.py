@@ -1,0 +1,373 @@
+"""Single-dispatch fused RunOnce (ISSUE 17 / docs/FUSED_LOOP.md): the whole
+loop's device content as one compiled program, with speculative next-loop
+overlap.
+
+The core contracts pinned here:
+- fused decisions are BIT-IDENTICAL to the phased three-dispatch path,
+  loop for loop, across encode modes and churn (the fused program is a
+  composition of the same integer/predicate kernels, not a reimplementation)
+- the journal cross-oracle: a sequence recorded fused replays phased with
+  zero drift on every decision-surface digest
+- a speculative dispatch is harvested ONLY on an exact composition match;
+  a discarded speculation never influences a decision
+- the supervisor's phase guards cover the fused dispatch: a hung fused
+  program aborts at the phase budget, and the healed loop's decisions are
+  bit-identical to a cold comparator
+- the loop's device round-trip budget: <= 2 per loop (one decision fetch,
+  one drain-confirmation subset gather)
+- the host-composed scale-up limiter cap replicates combined_limit_vec
+- the fused all-nodes drain sweep is row-independent: any candidate
+  subset's rows match a dedicated subset sweep bit for bit
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+from kubernetes_autoscaler_tpu.replay import journal as rj
+from kubernetes_autoscaler_tpu.replay.harness import replay_journal
+from kubernetes_autoscaler_tpu.sidecar import faults
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import (
+    build_test_node,
+    build_test_pod,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _opts(**kw):
+    base = dict(
+        scale_down_delay_after_add_s=0.0,
+        scale_down_delay_after_failure_s=0.0,
+        node_shape_bucket=16, group_shape_bucket=16,
+        max_new_nodes_static=32, max_pods_per_node=32, drain_chunk=8,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=3600.0,
+            scale_down_unready_time_s=3600.0),
+    )
+    base.update(kw)
+    return AutoscalingOptions(**base)
+
+
+def _world(n_nodes=5, n_pending=6, seed=0):
+    """A mixed world: resident load, pending pods that fit, one low-util
+    drain candidate band."""
+    rng = np.random.RandomState(seed)
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=20)
+    for i in range(n_nodes):
+        nd = build_test_node(f"n{i}", cpu_milli=4000, mem_mib=8192)
+        fake.add_existing_node("ng1", nd)
+        if i % 2 == 0:
+            fake.add_pod(build_test_pod(
+                f"r{i}", cpu_milli=int(rng.choice([800, 1600])),
+                mem_mib=512, owner_name=f"rs{i % 3}", node_name=nd.name))
+    for i in range(n_pending):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=300, mem_mib=256,
+                                    owner_name="prs"))
+    return fake
+
+
+def _digest(a, st):
+    return rj.surface_digests(rj.collect_outputs(a, st))
+
+
+def _autoscaler(fake, **kw):
+    return StaticAutoscaler(fake.provider, fake, options=_opts(**kw),
+                            eviction_sink=fake, registry=Registry())
+
+
+# ------------------------------------------------- fused ≡ phased identity
+
+
+@pytest.mark.parametrize("incremental", [True, False])
+def test_fused_identical_to_phased_across_encode_modes(incremental):
+    """Twin worlds under identical churn: every loop's decision-surface
+    digests (verdict plane, scale-up choice, reason plane, drain set) must
+    match between the fused single-dispatch loop and the phased path."""
+    twins = [_world(seed=3), _world(seed=3)]
+    autos = [_autoscaler(f, fused_loop=fused,
+                         incremental_encode=incremental)
+             for f, fused in zip(twins, (True, False))]
+    for a in autos:
+        a.capture_verdicts = True
+    seq = 0
+    for loop in range(8):
+        for f in twins:
+            if loop % 3 == 1:       # pod churn
+                f.remove_pod(f"p{seq % 6}")
+                f.add_pod(build_test_pod(f"p{6 + seq}", cpu_milli=300,
+                                         mem_mib=256, owner_name="prs"))
+            if loop == 4:           # unfittable burst: real scale-up
+                f.add_pod(build_test_pod("burst", cpu_milli=3900,
+                                         mem_mib=512, owner_name="bg"))
+        if loop % 3 == 1:
+            seq += 1
+        sts = [a.run_once(now=1000.0 + 10 * loop) for a in autos]
+        assert sts[0].fused_mode == "fused"
+        assert sts[1].fused_mode == "phased"
+        assert sts[0].loop_device_round_trips <= 2, \
+            f"loop {loop}: {sts[0].loop_device_round_trips} round trips"
+        assert _digest(autos[0], sts[0]) == _digest(autos[1], sts[1]), \
+            f"loop {loop} diverged"
+
+
+def test_fused_flag_off_runs_phased():
+    a = _autoscaler(_world(), fused_loop=False)
+    st = a.run_once(now=1000.0)
+    assert st.fused_mode == "phased" and st.speculation == "none"
+
+
+# ------------------------------------------------- journal cross-oracle
+
+
+def test_journal_cross_oracle_fused_records_phased_replay(tmp_path):
+    """A sequence RECORDED under the fused loop replays under the phased
+    oracle with zero drift — the strongest identity statement: the digests
+    were sealed by one mode and reproduced by the other."""
+    jdir = str(tmp_path / "journal")
+    fake = _world(seed=7)
+    a = _autoscaler(fake, fused_loop=True, journal_dir=jdir)
+    for loop in range(4):
+        if loop == 2:
+            fake.add_pod(build_test_pod("late", cpu_milli=300, mem_mib=256,
+                                        owner_name="prs"))
+        st = a.run_once(now=1000.0 + 10 * loop)
+        assert st.fused_mode == "fused"
+    report = replay_journal(jdir, options_override={"fused_loop": False})
+    assert report["zeroDrift"], report
+    # the per-loop annotations survive the round trip: recorded mode is
+    # fused, the replayed oracle ran phased — informational, never drift
+    modes = [lp["fusedMode"] for lp in report["records"]]
+    assert all(m["recorded"] == "fused" for m in modes), modes
+    assert all(m["replayed"] == "phased" for m in modes), modes
+    assert all(lp["loopDeviceRoundTrips"]["recorded"] <= 2
+               for lp in report["records"]), report["records"]
+
+
+# ------------------------------------------------- speculation protocol
+
+
+def test_speculation_hits_on_steady_world(monkeypatch):
+    """On an unchanged world the speculative dispatch is harvested (after
+    one warm-up loop for the world fingerprint to stabilize) and the loop
+    still pays <= 2 round trips with decisions stable."""
+    fake = _world(seed=1)
+    a = _autoscaler(fake, fused_loop=True, max_bulk_soft_taint_count=0)
+    digests = []
+    outcomes = []
+    for loop in range(5):
+        st = a.run_once(now=1000.0 + 10 * loop)
+        outcomes.append(st.speculation)
+        assert st.loop_device_round_trips <= 2
+        digests.append(_digest(a, st))
+    assert "hit" in outcomes[2:], outcomes
+    assert a.metrics.counter("speculative_hits_total").value() >= 1
+    # a steady world means steady decisions — on hit loops the harvested
+    # tensors produced exactly what a fresh dispatch would have
+    assert all(d == digests[-1] for d in digests[2:]), outcomes
+
+
+def test_speculative_discard_never_changes_decision():
+    """Mismatch injection: arm a speculation on loop k's world, mutate the
+    world, and verify loop k+1 discards the stale program AND decides
+    identically to a never-speculating comparator."""
+    twins = [_world(seed=5), _world(seed=5)]
+    spec_a = _autoscaler(twins[0], fused_loop=True,
+                         max_bulk_soft_taint_count=0)
+    plain = _autoscaler(twins[1], fused_loop=False,
+                        max_bulk_soft_taint_count=0)
+    for a in (spec_a, plain):
+        a.capture_verdicts = True
+    # loop 0+1: steady, speculation armed with loop 1's composition
+    for loop in range(2):
+        sa = spec_a.run_once(now=1000.0 + 10 * loop)
+        sp = plain.run_once(now=1000.0 + 10 * loop)
+        assert _digest(spec_a, sa) == _digest(plain, sp)
+    assert spec_a._speculation is not None, "speculation must be armed"
+    # mutate BOTH worlds: the armed program computed on a stale composition
+    for f in twins:
+        f.add_pod(build_test_pod("intruder", cpu_milli=3900, mem_mib=512,
+                                 owner_name="bg"))
+    sa = spec_a.run_once(now=1030.0)
+    sp = plain.run_once(now=1030.0)
+    assert sa.speculation == "discard"
+    assert spec_a.last_speculation["outcome"] == "discard"
+    assert _digest(spec_a, sa) == _digest(plain, sp), \
+        "a discarded speculation leaked into the decision"
+    assert spec_a.metrics.counter("speculative_discards_total").value() >= 1
+
+
+def test_speculation_key_guards_group_side_changes():
+    """The harvest key digests the GROUP side too: a limiter-cap change
+    between loops (max_nodes_total tightening the cap vector) must discard
+    even though the world composition is unchanged."""
+    fake = _world(seed=2)
+    a = _autoscaler(fake, fused_loop=True, max_bulk_soft_taint_count=0)
+    for loop in range(3):
+        st = a.run_once(now=1000.0 + 10 * loop)
+    assert a._speculation is not None
+    a.options.max_nodes_total = 6   # tightens prepare_fused's host cap
+    st = a.run_once(now=1040.0)
+    assert st.speculation == "discard", st.speculation
+    assert st.error == ""
+
+
+# ------------------------------------------------- supervisor integration
+
+
+def test_hung_fused_dispatch_aborts_and_heals_bit_identical():
+    """PR 13 semantics over the fused program: a hung fused dispatch
+    aborts at the phase budget (the driver survives), and the healed
+    loop's decisions are bit-identical to a cold comparator that never saw
+    a fault."""
+    from kubernetes_autoscaler_tpu.core.supervisor import (
+        PhaseDeadlineExceeded,
+    )
+
+    twins = [_world(seed=9), _world(seed=9)]
+    a = _autoscaler(twins[0], fused_loop=True, max_bulk_soft_taint_count=0)
+    cold = _autoscaler(twins[1], fused_loop=True,
+                       max_bulk_soft_taint_count=0)
+    for x in (a, cold):
+        x.capture_verdicts = True
+    a.run_once(now=999.0)           # warm the jit caches before arming
+    cold.run_once(now=999.0)
+    a.supervisor.phase_deadline_s = 2.0
+    faults.install([{"hook": "local_dispatch", "kind": "hang",
+                     "delay_ms": 30_000, "times": 1}], seed=7,
+                   registry=a.metrics)
+    # with speculation armed from loop 999, the next guarded dispatch is
+    # where the hang lands — the loop aborts at the phase budget instead
+    # of wedging the driver
+    with pytest.raises(PhaseDeadlineExceeded):
+        a.run_once(now=1010.0)
+    cold.run_once(now=1010.0)
+    assert a.supervisor.state != "healthy"
+    faults.clear()
+    st = a.run_once(now=1020.0)
+    st_cold = cold.run_once(now=1020.0)
+    assert st.ran and a.supervisor.state == "healthy"
+    assert _digest(a, st) == _digest(cold, st_cold), \
+        "post-heal fused decisions drifted from the cold comparator"
+
+
+# ------------------------------------------------- program-level contracts
+
+
+def test_host_limit_cap_matches_combined_limit_vec():
+    """prepare_fused's host-composed cap replicates the phased
+    estimator's combined_limit_vec min-composition exactly — per group,
+    after the program's min with the group's own max_new."""
+    import jax.numpy as jnp
+
+    from kubernetes_autoscaler_tpu.estimator.estimator import (
+        combined_limit_vec,
+    )
+
+    fake = _world(seed=4)
+    a = _autoscaler(fake, fused_loop=True, max_nodes_per_scaleup=3,
+                    max_nodes_total=7)
+    a.run_once(now=1000.0)
+    ctx = a._fused_ctx
+    assert ctx is not None, "fused loop did not run"
+    prep = ctx["prep"]
+    gt = prep.group_tensors
+    est = prep.estimator
+    vec = combined_limit_vec(est.limiters, len(fake.nodes), gt.max_new)
+    fused_cap = np.asarray(jnp.minimum(gt.max_new,
+                                       jnp.asarray(prep.limit_cap)))
+    phased_cap = np.asarray(jnp.minimum(gt.max_new, vec))
+    assert np.array_equal(fused_cap, phased_cap), (fused_cap, phased_cap)
+
+
+def test_fused_drain_sweep_rows_are_subset_independent():
+    """The fused program sweeps ALL nodes (C == N); the planner gathers a
+    candidate subset from it. Row independence is what makes that sound:
+    a dedicated sweep over any subset must produce the same rows bit for
+    bit."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_autoscaler_tpu.ops import drain
+
+    fake = _world(n_nodes=6, seed=6)
+    a = _autoscaler(fake, fused_loop=True)
+    a.run_once(now=1000.0)
+    ctx = a._fused_ctx
+    assert ctx is not None
+    _, _, sched, planes = ctx["inputs"]
+    nodes2, specs2 = ctx["nodes"], ctx["specs"]
+    full = ctx["resident"].removal
+    statics = ctx["statics"]
+    cand = np.asarray([0, 2, 5], np.int32)
+    sub = drain.simulate_removals(
+        nodes2, specs2, sched, jnp.asarray(cand),
+        dest_allowed=jnp.ones((nodes2.n,), bool),
+        max_pods_per_node=statics["max_pods_per_node"],
+        chunk=statics["chunk"], planes=planes,
+        max_zones=statics["dims"].max_zones,
+        with_constraints=statics["with_constraints"])
+    for name in ("drainable", "has_blocker", "n_moved", "n_failed",
+                 "dest_node", "pod_slot"):
+        f = np.asarray(getattr(full, name))[cand]
+        s = np.asarray(getattr(sub, name))
+        assert np.array_equal(f, s), name
+    # feas is the shared [G, N] predicate plane — subset-invariant whole
+    assert np.array_equal(np.asarray(full.feas), np.asarray(sub.feas))
+    jax.block_until_ready(sub.drainable)
+
+
+def test_fused_resident_swap_preserves_untouched_leaf_identity():
+    """The snapshot swap after a fused dispatch must keep every leaf the
+    placement did NOT touch as the ORIGINAL encoder array (alloc/count are
+    the only replacements) — that identity is what keeps the planner's
+    host-mirror reads transfer-free and the round-trip budget at 2."""
+    fake = _world(seed=8)
+    a = _autoscaler(fake, fused_loop=True)
+    a.run_once(now=1000.0)
+    ctx = a._fused_ctx
+    assert ctx is not None
+    in_nodes, in_specs, _, _ = ctx["inputs"]
+    out_nodes, out_specs = ctx["nodes"], ctx["specs"]
+    assert out_nodes.cap is in_nodes.cap
+    assert out_nodes.ready is in_nodes.ready
+    assert out_nodes.valid is in_nodes.valid
+    assert out_specs.req is in_specs.req
+    assert out_nodes.alloc is not in_nodes.alloc
+
+
+def test_fused_defers_to_phased_on_mesh():
+    """A sharded mesh owns estimator placement — the single-device fused
+    program steps aside and the loop runs (decision-identical) phased."""
+    fake = _world(seed=11)
+    a = _autoscaler(fake, fused_loop=True)
+    a.scale_up_orchestrator.mesh = object()   # any armed mesh defers
+    st = a.run_once(now=1000.0)
+    assert st.ran and st.error == ""
+    assert st.fused_mode == "phased" and st.speculation == "none"
+
+
+def test_fused_census_counts_compiles_only_on_growth():
+    """The fused program registers with the compile census: one compile on
+    the cold loop, zero growth across steady loops."""
+    fake = _world(seed=10)
+    a = _autoscaler(fake, fused_loop=True, max_bulk_soft_taint_count=0)
+    a.run_once(now=1000.0)
+    c = a.metrics.counter("fused_program_compiles_total")
+    after_cold = c.value()
+    for loop in range(1, 4):
+        a.run_once(now=1000.0 + 10 * loop)
+    assert c.value() == after_cold, "steady-state fused recompile"

@@ -57,6 +57,12 @@ def test_runonce_host_side_budget_at_bench_shape():
         node_shape_bucket=256, group_shape_bucket=64,
         max_new_nodes_static=256, max_pods_per_node=16, drain_chunk=256,
         scale_down_delay_after_add_s=0.0, scale_down_delay_after_failure_s=0.0,
+        # this test pins the PHASED ladder's host-side budgets (encode /
+        # confirm); the fused path's budgets live in test_fused_loop.py
+        # (loop_device_round_trips <= 2) and the CI fused smoke (>=1.5x
+        # speedup gate) — and its 5k-node program compile would dominate
+        # this test's wall time for no added coverage
+        fused_loop=False,
         node_group_defaults=NodeGroupDefaults(
             scale_down_unneeded_time_s=3600.0,  # plan, never actuate: steady
             scale_down_unready_time_s=3600.0),
@@ -111,6 +117,7 @@ def test_runonce_steady_churn_host_budget():
     opts = AutoscalingOptions(
         node_shape_bucket=256, group_shape_bucket=64,
         max_new_nodes_static=256, max_pods_per_node=16, drain_chunk=256,
+        fused_loop=False,  # phased-ladder budget oracle (see above)
         node_group_defaults=NodeGroupDefaults(
             scale_down_unneeded_time_s=3600.0,
             scale_down_unready_time_s=3600.0),

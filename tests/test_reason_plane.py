@@ -161,9 +161,7 @@ def test_event_dedup_aggregates_counts_across_loops():
     assert up[0].first_ts == 1000.0 and up[0].last_ts == 1010.0
 
 
-def test_lazy_contract_zero_dispatches_when_everything_schedules():
-    """All pods fit, every candidate drains → neither owner performs a
-    reason-extraction dispatch and no refusal event is emitted."""
+def _fitting_world():
     fake = FakeCluster()
     tmpl = build_test_node("tmpl", cpu_milli=4000, mem_mib=8192)
     fake.add_node_group("ng1", tmpl, min_size=0, max_size=10)
@@ -174,13 +172,27 @@ def test_lazy_contract_zero_dispatches_when_everything_schedules():
                                 owner_name="rs", node_name="n1"))
     fake.add_pod(build_test_pod("p0", cpu_milli=500, mem_mib=256,
                                 owner_name="rs2"))
-    a = StaticAutoscaler(fake.provider, fake, options=_opts(),
-                         eviction_sink=fake, registry=Registry())
-    a.run_once(now=1000.0)
-    assert "reason_extraction_dispatches" not in a.planner.phases.events
-    assert ("reason_extraction_dispatches"
-            not in a.scale_up_orchestrator.phases.events)
-    assert not a.event_sink.find("NoScaleUp")
+    return fake
+
+
+def test_lazy_contract_zero_dispatches_when_everything_schedules():
+    """All pods fit, every candidate drains → neither owner performs a
+    reason-extraction dispatch and no refusal event is emitted. Pinned on
+    BOTH loop modes: the fused program's decision tensors must satisfy the
+    lazy readers without any extra dispatch (docs/FUSED_LOOP.md)."""
+    for fused in (True, False):
+        fake = _fitting_world()
+        a = StaticAutoscaler(fake.provider, fake,
+                             options=_opts(fused_loop=fused),
+                             eviction_sink=fake, registry=Registry())
+        st = a.run_once(now=1000.0)
+        assert st.fused_mode == ("fused" if fused else "phased")
+        assert "reason_extraction_dispatches" not in a.planner.phases.events
+        assert ("reason_extraction_dispatches"
+                not in a.scale_up_orchestrator.phases.events)
+        assert not a.event_sink.find("NoScaleUp")
+        if fused:
+            assert st.loop_device_round_trips <= 2
 
 
 def test_event_sink_quota_drops_and_dedup():
