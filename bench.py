@@ -259,6 +259,14 @@ def run_floor_child(metric: str, args) -> int:
         # fused-vs-phased identity and round-trip evidence is backend-
         # independent composition — it degrades WITH the floor
         cmd += ["--fused"]
+    if getattr(args, "whatif", False):
+        # the multiverse-vs-serial evidence is backend-independent
+        # composition too — it degrades WITH the floor
+        cmd += ["--whatif"]
+    if getattr(args, "all", False):
+        # the child re-expands --all itself (and owns the combined line;
+        # this parent's stdout tee never saw the child's fd writes)
+        cmd += ["--all"]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     print(f"[bench] degrading to CPU floor metric: {' '.join(cmd[1:])}",
@@ -271,6 +279,39 @@ def run_floor_child(metric: str, args) -> int:
         # the last line of the never-null contract's defense
         emit_failure(metric, e, backend="cpu-floor")
         return 1
+
+
+class _MetricTee:
+    """stdout wrapper for --all: passes every write through while capturing
+    each parseable {"metric": ...} JSON line, keyed by metric name (last
+    write wins — the re-printed headline dedups itself), so the run can end
+    with ONE combined JSON object over every mode's evidence."""
+
+    def __init__(self, stream):
+        self.stream = stream
+        self.results: dict = {}
+        self._buf = ""
+
+    def write(self, s):
+        self.stream.write(s)
+        self._buf += s
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict) and obj.get("metric"):
+                    self.results[obj["metric"]] = obj
+        return len(s)
+
+    def flush(self):
+        self.stream.flush()
+
+    def __getattr__(self, name):
+        return getattr(self.stream, name)
 
 
 def emit_failure(metric: str, err: Exception, backend: str | None = None) -> None:
@@ -500,12 +541,45 @@ def main() -> None:
                          "window and steady-state recompiles (never-null "
                          "on the CPU floor — the fused program is backend-"
                          "independent composition)")
+    ap.add_argument("--whatif", action="store_true",
+                    help="counterfactual multiverse smoke (docs/WHATIF.md): "
+                         "branch a live fused world, fan out B=16 variant "
+                         "lanes, rollout T=32 simulated loops in ONE "
+                         "device dispatch — assert the null lane's decision "
+                         "trajectory is byte-identical to T live fused "
+                         "RunOnce loops, zero steady-state recompiles "
+                         "across lanes/knob churn, and print a "
+                         "whatif_multiverse JSON line with the aggregate "
+                         "fused-steps/sec speedup vs the serial phased "
+                         "loop on the same worlds (never-null on the CPU "
+                         "floor — pure backend-independent composition)")
+    ap.add_argument("--all", action="store_true",
+                    help="run every never-null bench mode in this one "
+                         "process (fused, whatif, world-store, journal, "
+                         "chaos-local, device-stats, shadow-audit) and "
+                         "emit a single combined JSON line at the end — "
+                         "one cooperating TPU-tunnel window banks real-TPU "
+                         "numbers for every mode")
     ap.add_argument("--require-tpu", action="store_true",
                     help="disable the CPU-floor degradation: a missing/hung "
                          "TPU backend emits the null-value error JSON and "
                          "exits 1 (the ONLY path that may produce a null)")
     ap.add_argument("--floor-for", default="", help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.all:
+        # expand into every never-null mode (the headline + scale-down +
+        # e2e phases are already on by default); --journal needs a dir
+        args.world_store = True
+        args.chaos_local = True
+        args.device_stats = True
+        args.shadow_audit = True
+        args.fused = True
+        args.whatif = True
+        if not args.journal:
+            import tempfile
+
+            args.journal = tempfile.mkdtemp(prefix="bench-all-journal-")
 
     if args.require_tpu and (args.smoke or args.floor_for):
         # --smoke IS an explicit CPU run — combining it with --require-tpu
@@ -569,9 +643,15 @@ def main() -> None:
             # measured (probe child was killed; our interpreter is clean)
             sys.exit(run_floor_child(metric, args))
 
+    tee = None
+    if args.all:
+        tee = _MetricTee(sys.stdout)
+        sys.stdout = tee
     try:
         run_bench(args, metric, budget=InitBudget())
     except Exception as e:  # noqa: BLE001 — evidence-preserving failure path
+        if tee is not None:
+            sys.stdout = tee.stream
         traceback.print_exc(file=sys.stderr)
         if can_degrade:
             sys.exit(run_floor_child(metric, args))
@@ -579,6 +659,13 @@ def main() -> None:
                      backend="cpu-floor" if args.smoke or args.floor_for
                      else None)
         sys.exit(1)
+    if tee is not None:
+        sys.stdout = tee.stream
+        print(json.dumps({
+            "metric": "bench_all_combined",
+            "modes": sorted(tee.results),
+            "results": tee.results,
+        }), flush=True)
 
 
 def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
@@ -1016,6 +1103,18 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
                 "error": f"{type(e).__name__}: {e}",
             }), flush=True)
 
+    if getattr(args, "whatif", False):
+        try:
+            with_timeout(lambda: bench_whatif(args), seconds=600)()
+        except Exception as e:
+            print(f"[bench] whatif phase failed: {type(e).__name__}: "
+                  f"{e}", file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "whatif_multiverse", "value": None, "unit": "ms",
+                "error": f"{type(e).__name__}: {e}",
+            }), flush=True)
+
     if getattr(args, "shadow_audit", False):
         try:
             with_timeout(lambda: bench_shadow_audit(args), seconds=600)()
@@ -1056,7 +1155,8 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
             or getattr(args, "chaos_local", False) \
             or getattr(args, "device_stats", False) \
             or getattr(args, "shadow_audit", False) \
-            or getattr(args, "fused", False):
+            or getattr(args, "fused", False) \
+            or getattr(args, "whatif", False):
         print(primary_line, flush=True)
 
 
@@ -2261,6 +2361,169 @@ def bench_fused(args) -> None:
         "speculative_discards": spec_discards,
         "decisions_identical": identical,
         "steady_state_recompiles": steady_recompiles,
+    }), flush=True)
+
+
+def bench_whatif(args) -> None:
+    """--whatif: the counterfactual multiverse as bench-evidenced contract
+    (docs/WHATIF.md). Branch a live fused world, fan out B=16 hypothesis
+    lanes (lane 0 = null), rollout T=32 simulated loops in ONE device
+    dispatch, and assert the three gates CI rides:
+    - the null lane's decision trajectory is byte-identical to T live
+      fused RunOnce loops on the same steady world
+    - steady-state recompiles == 0 across all B lanes AND a second rollout
+      with different per-lane knob VALUES (knobs are traced, never static)
+    - aggregate fused-steps/sec >= the speedup gate vs the serial phased
+      control loop on a twin world — evaluating B lanes over T steps the
+      old way costs B*T full phased loops; one batched dispatch is what
+      the multiverse is for
+    """
+    import numpy as np
+
+    import jax
+
+    from kubernetes_autoscaler_tpu.whatif import kernel as wkernel
+    from kubernetes_autoscaler_tpu.whatif import report as wreport
+    from kubernetes_autoscaler_tpu.whatif import variants as wvariants
+    from kubernetes_autoscaler_tpu.whatif.generator import (
+        WorkloadSpec,
+        generate_workload,
+        lane_workloads,
+    )
+    from kubernetes_autoscaler_tpu.whatif.synthetic import (
+        synthetic_autoscaler,
+        synthetic_branch,
+    )
+
+    b_lanes, t_steps = 16, 32
+    n_nodes = min(max(args.nodes // 4, 16), 48)
+
+    # branch a LIVE fused world in equilibrium with its own decisions:
+    # resident pods pin every node (no drains), and the pending pods are
+    # too large for any group template, so placement/scale-up stay
+    # plan-only verdicts on BOTH sides — the live loop re-presents the
+    # same pending pods each loop (nothing ever binds them), and the
+    # rollout's compressed actuation is a bitwise no-op
+    branch, auto = synthetic_branch(n_nodes=n_nodes, n_pending=12,
+                                    seed=7, loops=2, pending_milli=64000)
+    live_verd, live_pend = [], []
+    for k in range(t_steps):
+        st = auto.run_once(now=2000.0 + 10.0 * k)
+        if st.fused_mode != "fused":
+            raise RuntimeError(f"live loop {k} fell off the fused path "
+                               f"({st.fused_mode})")
+        dec = auto._fused_ctx["decision"]
+        live_verd.append(np.array(dec.verdict))
+        live_pend.append(np.array(dec.pending_after))
+    live_digest = wreport._digest(np.stack(live_verd), np.stack(live_pend))
+
+    def mk_variants(knob: float):
+        vs = [wvariants.VariantSpec(name="null")]
+        for i in range(b_lanes - 1):
+            kind = i % 4
+            if kind == 0:
+                vs.append(wvariants.VariantSpec(
+                    name=f"price{i}", price_scale=0.5 + 0.25 * i * knob))
+            elif kind == 1:
+                vs.append(wvariants.VariantSpec(
+                    name=f"thresh{i}",
+                    threshold=min(0.2 + 0.05 * i * knob, 0.95)))
+            elif kind == 2:
+                vs.append(wvariants.VariantSpec(
+                    name=f"cap{i}", max_new_cap=1 + i))
+            else:
+                vs.append(wvariants.VariantSpec(
+                    name=f"fail{i}", fail_nodes=(i % n_nodes,)))
+        return vs
+
+    lanes = wvariants.build_lanes(branch, mk_variants(1.0)[1:],
+                                  pad_to=b_lanes)
+    assert len(lanes.variants) == b_lanes
+    stt = lanes.statics
+    kw = dict(dims=stt["dims"], max_new_nodes=stt["max_new_nodes"],
+              max_pods_per_node=stt["max_pods_per_node"],
+              chunk=stt["chunk"])
+    wl = WorkloadSpec(kind="quiet")
+    g = int(np.asarray(lanes.specs.count).shape[1])
+    n = int(np.asarray(lanes.nodes.valid).shape[1])
+    adds, fails = generate_workload(wl, t_steps, g, n)
+    adds_b, fails_b = lane_workloads(lanes.variants, adds, fails)
+
+    def cache_size():
+        return (wkernel.rollout_multiverse._cache_size()
+                + wkernel.multiverse_step._cache_size())
+
+    def run_rollout(ln):
+        traj = wkernel.rollout_multiverse(
+            ln.nodes, ln.specs, ln.scheduled, ln.groups, ln.limit_cap,
+            ln.thresholds, adds_b, fails_b, **kw)
+        jax.block_until_ready(traj)
+        return traj
+
+    # warm-up compiles, then the timed window must grow the cache by 0 —
+    # including a rollout over a DIFFERENT variant set (knob values are
+    # traced; only shapes key the compile)
+    t0 = time.perf_counter()
+    traj = run_rollout(lanes)
+    compile_s = time.perf_counter() - t0
+    warm = cache_size()
+    lanes2 = wvariants.build_lanes(branch, mk_variants(1.3)[1:],
+                                   pad_to=b_lanes)
+    rollout_wall = []
+    for ln in (lanes, lanes2, lanes):
+        t0 = time.perf_counter()
+        traj = run_rollout(ln)
+        rollout_wall.append(time.perf_counter() - t0)
+    steady_recompiles = cache_size() - warm
+    rollout_s = float(np.median(rollout_wall))
+
+    null_digest = wreport.trajectory_digests(traj, 1)[0]
+    null_identical = null_digest == live_digest
+
+    # serial phased baseline: the actual phased control loop (encode +
+    # phase-by-phase dispatches + host policy + fetches) on a twin of the
+    # branch world — what evaluating B lanes x T steps costs without the
+    # multiverse is B*T of these loops, so steps/sec is 1 / loop-p50
+    _fake_p, phased = synthetic_autoscaler(
+        n_nodes=n_nodes, n_pending=12, seed=7, pending_milli=64000,
+        fused_loop=False)
+    for k in range(2):
+        phased.run_once(now=1000.0 + 10.0 * k)   # warm the phased programs
+    phased_wall = []
+    for k in range(8):
+        t0 = time.perf_counter()
+        phased.run_once(now=2000.0 + 10.0 * k)
+        phased_wall.append(time.perf_counter() - t0)
+    serial_loop_s = float(np.median(phased_wall))
+
+    steps = b_lanes * t_steps
+    fused_sps = steps / max(rollout_s, 1e-9)
+    serial_sps = 1.0 / max(serial_loop_s, 1e-9)
+    speedup = fused_sps / max(serial_sps, 1e-9)
+    print(f"[bench-whatif] lanes={b_lanes} steps={t_steps} nodes={n_nodes} "
+          f"rollout={rollout_s * 1000:.1f}ms "
+          f"phased_loop_p50={serial_loop_s * 1000:.1f}ms "
+          f"fused_steps/s={fused_sps:.0f} serial_steps/s={serial_sps:.0f} "
+          f"speedup={speedup:.1f}x null_identical={null_identical} "
+          f"recompiles={steady_recompiles} compile={compile_s:.1f}s",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "whatif_multiverse",
+        "value": round(rollout_s * 1000.0, 3),
+        "unit": "ms",
+        "backend": ("cpu-floor" if args.smoke or args.floor_for
+                    else __import__("jax").default_backend()),
+        "lanes": b_lanes,
+        "rollout_steps": t_steps,
+        "nodes": n_nodes,
+        "fused_steps_per_sec": round(fused_sps, 1),
+        "serial_steps_per_sec": round(serial_sps, 1),
+        "serial_baseline": "phased-control-loop",
+        "serial_loop_p50_ms": round(serial_loop_s * 1000.0, 3),
+        "speedup_vs_serial_phased": round(speedup, 2),
+        "null_lane_identical": null_identical,
+        "steady_state_recompiles": steady_recompiles,
+        "compile_ms": round(compile_s * 1000.0, 1),
     }), flush=True)
 
 

@@ -1131,6 +1131,11 @@ class StaticAutoscaler:
                         detail={"surfaces": sorted(
                             {d["surface"] for d in rep["divergences"]})},
                         persistent=rep["persistent"])
+                    # a speculative dispatch still in flight was issued
+                    # over the now-divergent world: it must never be
+                    # harvested against the healed planes next loop, even
+                    # if the heal re-uploads value-identical buffers
+                    self._discard_speculation("audit-divergence")
 
             if self.debugging_snapshotter is not None:
                 if self.debugging_snapshotter.is_data_collection_allowed():
@@ -1215,6 +1220,40 @@ class StaticAutoscaler:
         return (self.scale_up_orchestrator._last_group_fp,
                 mx.tobytes(), pr.tobytes(), prep.limit_cap.tobytes())
 
+    def _fused_defer(self, cause: str, now: float) -> None:
+        """A fused→phased deferral is a round-trip-cap regression: the loop
+        silently re-gains the phased ladder's device round trips. Make it
+        observable (counter + one event per dedup window) and drop any
+        armed speculation — a dispatch left in flight across a deferred
+        loop must never survive to a later harvest."""
+        self.metrics.counter(
+            "fused_deferrals_total",
+            help="Loops where the fused single-dispatch program deferred "
+                 "to the phased ladder, by cause (steady state: 0)").inc(
+            cause=cause)
+        self.event_sink.emit(
+            "Warning", "autoscaler", "FusedDeferral",
+            f"fused RunOnce deferred to the phased ladder ({cause}); "
+            "the 1-round-trip loop budget does not apply this loop",
+            now=now)
+        self._discard_speculation(cause)
+
+    def _discard_speculation(self, cause: str) -> None:
+        """Unconditionally drop an armed speculative dispatch (deferral,
+        audit divergence, shutdown) — counted like a harvest-gate discard
+        so the speculation ledger stays complete."""
+        spec, self._speculation = self._speculation, None
+        if spec is None:
+            return
+        self.metrics.counter(
+            "speculative_discards_total",
+            help="Speculative fused dispatches discarded on a "
+                 "fingerprint/input mismatch").inc()
+        self.last_speculation = {"outcome": "discard",
+                                 "handle": spec["handle"],
+                                 "resident": spec["resident"],
+                                 "key": spec["key"], "cause": cause}
+
     def _fused_dispatch(self, enc, snapshot, nodes: list[Node],
                         pods: list[Pod], now: float) -> dict | None:
         """Dispatch run_once_fused — or harvest last loop's speculative
@@ -1228,9 +1267,11 @@ class StaticAutoscaler:
         if self.scale_up_orchestrator.mesh is not None:
             # the sharded estimator owns mesh placement; the fused program
             # is a single-device composition
+            self._fused_defer("mesh-sharded", now)
             return None
         prep = self.scale_up_orchestrator.prepare_fused(enc, len(nodes), now)
         if prep is None:
+            self._fused_defer("no-candidate-groups", now)
             return None
         import jax
 

@@ -1178,6 +1178,113 @@ class SimulatorService:
         stamps.harvested = _time.perf_counter_ns()
         return self._finish_lifecycle(ts, stamps, resp)
 
+    # ---- rpc: WhatIf (counterfactual multiverse, docs/WHATIF.md) ----
+
+    def what_if(self, request: bytes, tenant: str = "") -> dict:
+        """Batched what-if evaluation over the tenant's resident world:
+        B hypothesis lanes (lane 0 = the null hypothesis, bit-identical to
+        a plain fused step on the unperturbed world) through ONE vmapped
+        fused dispatch, optionally time-compressed over T rollout loops.
+
+        The lane count is quantized up to a shape-class rung (padding with
+        null lanes, masked out of the report), so variant-count churn rides
+        the SAME compiled program — B lanes cost 0 steady-state recompiles,
+        the same admission contract the tenant batcher gives worlds."""
+        entry_ns = _time.perf_counter_ns()
+        raw = json.loads(request.decode() or "{}")
+        params = SimParams(
+            max_new_nodes=raw.get("max_new_nodes", 256),
+            strategy=raw.get("strategy", "least-waste"),
+            threshold=raw.get("threshold", 0.5),
+            node_groups=raw.get("node_groups"),
+        )
+        self._admit_sim(tenant, params, "up")
+        ts = self._tenant(tenant)
+        try:
+            return self._what_if_serial(ts, raw, params, entry_ns)
+        except WorldValidationError as e:
+            self._note_validation_reject(tenant, e)
+            raise
+
+    def _what_if_serial(self, ts: _Tenant, raw: dict, params: SimParams,
+                        entry_ns: int = 0) -> dict:
+        from kubernetes_autoscaler_tpu.whatif import (
+            generator as wgen,
+            kernel as wkernel,
+            report as wreport,
+            variants as wvariants,
+        )
+
+        stamps = Stamps(entry=entry_ns or _time.perf_counter_ns())
+        vs = [wvariants.VariantSpec.from_dict(d)
+              for d in raw.get("variants", [])]
+        rollout_t = int(raw.get("rollout", 0))
+        with ts.lock:
+            self._classify(ts)
+            nt, gt, pt, planes, has_c = self._tensors_with_constraints(ts)
+            groups, ids = self._encode_groups(ts, params)
+        if has_c:
+            # the multiverse lanes run the unconstrained fused body — same
+            # split the tenant batcher makes (constraint overlays stay on
+            # the serial planes-attached tier)
+            raise WorldValidationError(
+                "whatif-constrained",
+                "what-if lanes do not carry constraint overlays; drop the "
+                "aux constraints or use the serial sims")
+        branch = wvariants.Branch(
+            nodes=nt, specs=gt, scheduled=pt, groups=groups,
+            limit_cap=np.minimum(
+                np.asarray(groups.max_new, np.int64),
+                np.int64(params.max_new_nodes)).astype(np.int32),
+            statics={
+                "dims": self.dims,
+                "max_new_nodes": params.max_new_nodes,
+                "max_pods_per_node": 128,
+                "chunk": 32,
+                "with_constraints": False,
+            },
+            meta={"source": "tenant", "tenant": ts.tid, "groups": ids},
+        )
+        # lane-count admission: pad B up to a rung so variant churn never
+        # changes the dispatch shape (counted on the shape-class counters)
+        want = len(vs) + (0 if vs and vs[0].is_null() else 1)
+        lanes = wvariants.build_lanes(branch, vs, pad_to=rung(want, 4))
+        stamps.enqueue = _time.perf_counter_ns()   # encode done
+
+        st = lanes.statics
+        kw = dict(dims=st["dims"], max_new_nodes=st["max_new_nodes"],
+                  max_pods_per_node=st["max_pods_per_node"],
+                  chunk=st["chunk"], strategy=params.strategy)
+        margs = (lanes.nodes, lanes.specs, lanes.scheduled, lanes.groups,
+                 lanes.limit_cap)
+        with self._recompile_charge([ts]):
+            decision, summary = self._timed_sim(
+                lambda: wkernel.multiverse_step(*margs, **kw),
+                census=("multiverse_step", wkernel.multiverse_step,
+                        margs, kw),
+                tenant=ts.tid if not ts.dispatched else "")
+            traj = wl = None
+            if rollout_t > 0:
+                wl = wgen.WorkloadSpec.from_record(
+                    raw.get("workload") or {"kind": "quiet"})
+                g = int(np.asarray(lanes.specs.count).shape[1])
+                n = int(np.asarray(lanes.nodes.valid).shape[1])
+                adds, fails = wgen.generate_workload(wl, rollout_t, g, n)
+                adds_b, fails_b = wgen.lane_workloads(
+                    lanes.variants, adds, fails)
+                rargs = margs + (lanes.thresholds, adds_b, fails_b)
+                traj = self._timed_sim(
+                    lambda: wkernel.rollout_multiverse(*rargs, **kw),
+                    census=("rollout_multiverse",
+                            wkernel.rollout_multiverse, rargs, kw),
+                    tenant="")
+        stamps.dispatched = _time.perf_counter_ns()
+        resp = wreport.build_report(lanes, summary=summary,
+                                    decision=decision, traj=traj,
+                                    workload=wl)
+        stamps.harvested = _time.perf_counter_ns()
+        return self._finish_lifecycle(ts, stamps, resp)
+
     # ---- batched dispatch path ----
 
     def _batchable(self, ts: _Tenant) -> bool:
@@ -1469,10 +1576,12 @@ class SimulatorService:
 
     def _sim_cache_size(self) -> int:
         from kubernetes_autoscaler_tpu.ops import autoscale_step as a
+        from kubernetes_autoscaler_tpu.whatif import kernel as w
 
         return sum(f._cache_size() for f in (
             a.scale_up_sim, a.scale_down_sim,
-            a.scale_up_sim_batch, a.scale_down_sim_batch))
+            a.scale_up_sim_batch, a.scale_down_sim_batch,
+            w.multiverse_step, w.rollout_fused, w.rollout_multiverse))
 
     def _account_new_tenant(self, tenants: list[_Tenant],
                             recompiles: int) -> None:
@@ -2459,6 +2568,9 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
         "ScaleDownSim": grpc.unary_unary_rpc_method_handler(
             _json_method("ScaleDownSim", service.scale_down_sim, True),
             request_deserializer=ident, response_serializer=ident),
+        "WhatIf": grpc.unary_unary_rpc_method_handler(
+            _json_method("WhatIf", service.what_if, False),
+            request_deserializer=ident, response_serializer=ident),
         "Health": grpc.unary_unary_rpc_method_handler(
             _json_method("Health", lambda _b, tenant="": service.health(),
                          False, sample=False),
@@ -2843,6 +2955,23 @@ class SimulatorClient:
 
     def scale_down_sim(self, **params) -> dict:
         return self._call_json("ScaleDownSim", json.dumps(params).encode())
+
+    def what_if(self, variants=(), rollout: int = 0, workload=None,
+                **params) -> dict:
+        """Counterfactual multiverse over the tenant's resident world
+        (docs/WHATIF.md): `variants` is a list of variant dicts (lane 0
+        null hypothesis is always prepended server-side), `rollout` a
+        simulated loop count (0 = single step), `workload` a
+        WorkloadSpec record dict for the rollout's synthetic traffic."""
+        body = dict(params)
+        body["variants"] = [v.to_dict() if hasattr(v, "to_dict") else v
+                            for v in variants]
+        body["rollout"] = rollout
+        if workload is not None:
+            body["workload"] = (workload.to_record()
+                                if hasattr(workload, "to_record")
+                                else workload)
+        return self._call_json("WhatIf", json.dumps(body).encode())
 
     def health(self) -> dict:
         return self._call_json("Health", b"")

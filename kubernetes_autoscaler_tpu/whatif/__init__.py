@@ -1,0 +1,36 @@
+"""Counterfactual multiverse — vmapped what-if batches and device-resident
+time-compressed rollouts (docs/WHATIF.md).
+
+PR 7 vmaps *tenants* and the fused loop (docs/FUSED_LOOP.md) collapses the
+whole RunOnce into one device program; this package vmaps *hypotheses*: a
+leading lane axis B of perturbed worlds + per-lane policy scalars over the
+same `ops/autoscale_step.run_once_fused` body, plus a `lax.scan` rollout
+that advances the resident planes through T simulated loops entirely
+on-device. Lane b=0 is always the null hypothesis — the unperturbed branch
+world — and stays bit-identical to the live fused loop by construction
+(tests/test_whatif.py pins this).
+"""
+
+from kubernetes_autoscaler_tpu.whatif.kernel import (  # noqa: F401
+    LaneSummary,
+    RolloutStep,
+    multiverse_step,
+    rollout_fused,
+    rollout_multiverse,
+)
+from kubernetes_autoscaler_tpu.whatif.variants import (  # noqa: F401
+    Branch,
+    Lanes,
+    VariantSpec,
+    branch_from_journal,
+    branch_from_live,
+    build_lanes,
+)
+from kubernetes_autoscaler_tpu.whatif.generator import (  # noqa: F401
+    WorkloadSpec,
+    generate_workload,
+)
+from kubernetes_autoscaler_tpu.whatif.report import (  # noqa: F401
+    build_report,
+    lane_digests,
+)

@@ -1,0 +1,343 @@
+"""The multiverse kernels: the fused RunOnce body vmapped over a leading
+hypothesis axis B, and `lax.scan` time-compressed rollouts over T loops.
+
+Both kernels reuse `run_once_fused.__wrapped__` verbatim — lane arithmetic
+is the live loop's arithmetic, so the null-hypothesis lane (b=0, the
+unperturbed branch world) produces bit-identical decision planes to a live
+fused dispatch on the same world. Per-lane policy knobs ride as TRACED
+arrays (limit_cap i32[B, NG], thresholds f32[B], per-lane prices inside the
+batched group tensors), so B variant lanes and any knob churn share ONE
+compiled program per (shape-class, T) — the same no-fragmentation contract
+the tenant batcher pins (docs/SERVING.md).
+
+The rollout applies a *compressed actuation* inside the scan — placement is
+the fused filter's exact arithmetic and the placed pods BIND (the carry is
+the post-placement world, unlike the live loop where a real scheduler binds
+asynchronously); scale-up materializes the winning option's template rows
+into invalid node slots; scale-down retires empty drainable nodes below the
+lane's utilization threshold — so the host sees only the compact per-step
+decision trajectory (O(T·G)), never the worlds. Because every actuation is
+a masked select, a world in equilibrium with its own decisions (nothing
+placeable, nothing drainable) carries BITWISE unchanged — that is the
+null-lane trajectory identity `bench.py --whatif` pins against T live
+loops. Single-step identity (multiverse_step lane b ≡ serial
+run_once_fused) holds unconditionally on ANY world.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from kubernetes_autoscaler_tpu.models.cluster_state import (
+    Dims,
+    NodeGroupTensors,
+    NodeTensors,
+    PodGroupTensors,
+    ScheduledPodTensors,
+)
+from kubernetes_autoscaler_tpu.ops import scoring
+from kubernetes_autoscaler_tpu.ops.autoscale_step import (
+    FusedDecision,
+    run_once_fused,
+)
+
+
+class LaneSummary(struct.PyTreeNode):
+    """Per-lane scalars reduced ON DEVICE so the multiverse fetch stays
+    O(B) + the decision planes — cost / utilization / disruption are the
+    deltas the what-if consumer ranks lanes by (report.py subtracts the
+    null lane on host)."""
+
+    scaleup_cost: jax.Array   # f32 price of the winning expansion option
+    fleet_price: jax.Array    # f32 Σ price_per_node over live grouped nodes
+    utilization: jax.Array    # f32 mean post-placement util over valid nodes
+    disruption: jax.Array     # i32 drainable (evictable) node count
+    pending: jax.Array        # i32 pods still pending after the filter pass
+    nodes_added: jax.Array    # i32 node count of the winning option
+    best: jax.Array           # i32 winning node-group index (-1 = none)
+
+
+class RolloutStep(struct.PyTreeNode):
+    """One step of the host-visible decision trajectory — the ONLY thing a
+    rollout fetches (the worlds stay device-resident inside the scan)."""
+
+    verdict: jax.Array        # i32[G] filter placements (live-loop surface)
+    pending_after: jax.Array  # i32[G] pods pending after the filter
+    best: jax.Array           # i32 winning node-group index (-1 = none)
+    nodes_added: jax.Array    # i32 nodes materialized this step
+    nodes_removed: jax.Array  # i32 empty drainable nodes retired this step
+    util_mean: jax.Array      # f32 mean utilization over valid nodes
+    scaleup_cost: jax.Array   # f32 price of this step's expansion
+    fleet_price: jax.Array    # f32 post-actuation fleet price rate
+
+
+def _summarize(dec: FusedDecision, nodes: NodeTensors,
+               groups: NodeGroupTensors, strategy: str) -> LaneSummary:
+    best = scoring.best_option(dec.scores, strategy)
+    b = jnp.maximum(best, 0)
+    n_add = jnp.where(best >= 0, dec.est_node_count[b], 0)
+    price = groups.price_per_node
+    cost = jnp.where(best >= 0, price[b] * n_add.astype(jnp.float32), 0.0)
+    nvalid = nodes.valid.sum()
+    util = jnp.where(
+        nvalid > 0,
+        (dec.util * nodes.valid).sum() / jnp.maximum(nvalid, 1), 0.0)
+    gid = jnp.maximum(nodes.group_id, 0)
+    fleet = jnp.where(nodes.valid & (nodes.group_id >= 0),
+                      price[gid], 0.0).sum()
+    disruption = (dec.drainable & ~dec.has_blocker & nodes.valid).sum()
+    return LaneSummary(
+        scaleup_cost=cost,
+        fleet_price=fleet,
+        utilization=util,
+        disruption=disruption.astype(jnp.int32),
+        pending=dec.pending_after.sum().astype(jnp.int32),
+        nodes_added=n_add.astype(jnp.int32),
+        best=best,
+    )
+
+
+@partial(jax.jit, static_argnames=("dims", "max_new_nodes",
+                                   "max_pods_per_node", "chunk", "strategy"))
+def _multiverse_step_jit(
+    nodes: NodeTensors,              # leading axis B on every tensor input
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors,
+    groups: NodeGroupTensors,
+    limit_cap: jax.Array,            # i32[B, NG] per-lane composed cap
+    dims: Dims,
+    max_new_nodes: int,
+    max_pods_per_node: int,
+    chunk: int,
+    strategy: str,
+) -> tuple[FusedDecision, LaneSummary]:
+    def one(nt, pt, st, gt, cap):
+        dec, _res = run_once_fused.__wrapped__(
+            nt, pt, st, gt, cap, dims, max_new_nodes,
+            max_pods_per_node, chunk, None, False)
+        return dec, _summarize(dec, _res.nodes, gt, strategy)
+
+    return jax.vmap(one)(nodes, specs, scheduled, groups, limit_cap)
+
+
+def multiverse_step(
+    nodes: NodeTensors,              # leading axis B on every tensor input
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors,
+    groups: NodeGroupTensors,
+    limit_cap: jax.Array,            # i32[B, NG] per-lane composed cap
+    dims: Dims,
+    max_new_nodes: int = 256,
+    max_pods_per_node: int = 128,
+    chunk: int = 32,
+    strategy: str = "least-waste",
+) -> tuple[FusedDecision, LaneSummary]:
+    """One fused RunOnce step over B hypothesis lanes.
+
+    Returns the FULL batched decision planes (verdict / options / drain —
+    every leaf gains axis 0 of size B) plus the on-device LaneSummary
+    reduction, fetched together as one batched transfer. Lane b is
+    bit-identical to a serial `run_once_fused` call on lane b's world —
+    vmap is a dispatch-shape change only, exactly the PR 7 contract.
+
+    The per-lane body is the single-device unconstrained path (planes=None):
+    constraint-overlay worlds take the serial fused dispatch instead, same
+    split the tenant batcher makes.
+
+    Plain-function wrapper: jax's jit cache keys distinguish a kwarg left
+    at its default from the same value passed explicitly, so two callers
+    with different calling conventions would silently pay two compiles of
+    the same program. The wrapper always forwards every static explicitly."""
+    return _multiverse_step_jit(nodes, specs, scheduled, groups, limit_cap,
+                                dims=dims, max_new_nodes=max_new_nodes,
+                                max_pods_per_node=max_pods_per_node,
+                                chunk=chunk, strategy=strategy)
+
+
+multiverse_step._cache_size = _multiverse_step_jit._cache_size
+
+
+def _actuate(nodes2: NodeTensors, dec: FusedDecision, tmpl: NodeTensors,
+             groups: NodeGroupTensors, threshold: jax.Array, strategy: str):
+    """Compressed actuation on the post-placement resident nodes: graft the
+    winning option's template rows into invalid slots, retire empty
+    drainable nodes under the lane threshold. Every branch is a masked
+    select over fixed shapes — a no-op decision (best == -1, nothing
+    drainable) leaves the planes BITWISE unchanged, which is what keeps the
+    null lane's steady-state trajectory byte-identical to the live loop."""
+    best = scoring.best_option(dec.scores, strategy)
+    b = jnp.maximum(best, 0)
+    n_add = jnp.where(best >= 0, dec.est_node_count[b], 0)
+    inv = ~nodes2.valid
+    rank = jnp.cumsum(inv.astype(jnp.int32)) * inv.astype(jnp.int32)
+    take = inv & (rank > 0) & (rank <= n_add)
+
+    def graft(cur, rows):
+        row = rows[b]
+        mask = take.reshape(take.shape + (1,) * (cur.ndim - 1))
+        return jnp.where(mask, row, cur)
+
+    nodes3 = NodeTensors(
+        cap=graft(nodes2.cap, tmpl.cap),
+        alloc=graft(nodes2.alloc, tmpl.alloc),
+        label_hash=graft(nodes2.label_hash, tmpl.label_hash),
+        taint_exact=graft(nodes2.taint_exact, tmpl.taint_exact),
+        taint_key=graft(nodes2.taint_key, tmpl.taint_key),
+        used_ports=graft(nodes2.used_ports, tmpl.used_ports),
+        zone_id=graft(nodes2.zone_id, tmpl.zone_id),
+        group_id=jnp.where(take, b.astype(jnp.int32), nodes2.group_id),
+        ready=nodes2.ready | take,
+        schedulable=nodes2.schedulable | take,
+        valid=nodes2.valid | take,
+    )
+    # retire: drainable, unblocked, below the lane threshold AND empty —
+    # the compressed policy never migrates residents, so only pod-free
+    # nodes leave the world (the drain verdicts of freshly-grafted rows
+    # are last step's sweep of an invalid slot: exclude them)
+    empty = nodes3.alloc.sum(axis=1) == 0
+    remove = (nodes3.valid & dec.drainable & ~dec.has_blocker
+              & (dec.util < threshold) & empty & ~take)
+    nodes4 = nodes3.replace(
+        ready=nodes3.ready & ~remove,
+        schedulable=nodes3.schedulable & ~remove,
+        valid=nodes3.valid & ~remove,
+    )
+    price = groups.price_per_node
+    cost = jnp.where(best >= 0, price[b] * n_add.astype(jnp.float32), 0.0)
+    gid = jnp.maximum(nodes4.group_id, 0)
+    fleet = jnp.where(nodes4.valid & (nodes4.group_id >= 0),
+                      price[gid], 0.0).sum()
+    return nodes4, best, take.sum(), remove.sum(), cost, fleet
+
+
+def _rollout_body(nodes, specs, scheduled, groups, limit_cap, threshold,
+                  adds, fails, dims, max_new_nodes, max_pods_per_node,
+                  chunk, strategy):
+    tmpl = groups.as_node_tensors(dims)
+
+    def step(carry, xs):
+        nodes_c, specs_c = carry
+        add_t, fail_t = xs
+        # workload injection for this simulated loop: pending-pod arrivals
+        # (negative = completions) and spot reclaims / failures
+        specs_c = specs_c.replace(
+            count=jnp.maximum(specs_c.count + add_t, 0))
+        nodes_c = nodes_c.replace(
+            ready=nodes_c.ready & ~fail_t,
+            schedulable=nodes_c.schedulable & ~fail_t)
+        dec, res = run_once_fused.__wrapped__(
+            nodes_c, specs_c, scheduled, groups, limit_cap, dims,
+            max_new_nodes, max_pods_per_node, chunk, None, False)
+        nodes4, best, added, removed, cost, fleet = _actuate(
+            res.nodes, dec, tmpl, groups, threshold, strategy)
+        nvalid = res.nodes.valid.sum()
+        util = jnp.where(
+            nvalid > 0,
+            (dec.util * res.nodes.valid).sum() / jnp.maximum(nvalid, 1), 0.0)
+        out = RolloutStep(
+            verdict=dec.verdict,
+            pending_after=dec.pending_after,
+            best=best,
+            nodes_added=added.astype(jnp.int32),
+            nodes_removed=removed.astype(jnp.int32),
+            util_mean=util,
+            scaleup_cost=cost,
+            fleet_price=fleet,
+        )
+        return (nodes4, res.specs), out
+
+    _final, traj = jax.lax.scan(step, (nodes, specs), (adds, fails))
+    return traj
+
+
+@partial(jax.jit, static_argnames=("dims", "max_new_nodes",
+                                   "max_pods_per_node", "chunk", "strategy"))
+def _rollout_fused_jit(nodes, specs, scheduled, groups, limit_cap,
+                       threshold, adds, fails, dims, max_new_nodes,
+                       max_pods_per_node, chunk, strategy) -> RolloutStep:
+    return _rollout_body(nodes, specs, scheduled, groups, limit_cap,
+                         threshold, adds, fails, dims, max_new_nodes,
+                         max_pods_per_node, chunk, strategy)
+
+
+def rollout_fused(
+    nodes: NodeTensors,
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors,
+    groups: NodeGroupTensors,
+    limit_cap: jax.Array,     # i32[NG]
+    threshold: jax.Array,     # f32 scale-down utilization threshold
+    adds: jax.Array,          # i32[T, G] pending-pod arrivals per step
+    fails: jax.Array,         # bool[T, N] node failures / spot reclaims
+    dims: Dims,
+    max_new_nodes: int = 256,
+    max_pods_per_node: int = 128,
+    chunk: int = 32,
+    strategy: str = "least-waste",
+) -> RolloutStep:
+    """T fused loops as ONE device program: 'simulate this week' is a single
+    dispatch + one compact trajectory fetch instead of T round trips. The
+    scan carries (nodes, specs); `scheduled` (resident pods) stays the
+    branch world's — the compressed policy moves capacity, not residents.
+
+    Plain wrapper over the jit so every static forwards explicitly — see
+    `multiverse_step` for why (default-vs-explicit kwargs split the cache)."""
+    return _rollout_fused_jit(nodes, specs, scheduled, groups, limit_cap,
+                              threshold, adds, fails, dims=dims,
+                              max_new_nodes=max_new_nodes,
+                              max_pods_per_node=max_pods_per_node,
+                              chunk=chunk, strategy=strategy)
+
+
+rollout_fused._cache_size = _rollout_fused_jit._cache_size
+
+
+@partial(jax.jit, static_argnames=("dims", "max_new_nodes",
+                                   "max_pods_per_node", "chunk", "strategy"))
+def _rollout_multiverse_jit(nodes, specs, scheduled, groups, limit_cap,
+                            thresholds, adds, fails, dims, max_new_nodes,
+                            max_pods_per_node, chunk,
+                            strategy) -> RolloutStep:
+    def one(nt, pt, st, gt, cap, th, ad, fl):
+        return _rollout_body(nt, pt, st, gt, cap, th, ad, fl, dims,
+                             max_new_nodes, max_pods_per_node, chunk,
+                             strategy)
+
+    return jax.vmap(one)(nodes, specs, scheduled, groups, limit_cap,
+                         thresholds, adds, fails)
+
+
+def rollout_multiverse(
+    nodes: NodeTensors,       # leading axis B on every tensor input
+    specs: PodGroupTensors,
+    scheduled: ScheduledPodTensors,
+    groups: NodeGroupTensors,
+    limit_cap: jax.Array,     # i32[B, NG]
+    thresholds: jax.Array,    # f32[B]
+    adds: jax.Array,          # i32[B, T, G]
+    fails: jax.Array,         # bool[B, T, N]
+    dims: Dims,
+    max_new_nodes: int = 256,
+    max_pods_per_node: int = 128,
+    chunk: int = 32,
+    strategy: str = "least-waste",
+) -> RolloutStep:
+    """B lanes × T loops in one dispatch — the headline B·T fused-steps-per-
+    dispatch shape (`bench.py --whatif`). Every RolloutStep leaf gains a
+    leading lane axis; lane b is bit-identical to `rollout_fused` on lane
+    b's world and workload.
+
+    Plain wrapper over the jit so every static forwards explicitly — see
+    `multiverse_step` for why (default-vs-explicit kwargs split the cache)."""
+    return _rollout_multiverse_jit(nodes, specs, scheduled, groups,
+                                   limit_cap, thresholds, adds, fails,
+                                   dims=dims, max_new_nodes=max_new_nodes,
+                                   max_pods_per_node=max_pods_per_node,
+                                   chunk=chunk, strategy=strategy)
+
+
+rollout_multiverse._cache_size = _rollout_multiverse_jit._cache_size

@@ -371,3 +371,116 @@ def test_fused_census_counts_compiles_only_on_growth():
     for loop in range(1, 4):
         a.run_once(now=1000.0 + 10 * loop)
     assert c.value() == after_cold, "steady-state fused recompile"
+
+
+# ------------------------------------------- deferral observability (PR 18)
+
+
+def test_fused_deferral_is_counted_and_evented():
+    """A fused→phased deferral silently re-gains the phased ladder's round
+    trips — it must surface as fused_deferrals_total{cause} plus ONE
+    FusedDeferral event per dedup window, never a silent downgrade."""
+    fake = _world(seed=12)
+    a = _autoscaler(fake, fused_loop=True)
+    a.scale_up_orchestrator.mesh = object()
+    for loop in range(3):
+        st = a.run_once(now=1000.0 + 10 * loop)
+        assert st.fused_mode == "phased"
+    assert a.metrics.counter("fused_deferrals_total").value(
+        cause="mesh-sharded") == 3
+    evs = [e for e in a.event_sink.snapshot()
+           if e["reason"] == "FusedDeferral"]
+    assert len(evs) == 1, "deferral events must dedup inside the window"
+    assert "phased ladder" in evs[0]["message"]
+
+
+def test_deferral_discards_armed_speculation():
+    """A speculative dispatch left in flight across a deferred loop must
+    never survive to a later harvest: the deferral drops it, counts it,
+    and the eventual fused loop decides identically to a cold twin."""
+    twins = [_world(seed=13), _world(seed=13)]
+    a = _autoscaler(twins[0], fused_loop=True, max_bulk_soft_taint_count=0)
+    cold = _autoscaler(twins[1], fused_loop=True,
+                       max_bulk_soft_taint_count=0)
+    for x in (a, cold):
+        x.capture_verdicts = True
+    for loop in range(3):
+        a.run_once(now=1000.0 + 10 * loop)
+        cold.run_once(now=1000.0 + 10 * loop)
+    assert a._speculation is not None, "speculation must be armed"
+    before = a.metrics.counter("speculative_discards_total").value()
+    a.scale_up_orchestrator.mesh = object()       # next loop defers
+    st = a.run_once(now=1030.0)
+    assert st.fused_mode == "phased"
+    assert a._speculation is None
+    assert a.metrics.counter("speculative_discards_total").value() \
+        == before + 1
+    assert a.last_speculation["outcome"] == "discard"
+    assert a.last_speculation["cause"] == "mesh-sharded"
+    # back on the fused path: no stale harvest, decisions match the twin
+    a.scale_up_orchestrator.mesh = None
+    cold.run_once(now=1030.0)
+    sa = a.run_once(now=1040.0)
+    sc = cold.run_once(now=1040.0)
+    assert sa.fused_mode == "fused"
+    assert sa.speculation != "hit"
+    assert _digest(a, sa) == _digest(cold, sc)
+
+
+def test_audit_divergence_never_leaves_speculation_in_flight(tmp_path):
+    """Shadow audit × speculation (the PR 15 × PR 17 seam): a divergence
+    verdict means the device is suspect — no speculative dispatch may
+    stay armed across the divergent loop, and the healed loop must
+    dispatch fresh (never harvest a program that computed on pre-heal
+    planes), deciding bit-identical to a cold comparator."""
+    twins = [_world(seed=14), _world(seed=14)]
+    opts = dict(fused_loop=True, max_bulk_soft_taint_count=0,
+                shadow_audit=True,
+                shadow_audit_dir=str(tmp_path / "audit"),
+                journal_dir=str(tmp_path / "journal"))
+    a = _autoscaler(twins[0], **opts)
+    cold = _autoscaler(twins[1], fused_loop=True,
+                       max_bulk_soft_taint_count=0)
+    for x in (a, cold):
+        x.capture_verdicts = True
+    for loop in range(3):
+        st = a.run_once(now=1000.0 + 10 * loop)
+        cold.run_once(now=1000.0 + 10 * loop)
+        assert not st.audit_divergence
+    assert a._speculation is not None, "speculation must be armed"
+    faults.install([{"hook": "verdict_plane", "kind": "flip_bit",
+                     "times": 1}], seed=7)
+    st = a.run_once(now=1030.0)
+    assert st.audit_divergence
+    assert a._speculation is None, \
+        "a speculation must never stay in flight across a divergent loop"
+    faults.clear()
+    cold.run_once(now=1030.0)
+    # the healed loop re-encodes cold and dispatches fresh — bit-identical
+    # to the comparator that never saw corruption, with no stale harvest
+    sa = a.run_once(now=1040.0)
+    sc = cold.run_once(now=1040.0)
+    assert not sa.audit_divergence
+    assert sa.speculation != "hit"
+    assert _digest(a, sa) == _digest(cold, sc)
+
+
+def test_audit_divergence_discard_attribution():
+    """The seam's defense-in-depth: if a speculation IS in flight when the
+    audit convicts the device, the discard is attributed to the divergence
+    (counter + last_speculation cause) — the handle is dropped unharvested."""
+    a = _autoscaler(_world(seed=15), fused_loop=True,
+                    max_bulk_soft_taint_count=0)
+    for loop in range(3):
+        a.run_once(now=1000.0 + 10 * loop)
+    assert a._speculation is not None
+    before = a.metrics.counter("speculative_discards_total").value()
+    a._discard_speculation("audit-divergence")
+    assert a._speculation is None
+    assert a.metrics.counter("speculative_discards_total").value() \
+        == before + 1
+    assert a.last_speculation["outcome"] == "discard"
+    assert a.last_speculation["cause"] == "audit-divergence"
+    # the next loop dispatches fresh — a dropped handle is gone for good
+    st = a.run_once(now=1030.0)
+    assert st.fused_mode == "fused" and st.speculation != "hit"

@@ -1,0 +1,401 @@
+"""Counterfactual multiverse (ISSUE 18 / docs/WHATIF.md): vmapped what-if
+lanes over branched autoscaler worlds and device-resident time-compressed
+rollouts.
+
+The contracts pinned here:
+- lane 0 (the null hypothesis) is BIT-IDENTICAL to a serial run_once_fused
+  dispatch on the unperturbed branch world — under churn, across oracles
+- the same (seed, journal cursor, variants) yields byte-identical variant
+  deltas and lane digests across independent runs, and regardless of
+  whether the recording loop ran fused or phased (the branch planes come
+  from the journal's world records, not the recording mode)
+- on a world in equilibrium with its own decisions, the null lane's rollout
+  trajectory digest equals T live fused RunOnce loops (the bench gate)
+- the synthetic workload generator is seeded-deterministic and its spec
+  round-trips through the journal-record encoding
+- the sidecar WhatIf RPC pads lanes to a shape rung, masks padding out of
+  the report, and prices lane 0 deltas at exactly zero
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from kubernetes_autoscaler_tpu.whatif import (
+    Branch,
+    VariantSpec,
+    WorkloadSpec,
+    branch_from_journal,
+    build_lanes,
+    build_report,
+    generate_workload,
+    lane_digests,
+    multiverse_step,
+    rollout_fused,
+    rollout_multiverse,
+)
+from kubernetes_autoscaler_tpu.whatif import report as wreport
+from kubernetes_autoscaler_tpu.whatif.generator import lane_workloads
+from kubernetes_autoscaler_tpu.whatif.synthetic import (
+    synthetic_autoscaler,
+    synthetic_branch,
+)
+
+VARIANTS = [
+    VariantSpec(name="half-price", price_scale=0.5),
+    VariantSpec(name="tight-cap", max_new_cap=1),
+    VariantSpec(name="hot-drain", threshold=0.9),
+    VariantSpec(name="reclaim", fail_nodes=(1,)),
+]
+
+# Dispatching tests stick to ONE lane rung (B=4) and ONE rollout length
+# (T=4) so the vmap/scan programs compile once for the whole module —
+# tier-1 pays the compile, every later test is a cache hit.
+STEP_VARIANTS = VARIANTS[:3]
+T_STEPS = 4
+
+
+def _kw(branch, **extra):
+    st = branch.statics
+    kw = dict(dims=st["dims"], max_new_nodes=st["max_new_nodes"],
+              max_pods_per_node=st["max_pods_per_node"], chunk=st["chunk"])
+    kw.update(extra)
+    return kw
+
+
+def _step(lanes, **extra):
+    return multiverse_step(lanes.nodes, lanes.specs, lanes.scheduled,
+                           lanes.groups, lanes.limit_cap,
+                           **_kw(lanes, **extra))
+
+
+# ---- generator ---------------------------------------------------------
+
+
+def test_generator_deterministic_and_round_trips():
+    spec = WorkloadSpec(kind="bursty", seed=42, burst_prob=0.5, burst_size=7)
+    a1, f1 = generate_workload(spec, 16, 8, 12)
+    a2, f2 = generate_workload(spec, 16, 8, 12)
+    assert a1.dtype == np.int32 and f1.dtype == bool
+    assert (a1 == a2).all() and (f1 == f2).all()
+    other = generate_workload(WorkloadSpec(kind="bursty", seed=43,
+                                           burst_prob=0.5, burst_size=7),
+                              16, 8, 12)
+    assert not (a1 == other[0]).all()
+    # the record encoding is lossless — a journaled what-if re-generates
+    # the exact same traffic
+    back = WorkloadSpec.from_record(spec.to_record())
+    assert back == spec
+    a3, f3 = generate_workload(back, 16, 8, 12)
+    assert (a1 == a3).all() and (f1 == f3).all()
+
+
+def test_generator_kinds_shape_traffic():
+    t, g, n = 24, 4, 6
+    quiet = generate_workload(WorkloadSpec(kind="quiet"), t, g, n)
+    assert not quiet[0].any() and not quiet[1].any()
+    diurnal = generate_workload(WorkloadSpec(kind="diurnal", seed=1,
+                                             base_rate=5.0), t, g, n)
+    assert diurnal[0].sum() > 0 and not diurnal[1].any()
+    spot = generate_workload(WorkloadSpec(kind="spot", seed=1,
+                                          reclaim_prob=1.0,
+                                          reclaim_nodes=2), t, g, n)
+    assert spot[1].any()
+
+
+def test_lane_workloads_null_lane_untouched():
+    adds, fails = generate_workload(
+        WorkloadSpec(kind="diurnal", seed=3, base_rate=4.0), 8, 4, 6)
+    vs = [VariantSpec(name="null"),
+          VariantSpec(name="surge", pending_scale=2.0)]
+    adds_b, fails_b = lane_workloads(vs, adds, fails)
+    assert adds_b.shape == (2, 8, 4) and fails_b.shape == (2, 8, 6)
+    assert adds_b[0].tobytes() == adds.tobytes()
+    assert adds_b[1].sum() >= 2 * adds.sum()
+
+
+# ---- lanes -------------------------------------------------------------
+
+
+def test_build_lanes_null_lane_leaves_are_branch_bytes():
+    """Perturbations on OTHER lanes must not drift lane 0: every per-lane
+    knob plane's row 0 is byte-for-byte the branch plane."""
+    branch, _a = synthetic_branch(seed=5)
+    lanes = build_lanes(branch, VARIANTS, pad_to=8)
+    assert lanes.real == len(VARIANTS) + 1 and len(lanes.variants) == 8
+    assert lanes.variants[0].is_null()
+    assert np.asarray(lanes.limit_cap)[0].tobytes() \
+        == branch.limit_cap.tobytes()
+    assert np.asarray(lanes.groups.price_per_node)[0].tobytes() \
+        == np.asarray(branch.groups.price_per_node).tobytes()
+    assert np.asarray(lanes.specs.count)[0].tobytes() \
+        == np.asarray(branch.specs.count).tobytes()
+    assert np.asarray(lanes.nodes.ready)[0].tobytes() \
+        == np.asarray(branch.nodes.ready).tobytes()
+    # and the perturbed lanes did move their own knobs
+    assert np.asarray(lanes.groups.price_per_node)[1].sum() \
+        < np.asarray(branch.groups.price_per_node).sum()
+    assert not np.asarray(lanes.nodes.ready)[4, 1]
+
+
+def test_null_lane_bit_identical_to_serial_fused_under_churn():
+    """Single-step identity holds on ANY world: run a churny live sequence,
+    branch the last fused dispatch, and lane 0's full decision surface
+    digests equal a serial run_once_fused call on the branch planes."""
+    from kubernetes_autoscaler_tpu.ops.autoscale_step import run_once_fused
+    from kubernetes_autoscaler_tpu.utils.testing import build_test_pod
+
+    fake, a = synthetic_autoscaler(n_nodes=6, n_pending=5, seed=11)
+    for loop in range(4):
+        if loop == 1:
+            fake.add_pod(build_test_pod("late", cpu_milli=700, mem_mib=256,
+                                        owner_name="prs"))
+        if loop == 2:
+            fake.remove_pod("p0")
+            fake.add_pod(build_test_pod("burst", cpu_milli=3900,
+                                        mem_mib=512, owner_name="bg"))
+        st = a.run_once(now=1000.0 + 10 * loop)
+        assert st.fused_mode == "fused"
+    from kubernetes_autoscaler_tpu.whatif.variants import branch_from_live
+
+    branch = branch_from_live(a)
+    lanes = build_lanes(branch, STEP_VARIANTS, pad_to=4)
+    dec, _sum = _step(lanes)
+    # call with the LIVE loop's exact convention (planes kwarg + statics
+    # dict) so this hits the compile the churn loops above already paid —
+    # jit cache keys are calling-convention-sensitive
+    serial_dec, _res = run_once_fused(
+        branch.nodes, branch.specs, branch.scheduled, branch.groups,
+        branch.limit_cap, planes=None, **branch.statics)
+    want = wreport._digest(*(np.asarray(x) for x in (
+        serial_dec.verdict, serial_dec.pending_after,
+        serial_dec.est_node_count, serial_dec.drainable, serial_dec.util)))
+    assert lane_digests(dec, lanes.real)[0] == want
+
+
+# ---- journal-cursor determinism ----------------------------------------
+
+
+def _journaled_world(tmp_path, tag, fused):
+    from kubernetes_autoscaler_tpu.utils.testing import build_test_pod
+
+    jdir = str(tmp_path / f"journal-{tag}")
+    fake, a = synthetic_autoscaler(n_nodes=6, n_pending=5, seed=11,
+                                   journal_dir=jdir, fused_loop=fused)
+    for loop in range(4):
+        if loop == 2:
+            fake.add_pod(build_test_pod("late", cpu_milli=700, mem_mib=256,
+                                        owner_name="prs"))
+        a.run_once(now=1000.0 + 10 * loop)
+    return jdir
+
+
+def _report_at_cursor(jdir, upto):
+    branch = branch_from_journal(jdir, upto=upto)
+    lanes = build_lanes(branch, STEP_VARIANTS, pad_to=4)
+    dec, summary = _step(lanes)
+    return build_report(lanes, summary=summary, decision=dec)
+
+
+def test_same_cursor_same_bytes_across_runs_and_oracles(tmp_path):
+    """The replayability statement: (journal, cursor, variants) pins the
+    report — two independent replays agree byte for byte, and a journal
+    RECORDED under the phased ladder branches to the same lanes as one
+    recorded fused (twin worlds, same churn)."""
+    j_fused = _journaled_world(tmp_path, "fused", fused=True)
+    r1 = _report_at_cursor(j_fused, upto=2)
+    r2 = _report_at_cursor(j_fused, upto=2)
+    assert json.dumps(r1, sort_keys=True) == json.dumps(r2, sort_keys=True)
+    assert r1["laneDigests"][0] != ""
+    # deltas of the null lane are identically zero
+    assert all(v == 0 for v in r1["summary"][0]["deltas"].values())
+
+    j_phased = _journaled_world(tmp_path, "phased", fused=False)
+    r3 = _report_at_cursor(j_phased, upto=2)
+    assert r1["laneDigests"] == r3["laneDigests"]
+    assert [row["deltas"] for row in r1["summary"]] \
+        == [row["deltas"] for row in r3["summary"]]
+    # a different cursor is a different world — loop 1 predates the churn
+    # that loop 2 saw, so the digests must move
+    r4 = _report_at_cursor(j_fused, upto=1)
+    assert r4["laneDigests"] != r1["laneDigests"]
+
+
+# ---- time-compressed rollout -------------------------------------------
+
+
+def test_rollout_null_lane_matches_live_trajectory():
+    """The bench gate at test scale: on a world in equilibrium with its own
+    decisions (plan-only verdicts), lane 0's rollout trajectory digest
+    equals T live fused RunOnce loops."""
+    t_steps = T_STEPS
+    branch, auto = synthetic_branch(n_nodes=6, n_pending=4, seed=7,
+                                    loops=2, pending_milli=64000)
+    live_verd, live_pend = [], []
+    for k in range(t_steps):
+        st = auto.run_once(now=2000.0 + 10.0 * k)
+        assert st.fused_mode == "fused"
+        dec = auto._fused_ctx["decision"]
+        live_verd.append(np.array(dec.verdict))
+        live_pend.append(np.array(dec.pending_after))
+    assert any(p.sum() > 0 for p in live_pend), "world must be nontrivial"
+
+    lanes = build_lanes(branch, STEP_VARIANTS, pad_to=4)
+    g = int(np.asarray(lanes.specs.count).shape[1])
+    n = int(np.asarray(lanes.nodes.valid).shape[1])
+    adds, fails = generate_workload(WorkloadSpec(kind="quiet"), t_steps, g, n)
+    adds_b, fails_b = lane_workloads(lanes.variants, adds, fails)
+    traj = rollout_multiverse(
+        lanes.nodes, lanes.specs, lanes.scheduled, lanes.groups,
+        lanes.limit_cap, lanes.thresholds, adds_b, fails_b, **_kw(branch))
+    live = wreport._digest(np.stack(live_verd), np.stack(live_pend))
+    assert wreport.trajectory_digests(traj, lanes.real)[0] == live
+
+
+def test_rollout_multiverse_lane_matches_rollout_fused():
+    """vmap is a dispatch-shape change only: every multiverse lane equals a
+    single-lane rollout_fused on that lane's world and workload."""
+    branch, _a = synthetic_branch(n_nodes=6, n_pending=4, seed=9)
+    lanes = build_lanes(branch, VARIANTS[:2], pad_to=4)
+    g = int(np.asarray(lanes.specs.count).shape[1])
+    n = int(np.asarray(lanes.nodes.valid).shape[1])
+    adds, fails = generate_workload(
+        WorkloadSpec(kind="bursty", seed=5, burst_prob=0.5, burst_size=3),
+        T_STEPS, g, n)
+    adds_b, fails_b = lane_workloads(lanes.variants, adds, fails)
+    kw = _kw(branch)
+    traj = rollout_multiverse(
+        lanes.nodes, lanes.specs, lanes.scheduled, lanes.groups,
+        lanes.limit_cap, lanes.thresholds, adds_b, fails_b, **kw)
+    import jax
+
+    for b in range(lanes.real):
+        one = rollout_fused(
+            jax.tree_util.tree_map(lambda x: x[b], lanes.nodes),
+            jax.tree_util.tree_map(lambda x: x[b], lanes.specs),
+            jax.tree_util.tree_map(lambda x: x[b], lanes.scheduled),
+            jax.tree_util.tree_map(lambda x: x[b], lanes.groups),
+            lanes.limit_cap[b], lanes.thresholds[b],
+            adds_b[b], fails_b[b], **kw)
+        for leaf_m, leaf_s in zip(jax.tree_util.tree_leaves(traj),
+                                  jax.tree_util.tree_leaves(one)):
+            assert np.asarray(leaf_m[b]).tobytes() \
+                == np.asarray(leaf_s).tobytes(), f"lane {b} drifted"
+
+
+def test_rollout_workload_moves_the_world():
+    """A bursty workload on a placeable world must make the rollout DO
+    something: pending arrives, placements bind, scale-up materializes
+    nodes — and the report's per-lane rollout block reflects it."""
+    branch, _a = synthetic_branch(n_nodes=4, n_pending=2, seed=3)
+    lanes = build_lanes(branch, [VariantSpec(name="surge",
+                                             pending_scale=3.0)],
+                        pad_to=4)
+    g = int(np.asarray(lanes.specs.count).shape[1])
+    n = int(np.asarray(lanes.nodes.valid).shape[1])
+    wl = WorkloadSpec(kind="bursty", seed=2, burst_prob=1.0, burst_size=24)
+    adds, fails = generate_workload(wl, T_STEPS, g, n)
+    adds_b, fails_b = lane_workloads(lanes.variants, adds, fails)
+    traj = rollout_multiverse(
+        lanes.nodes, lanes.specs, lanes.scheduled, lanes.groups,
+        lanes.limit_cap, lanes.thresholds, adds_b, fails_b, **_kw(branch))
+    rep = build_report(lanes, traj=traj, workload=wl)
+    per = rep["rollout"]["perLane"]
+    assert rep["workload"]["kind"] == "bursty"
+    assert any(row["nodesAdded"] > 0 for row in per), per
+    assert rep["rollout"]["trajectoryDigests"][0] \
+        != rep["rollout"]["trajectoryDigests"][1]
+
+
+# ---- CLI ---------------------------------------------------------------
+
+
+def test_cli_synthetic_report(tmp_path, capsys):
+    from kubernetes_autoscaler_tpu.whatif.cli import main
+
+    out = tmp_path / "rep.json"
+    rc = main(["--synthetic", "--nodes", "4", "--pending", "3",
+               "--rollout", "4", "--workload", "diurnal",
+               "--variants", '[{"name": "x", "price_scale": 2.0}]',
+               "--out", str(out)])
+    assert rc == 0
+    rep = json.loads(out.read_text())
+    assert rep["lanes"] == 2
+    assert rep["variants"][0]["name"] == "null"
+    assert rep["summary"][0]["deltas"]["scaleupCost"] == 0.0
+    assert rep["rollout"]["steps"] == 4
+    assert len(rep["laneDigests"]) == 2
+
+
+# ---- sidecar RPC -------------------------------------------------------
+
+
+def _native_available():
+    from kubernetes_autoscaler_tpu.sidecar import native_api
+
+    return native_api.available()
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native codec not buildable")
+def test_sidecar_what_if_rpc():
+    grpc = pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimulatorClient,
+        SimulatorService,
+        make_grpc_server,
+    )
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+    from kubernetes_autoscaler_tpu.utils.testing import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    mib = 1024 * 1024
+    service = SimulatorService(node_bucket=16, group_bucket=16)
+    server, port = make_grpc_server(service, port=0)
+    server.start()
+    try:
+        c = SimulatorClient(port)
+        w = DeltaWriter()
+        w.upsert_node(build_test_node("n1", cpu_milli=2000, mem_mib=4096))
+        for i in range(5):
+            w.upsert_pod(build_test_pod(f"p{i}", cpu_milli=900, mem_mib=256,
+                                        owner_name="rs"))
+        assert c.apply_delta(w)["error"] == ""
+
+        groups = [{"id": "ng-big",
+                   "template": {"name": "t", "labels": {},
+                                "capacity": {"cpu": 4.0,
+                                             "memory": 8192 * mib,
+                                             "pods": 110}},
+                   "max_new": 10, "price": 2.0}]
+        rep = c.what_if(
+            variants=[{"name": "cheap", "price_scale": 0.5},
+                      {"name": "capped", "max_new_cap": 0}],
+            rollout=3, workload={"v": 1, "kind": "quiet"},
+            node_groups=groups)
+        assert rep["lanes"] == 3           # null + 2, padding masked out
+        assert rep["variants"][0]["name"] == "null"
+        null, cheap, capped = rep["summary"]
+        assert all(v == 0 for v in null["deltas"].values())
+        # half price on the same winning option: cost delta is negative
+        assert null["scaleupCost"] > 0
+        assert cheap["deltas"]["scaleupCost"] \
+            == pytest.approx(-0.5 * null["scaleupCost"])
+        # a zero cap refuses the expansion entirely
+        assert capped["nodesAdded"] == 0 and capped["best"] == -1
+        assert len(rep["laneDigests"]) == 3
+        assert rep["rollout"]["steps"] == 3
+        # determinism over the wire: the same request re-yields the bytes
+        rep2 = c.what_if(
+            variants=[{"name": "cheap", "price_scale": 0.5},
+                      {"name": "capped", "max_new_cap": 0}],
+            rollout=3, workload={"v": 1, "kind": "quiet"},
+            node_groups=groups)
+        assert rep2["laneDigests"] == rep["laneDigests"]
+        assert rep2["rollout"]["trajectoryDigests"] \
+            == rep["rollout"]["trajectoryDigests"]
+    finally:
+        server.stop(None)
