@@ -267,6 +267,12 @@ def run_floor_child(metric: str, args) -> int:
         # the child re-expands --all itself (and owns the combined line;
         # this parent's stdout tee never saw the child's fd writes)
         cmd += ["--all"]
+    if getattr(args, "history", ""):
+        # same reason: the child's records bypass our tee (inherited fd),
+        # so the CHILD appends them — run id shared via KA_BENCH_RUN_ID
+        cmd += ["--history", args.history]
+        if getattr(args, "check_regressions", False):
+            cmd += ["--check-regressions"]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     print(f"[bench] degrading to CPU floor metric: {' '.join(cmd[1:])}",
@@ -281,34 +287,67 @@ def run_floor_child(metric: str, args) -> int:
         return 1
 
 
-class _MetricTee:
-    """stdout wrapper for --all: passes every write through while capturing
-    each parseable {"metric": ...} JSON line, keyed by metric name (last
-    write wins — the re-printed headline dedups itself), so the run can end
-    with ONE combined JSON object over every mode's evidence."""
+# bench JSON record schema (mirrors perfwatch.history.SCHEMA_VERSION —
+# kept as a literal so importing it never touches the package tree before
+# the backend probe; tests assert the two stay equal). v2 added
+# schema_version + the propagated run_id: the floor child used to emit
+# unversioned records uncorrelated with the parent that spawned it.
+SCHEMA_VERSION = 2
 
-    def __init__(self, stream):
+
+def bench_run_id() -> str:
+    """The run correlation id: one id for the whole invocation INCLUDING
+    a degraded floor child — the child inherits KA_BENCH_RUN_ID through
+    the environment, so parent and child records join in the history."""
+    rid = os.environ.get("KA_BENCH_RUN_ID", "")
+    if not rid:
+        rid = f"{int(time.time()):x}-{os.getpid():04x}"
+        os.environ["KA_BENCH_RUN_ID"] = rid
+    return rid
+
+
+class _MetricTee:
+    """stdout wrapper: buffers writes line-wise, STAMPS each parseable
+    {"metric": ...} JSON line with schema_version + run_id before it
+    reaches the terminal, and captures it keyed by metric name (last
+    write wins — the re-printed headline dedups itself). The capture
+    feeds --all's combined line, the --history appends and the final
+    summary table; non-JSON output passes through untouched."""
+
+    def __init__(self, stream, stamp: dict | None = None):
         self.stream = stream
+        self.stamp = stamp or {}
         self.results: dict = {}
         self._buf = ""
 
     def write(self, s):
-        self.stream.write(s)
         self._buf += s
         while "\n" in self._buf:
             line, self._buf = self._buf.split("\n", 1)
-            line = line.strip()
-            if line.startswith("{"):
+            stripped = line.strip()
+            if stripped.startswith("{"):
                 try:
-                    obj = json.loads(line)
+                    obj = json.loads(stripped)
                 except ValueError:
-                    continue
+                    obj = None
                 if isinstance(obj, dict) and obj.get("metric"):
+                    for k, v in self.stamp.items():
+                        obj.setdefault(k, v)
                     self.results[obj["metric"]] = obj
+                    line = json.dumps(obj)
+            self.stream.write(line + "\n")
         return len(s)
 
     def flush(self):
         self.stream.flush()
+
+    def detach(self) -> dict:
+        """Flush any partial line through unstamped and return captures."""
+        if self._buf:
+            self.stream.write(self._buf)
+            self._buf = ""
+        self.stream.flush()
+        return self.results
 
     def __getattr__(self, name):
         return getattr(self.stream, name)
@@ -564,6 +603,17 @@ def main() -> None:
                     help="disable the CPU-floor degradation: a missing/hung "
                          "TPU backend emits the null-value error JSON and "
                          "exits 1 (the ONLY path that may produce a null)")
+    ap.add_argument("--history", default="", metavar="DIR",
+                    help="append every emitted mode record to the perfwatch "
+                         "history store at DIR (docs/BENCH.md 'Trajectory & "
+                         "regression gate'); forwarded through the floor "
+                         "child so degraded rounds bank their cpu-floor "
+                         "rows under the shared run id")
+    ap.add_argument("--check-regressions", action="store_true",
+                    help="with --history: after appending, judge this run "
+                         "against its lineage baselines and print the "
+                         "verdicts (report-only; `perfwatch gate` is the "
+                         "exiting surface)")
     ap.add_argument("--floor-for", default="", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
@@ -623,6 +673,10 @@ def main() -> None:
     metric = (args.floor_for or
               f"scaleup_sim_p50_ms_{kp}kpods_{kn}{unit_n}_{args.nodegroups}ng")
 
+    # one correlation id for the whole invocation — set BEFORE any floor
+    # child can be spawned so parent + child records join in the history
+    run_id = bench_run_id()
+
     can_degrade = not (args.smoke or args.floor_for or args.require_tpu)
     if not (args.smoke or args.floor_for):
         # backend autodetect BEFORE this process touches jax: a hung tunnel
@@ -643,29 +697,109 @@ def main() -> None:
             # measured (probe child was killed; our interpreter is clean)
             sys.exit(run_floor_child(metric, args))
 
-    tee = None
-    if args.all:
-        tee = _MetricTee(sys.stdout)
-        sys.stdout = tee
+    # the tee is always on now: every record leaves stamped with
+    # schema_version + run_id, and the captures feed --history / --all
+    tee = _MetricTee(sys.stdout,
+                     stamp={"schema_version": SCHEMA_VERSION,
+                            "run_id": run_id})
+    sys.stdout = tee
+    t_bench = time.perf_counter()
     try:
         run_bench(args, metric, budget=InitBudget())
     except Exception as e:  # noqa: BLE001 — evidence-preserving failure path
-        if tee is not None:
-            sys.stdout = tee.stream
         traceback.print_exc(file=sys.stderr)
         if can_degrade:
+            sys.stdout = tee.stream
             sys.exit(run_floor_child(metric, args))
         emit_failure(metric, e,
                      backend="cpu-floor" if args.smoke or args.floor_for
                      else None)
+        _finish(args, tee, run_id, time.perf_counter() - t_bench)
         sys.exit(1)
-    if tee is not None:
-        sys.stdout = tee.stream
+    _finish(args, tee, run_id, time.perf_counter() - t_bench)
+
+
+def _finish(args, tee: _MetricTee, run_id: str, bench_wall_s: float) -> None:
+    """The epilogue behind every exit that emitted records: the --all
+    combined line, the --history appends (with their measured overhead —
+    CI asserts append_ms ≤ 1% of bench wall), the advisory regression
+    check, and the --all per-mode summary table."""
+    results = tee.detach()
+    sys.stdout = tee.stream
+    if args.all:
         print(json.dumps({
             "metric": "bench_all_combined",
-            "modes": sorted(tee.results),
-            "results": tee.results,
+            "schema_version": SCHEMA_VERSION,
+            "run_id": run_id,
+            "modes": sorted(results),
+            "results": results,
         }), flush=True)
+    mode_records = {name: obj for name, obj in results.items()
+                    if name not in ("bench_all_combined", "perfwatch_log")}
+    verdicts = None
+    if args.history and mode_records:
+        try:
+            from kubernetes_autoscaler_tpu.perfwatch.history import (
+                PerfHistory,
+                git_commit,
+            )
+
+            t0 = time.perf_counter()
+            hist = PerfHistory(args.history)
+            commit = git_commit()
+            now = time.time()
+            for name in sorted(mode_records):
+                hist.append_bench_record(mode_records[name], run_id=run_id,
+                                         commit=commit, ts=now)
+            append_ms = (time.perf_counter() - t0) * 1000.0
+            print(json.dumps({
+                "metric": "perfwatch_log",
+                "schema_version": SCHEMA_VERSION,
+                "run_id": run_id,
+                "history": args.history,
+                "appended": len(mode_records),
+                "append_ms": round(append_ms, 3),
+                "bench_wall_ms": round(bench_wall_s * 1000.0, 3),
+            }), flush=True)
+        except Exception as e:  # noqa: BLE001 — evidence, not control flow
+            print(f"[bench] history append failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+    if args.history and args.check_regressions:
+        try:
+            from kubernetes_autoscaler_tpu.perfwatch.detect import (
+                RegressionDetector,
+                gating_regressions,
+            )
+            from kubernetes_autoscaler_tpu.perfwatch.history import (
+                PerfHistory,
+            )
+            from kubernetes_autoscaler_tpu.perfwatch.report import (
+                verdict_lines,
+            )
+
+            rows = PerfHistory(args.history).load()
+            verdicts = RegressionDetector().check_run(rows, run_id)
+            for line in verdict_lines(verdicts):
+                print(f"[bench] {line}", file=sys.stderr)
+            n_reg = len(gating_regressions(verdicts))
+            print(f"[bench] regression check: {len(verdicts)} verdicts, "
+                  f"{n_reg} gating regressions (advisory — `perfwatch "
+                  f"gate` is the exiting surface)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] regression check failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+    if args.all and mode_records:
+        try:
+            from kubernetes_autoscaler_tpu.perfwatch.report import (
+                mode_summary_table,
+            )
+
+            print("[bench] per-mode summary:", file=sys.stderr)
+            print(mode_summary_table(mode_records, verdicts),
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] summary table failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
 
 
 def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
@@ -811,12 +945,20 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
     # round trip (any D2H readback does this; see module docstring).
     _ = int(out.best)
 
+    # perf canary (CI's regression-gate demo): a PER-CHAINED-SIM delay.
+    # Chain differencing cancels any fixed per-call overhead — only a
+    # per-iteration cost moves the headline p50, so the injected slowdown
+    # must ride inside the k-loop to be a faithful "the sim got slower"
+    canary_ms = float(os.environ.get("KA_BENCH_PERF_CANARY_MS", "0") or 0)
+
     def chain(k: int) -> float:
         t0 = time.perf_counter()
         tok = jnp.int32(0)
         for _ in range(k):
             o = step(nodes, specs, sched, groups, tok, plan)
             tok = o.best
+            if canary_ms:
+                time.sleep(canary_ms / 1000.0)
         jax.block_until_ready(o)
         return (time.perf_counter() - t0) * 1000.0
 

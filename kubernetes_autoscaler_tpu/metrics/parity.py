@@ -227,6 +227,34 @@ SHADOW_AUDIT_FAMILIES = {
         "retained trace id), persisted next to the flight-recorder dumps"),
 }
 
+# The reference benchmarks offline (k8s perf-tests / ClusterLoader2 live
+# OUTSIDE the autoscaler repo) and records no longitudinal perf series of
+# its own simulator. This framework's value proposition IS simulator
+# speed, so the perf observatory (perfwatch/; docs/BENCH.md "Trajectory &
+# regression gate") banks every bench round and gates on statistical
+# regressions. PARITY.md carries the same table; both families ride the
+# normal Registry exposition path and are served identically by /metrics
+# and Metricz.
+PERFWATCH_FAMILIES = {
+    # absent reference surface -> our longitudinal perf accounting
+    "(no longitudinal bench record)": (
+        "bench_runs_total{mode,backend} — every bench.py mode record "
+        "appended to the chain-sealed PerfHistory store, labelled by mode "
+        "and producing-backend lineage; lineage is part of the row, so a "
+        "cpu-floor run can never masquerade as tpu evidence (the PR 7 "
+        "bug class, closed structurally)"),
+    "(no perf regression detection)": (
+        "perf_regressions_total{metric,severity} — confirmed regressions "
+        "from the rolling median+MAD detector (perfwatch/detect.py; "
+        "severity minor/major/critical), each paired with a self-"
+        "contained triage bundle (perfwatch/triage.py)"),
+    "(no bench evidence retention accounting)": (
+        "perf_history_dropped_total{reason} + perf_triage_bundles_total"
+        "{metric} — rotation-pruned and null-valued rows accounted by "
+        "reason (never silently vanished), and the evidence bundles "
+        "written per confirmed regression"),
+}
+
 # The reference UnremovableReason enum values our planner actually produces,
 # value-for-value (simulator/cluster.go:63-103). A dashboard filtering the
 # reference's unremovable_nodes_count{reason=...} re-points unchanged.

@@ -1,0 +1,5 @@
+import sys
+
+from kubernetes_autoscaler_tpu.perfwatch.cli import main
+
+sys.exit(main())

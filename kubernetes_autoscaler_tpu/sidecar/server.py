@@ -2250,6 +2250,28 @@ class SimulatorService:
             for ev in events[-8:]:
                 lines.append(f"  {ev['kind']} {ev['object']}: "
                              f"{ev['reason']} x{ev['count']}")
+        hist_dir = os.environ.get("KATPU_PERF_HISTORY")
+        if hist_dir:
+            # perf trajectory tail: a sidecar pointed at a perfwatch store
+            # serves the recent bench series so fleet perf is inspectable
+            # without pulling artifacts (docs/BENCH.md "Trajectory &
+            # regression gate")
+            try:
+                from ..perfwatch.history import PerfHistory
+                from ..perfwatch.report import trajectory_lines
+                if not os.path.isdir(hist_dir):
+                    # a status read must not mkdir a mistyped store path
+                    raise FileNotFoundError(hist_dir)
+                hist = PerfHistory(hist_dir)
+                st = hist.stats()
+                lines.append(
+                    f"perf history: dir={hist_dir} rows={st['rows']} "
+                    f"dropped={st['dropped_rows']} "
+                    f"lineages={json.dumps(st['lineages'], sort_keys=True)}")
+                for ln in trajectory_lines(hist.load(), last=5):
+                    lines.append("  " + ln)
+            except Exception as exc:  # tampered/unreadable store: surface it
+                lines.append(f"perf history: unreadable ({exc})")
         return "\n".join(lines) + "\n"
 
     def _on_complete(self, method: str, tenant: str, dt_s: float,

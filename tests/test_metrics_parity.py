@@ -199,3 +199,41 @@ def test_device_families_documented_and_exposed():
     for fam in ("hbm_bytes_in_use", "hbm_bytes_limit", "resident_bytes",
                 "tenant_hbm_bytes"):
         assert fam in text, fam
+
+
+def test_perfwatch_families_documented_and_exposed(tmp_path):
+    """ISSUE 19: the perf-observatory mapping exists
+    (parity.PERFWATCH_FAMILIES names every absent reference surface -> our
+    longitudinal family, mirrored in PARITY.md "Perf observatory"), and the
+    named families actually reach the exposition once a history append and
+    a confirmed regression publish them."""
+    from pathlib import Path
+
+    from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+    from kubernetes_autoscaler_tpu.perfwatch.detect import RegressionDetector
+    from kubernetes_autoscaler_tpu.perfwatch.history import PerfHistory
+
+    for ref, ours in parity.PERFWATCH_FAMILIES.items():
+        assert ours and len(ours) > 20, ref
+    doc = " ".join(parity.PERFWATCH_FAMILIES.values())
+    for fam in ("bench_runs_total", "perf_regressions_total",
+                "perf_history_dropped_total", "perf_triage_bundles_total"):
+        assert fam in doc, fam
+    parity_md = (Path(parity.__file__).parents[2] / "PARITY.md").read_text()
+    assert "## Perf observatory" in parity_md
+    assert "PERFWATCH_FAMILIES" in parity_md
+    # a store append + a confirmed regression publish the named families
+    reg = Registry()
+    hist = PerfHistory(str(tmp_path / "hist"), registry=reg)
+    rec = {"metric": "scaleup_sim_p50_ms_1kpods_128nodes_4ng",
+           "unit": "ms", "backend": "cpu-floor", "mode": "smoke"}
+    for i, v in enumerate((5.0, 5.1)):
+        hist.append_bench_record(dict(rec, value=v), run_id=f"r{i}",
+                                 ts=float(i))
+    hist.append_bench_record(dict(rec, value=40.0), run_id="slow", ts=9.0)
+    det = RegressionDetector(min_samples=2, registry=reg)
+    verdicts = det.check_run(hist.load(), "slow")
+    assert any(v.status == "regressed" for v in verdicts)
+    text = reg.expose_text()
+    for fam in ("bench_runs_total", "perf_regressions_total"):
+        assert fam in text, fam

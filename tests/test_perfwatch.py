@@ -1,0 +1,532 @@
+"""Perf observatory (perfwatch/): store sealing, lineage separation,
+detector edge cases, triage bundles, CLI exit codes, metric parity.
+
+The load-bearing assertions:
+  * the chain seal makes tampering STRUCTURAL (HistoryTamperError), not a
+    quiet baseline shift;
+  * cpu-floor and tpu rows NEVER share a baseline window, even when the
+    floor child emits the tpu headline metric name (the PR 7 bug class);
+  * the detector warms up (no-baseline below min_samples), survives
+    MAD=0 constant series without a zero-width band, and treats the
+    invariant counters as exact contracts;
+  * the direction-policy table covers every real bench metric name —
+    every headline gates (or is explicitly opted out) with the right
+    badness direction;
+  * `bench_runs_total` / `perf_regressions_total` are served identically
+    by the /metrics and Metricz exposition paths (PARITY.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_autoscaler_tpu.metrics.metrics import (  # noqa: E402
+    Registry,
+    default_registry,
+    expose_all_text,
+    register_exposition,
+    unregister_exposition,
+)
+from kubernetes_autoscaler_tpu.perfwatch import cli  # noqa: E402
+from kubernetes_autoscaler_tpu.perfwatch.detect import (  # noqa: E402
+    EXACT,
+    GATE,
+    OBSERVE,
+    UP_BAD,
+    DOWN_BAD,
+    RegressionDetector,
+    gating_regressions,
+    policy_for,
+)
+from kubernetes_autoscaler_tpu.perfwatch.history import (  # noqa: E402
+    SCHEMA_VERSION,
+    HistoryTamperError,
+    PerfHistory,
+    flatten_metrics,
+    lineage_of,
+    shape_signature,
+)
+from kubernetes_autoscaler_tpu.perfwatch.triage import (  # noqa: E402
+    build_bundle,
+    census_diff,
+    write_bundle,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rec(metric="scaleup_sim_p50_ms_1kpods_128nodes_4ng", value=5.0,
+         backend="cpu-floor", mode="smoke", **extra):
+    rec = {"metric": metric, "value": value, "unit": "ms",
+           "backend": backend, "mode": mode}
+    rec.update(extra)
+    return rec
+
+
+def _store(tmp_path, **kw) -> PerfHistory:
+    return PerfHistory(str(tmp_path / "hist"), clock=lambda: 1000.0, **kw)
+
+
+def _fill(hist, values, metric="scaleup_sim_p50_ms_1kpods_128nodes_4ng",
+          backend="cpu-floor", **extra):
+    rows = []
+    for i, v in enumerate(values):
+        rows.append(hist.append_bench_record(
+            _rec(metric=metric, value=v, backend=backend, **extra),
+            run_id=f"r{i}", commit=f"c{i}", ts=100.0 + i))
+    return rows
+
+
+# ---- history: seal, chain, rotation, drops ----
+
+class TestHistory:
+    def test_append_load_roundtrip(self, tmp_path):
+        hist = _store(tmp_path)
+        _fill(hist, [5.0, 5.1, 4.9])
+        rows = hist.load(verify=True)
+        assert [r["seq"] for r in rows] == [0, 1, 2]
+        assert rows[1]["parent"] == rows[0]["digest"]
+        assert hist.verify() == 3
+        # reopening resumes the chain where it left off
+        hist2 = PerfHistory(str(tmp_path / "hist"))
+        hist2.append_bench_record(_rec(value=5.2), run_id="r3", ts=103.0)
+        assert hist2.verify() == 4
+
+    def test_chain_tamper_is_structural_error(self, tmp_path):
+        hist = _store(tmp_path)
+        _fill(hist, [5.0, 5.1, 4.9])
+        path = hist.files()[0]
+        doctored = open(path, encoding="utf-8").read().replace(
+            '"value":5.1', '"value":4.1')
+        assert doctored != open(path, encoding="utf-8").read()
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(doctored)
+        with pytest.raises(HistoryTamperError, match="digest mismatch"):
+            hist.load(verify=True)
+
+    def test_row_deletion_breaks_parent_link(self, tmp_path):
+        hist = _store(tmp_path)
+        _fill(hist, [5.0, 5.1, 4.9])
+        path = hist.files()[0]
+        lines = open(path, encoding="utf-8").read().splitlines()
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines[:2] + lines[3:]) + "\n")  # drop row 1
+        with pytest.raises(HistoryTamperError,
+                           match="parent-link|seq gap"):
+            hist.load(verify=True)
+
+    def test_rotation_drop_accounting(self, tmp_path):
+        reg = Registry()
+        hist = PerfHistory(str(tmp_path / "hist"), max_mb=0.02,
+                           keep_files=2, registry=reg)
+        # rotate_bytes ~10KB, rows ~1.5KB: enough rows to prune files
+        big = {"spans": {f"k{i}": float(i) for i in range(40)}}
+        _fill(hist, [float(i) for i in range(40)], **big)
+        assert len(hist.files()) <= 2
+        assert hist.drops.get("rotated", 0) > 0
+        assert reg.counter("perf_history_dropped_total").value(
+            reason="rotated") == hist.drops["rotated"]
+        # retained files still verify despite the pruned prefix
+        rows = hist.load(verify=True)
+        assert len(rows) + hist.drops["rotated"] == 40
+        # appended rows counted by mode and lineage
+        assert reg.counter("bench_runs_total").value(
+            mode="smoke", backend="cpu-floor") == 40
+
+    def test_null_value_rows_are_dropped_not_baselines(self, tmp_path):
+        hist = _store(tmp_path)
+        _fill(hist, [5.0, 5.1])
+        hist.append_bench_record(
+            _rec(value=None, error="TimeoutError: tunnel hang"),
+            run_id="rX", ts=200.0)
+        assert hist.stats()["dropped_rows"] == 1
+        assert "null-value" in " ".join(hist.drops)
+        rows = hist.rows(metric="scaleup_sim_p50_ms_1kpods_128nodes_4ng",
+                         lineage="cpu-floor")
+        assert len(rows) == 2  # the null row is not served as a baseline
+        assert len(hist.rows(include_dropped=True,
+                             lineage="cpu-floor")) == 3
+
+    def test_shape_signature_separates_floor_shapes(self):
+        full = _rec(metric="scaleup_sim_p50_ms_50kpods_5knodes_20ng",
+                    backend="tpu", mode="full")
+        floored = _rec(metric="scaleup_sim_p50_ms_50kpods_5knodes_20ng",
+                       backend="cpu-floor", mode="floor",
+                       floor_shapes={"nodes": 128, "pods": 1500})
+        assert shape_signature(full)[1] != shape_signature(floored)[1]
+
+    def test_flatten_and_lineage(self):
+        flat = flatten_metrics(_rec(
+            value=5.0, steady_state_recompiles=0, ok=True,
+            phases={"encode_ms": 3.0}, name="skipme",
+            spans={"totals_ms": {"fetch": 9.0}}))
+        assert flat["value"] == 5.0
+        assert flat["phases.encode_ms"] == 3.0
+        assert flat["spans.totals_ms.fetch"] == 9.0
+        assert flat["ok"] == 1.0
+        assert "name" not in flat and "unit" not in flat
+        assert lineage_of("tpu") == "tpu"
+        assert lineage_of("cpu-floor") == "cpu-floor"
+        assert lineage_of(None) == "unknown"
+
+
+# ---- detector ----
+
+class TestDetector:
+    def test_no_baseline_warmup(self, tmp_path):
+        hist = _store(tmp_path)
+        _fill(hist, [5.0, 5.1, 30.0])  # a wild value during warmup
+        rows = hist.load()
+        det = RegressionDetector(min_samples=3)
+        verdicts = det.check_run(rows, "r2")
+        assert verdicts and all(v.status == "no-baseline" for v in verdicts)
+        assert not gating_regressions(verdicts)
+
+    def test_mad_zero_constant_series(self, tmp_path):
+        hist = _store(tmp_path)
+        _fill(hist, [5.0, 5.0, 5.0, 5.0, 5.0])
+        rows = hist.load()
+        det = RegressionDetector(min_samples=3)
+        same = det.check_run(rows, "r4")
+        v = next(x for x in same if x.key == "value")
+        # MAD = 0 must not produce a zero-width band: rel_floor holds it open
+        assert v.status == "stable" and v.threshold >= 0.35 * 5.0
+        hist.append_bench_record(_rec(value=9.0), run_id="big", ts=300.0)
+        big = next(x for x in det.check_run(hist.load(), "big")
+                   if x.key == "value")
+        assert big.status == "regressed"
+
+    def test_lineage_switch_never_compared(self, tmp_path):
+        hist = _store(tmp_path)
+        metric = "scaleup_sim_p50_ms_50kpods_5knodes_20ng"
+        # the hazard: floor child emits the TPU headline NAME as cpu-floor
+        _fill(hist, [20.0, 21.0, 19.0], metric=metric, backend="cpu-floor",
+              mode="floor")
+        tpu_row = hist.append_bench_record(
+            _rec(metric=metric, value=5.48, backend="tpu", mode="full"),
+            run_id="tpu0", ts=500.0)
+        rows = hist.load()
+        det = RegressionDetector(min_samples=1)
+        # the tpu row has NO cpu-floor baselines: 5.48 vs ~20 would read
+        # as a huge improvement if lineages ever crossed
+        assert det.baselines_for(rows, rows[-1]) == []
+        v = next(x for x in det.check_run(rows, "tpu0") if x.key == "value")
+        assert v.status == "no-baseline"
+        # and the floor rows never see the tpu anchor either
+        floor_base = det.baselines_for(rows, rows[2])
+        assert floor_base and all(
+            r["lineage"] == "cpu-floor" for r in floor_base)
+        assert tpu_row["digest"] not in {r["digest"] for r in floor_base}
+        # the store level filter agrees
+        assert len(hist.rows(metric=metric, lineage="tpu")) == 1
+
+    def test_exact_counter_any_increase_regresses(self, tmp_path):
+        hist = _store(tmp_path)
+        _fill(hist, [5.0, 5.1, 5.0], steady_state_recompiles=0)
+        hist.append_bench_record(
+            _rec(value=5.05, steady_state_recompiles=1), run_id="leak",
+            ts=400.0)
+        verdicts = RegressionDetector(min_samples=3).check_run(
+            hist.load(), "leak")
+        v = next(x for x in verdicts if x.key == "steady_state_recompiles")
+        assert v.status == "regressed" and v.severity == "critical"
+        assert v.klass == EXACT
+
+    def test_identity_predicate_flip_regresses(self, tmp_path):
+        hist = _store(tmp_path)
+        _fill(hist, [5.0, 5.1, 5.0],
+              replay={"zero_drift": True})
+        hist.append_bench_record(
+            _rec(value=5.0, replay={"zero_drift": False}), run_id="drift",
+            ts=400.0)
+        verdicts = RegressionDetector(min_samples=3).check_run(
+            hist.load(), "drift")
+        v = next(x for x in verdicts if x.key == "replay.zero_drift")
+        assert v.status == "regressed" and v.direction == DOWN_BAD
+
+    def test_improvement_and_regression_directions(self, tmp_path):
+        hist = _store(tmp_path)
+        metric = "multi_tenant_clusters_per_sec"
+        _fill(hist, [100.0, 105.0, 95.0, 102.0], metric=metric)
+        hist.append_bench_record(_rec(metric=metric, value=200.0),
+                                 run_id="fast", ts=300.0)
+        hist.append_bench_record(_rec(metric=metric, value=30.0),
+                                 run_id="slow", ts=301.0)
+        det = RegressionDetector(min_samples=3)
+        rows = hist.load()
+        fast = next(v for v in det.check_run(rows, "fast")
+                    if v.key == "value")
+        slow = next(v for v in det.check_run(rows, "slow")
+                    if v.key == "value")
+        # throughput: up is good, down is bad
+        assert fast.status == "improved"
+        assert slow.status == "regressed"
+
+    def test_direction_policy_covers_real_bench_metrics(self):
+        # the actual headline metric names bench.py emits (grep-audited);
+        # every headline must gate — or be an explicit, reviewed opt-out
+        headlines_up_bad = [
+            "scaleup_sim_p50_ms_50kpods_5knodes_20ng",
+            "scaleup_sim_p50_ms_1kpods_128nodes_4ng",
+            "runonce_e2e_p50_ms_50kpods_5knodes",
+            "runonce_e2e_p50_ms_1kpods_128nodes",
+            "world_store_churn",
+            "local_chaos_control_loop",
+            "device_stats",
+            "fused_loop_e2e",
+            "whatif_multiverse",
+            "shadow_audit_smoke",
+            "journal_record_replay_smoke",
+        ]
+        for m in headlines_up_bad:
+            pol = policy_for(m, "value")
+            assert pol.klass == GATE, m
+            assert pol.direction == UP_BAD, m
+        pol = policy_for("multi_tenant_clusters_per_sec", "value")
+        assert pol.klass == GATE and pol.direction == DOWN_BAD
+        # explicit opt-out: a dryrun ok-flag is not a measurement
+        assert policy_for("multichip_dryrun", "value").klass == OBSERVE
+        # a FUTURE mode's headline is born gated (default-gate fallback)
+        novel = policy_for("brand_new_mode_p50_ms", "value")
+        assert novel.klass == GATE and novel.direction == UP_BAD
+        novel_tp = policy_for("brand_new_mode_steps_per_sec", "value")
+        assert novel_tp.klass == GATE and novel_tp.direction == DOWN_BAD
+        # representative non-headline keys from real records
+        assert policy_for("m", "steady_state_recompiles").klass == EXACT
+        assert policy_for("m", "recompiles_per_new_tenant").klass == EXACT
+        assert policy_for("m", "fused.loop_device_round_trips").klass == EXACT
+        assert policy_for("m", "chaos.driver_deaths").klass == EXACT
+        for key, direction in [
+            ("phases.encode_ms", UP_BAD),
+            ("spans.totals_ms.fetch", UP_BAD),
+            ("plane_fetch.bytes_moved", UP_BAD),
+            ("h2d_reduction_vs_full", DOWN_BAD),
+            ("speedup_vs_serial_phased", DOWN_BAD),
+            ("shape_class_hit_rate", DOWN_BAD),
+            ("journal_overhead_frac", UP_BAD),
+            ("reason_extraction_dispatches", UP_BAD),
+        ]:
+            pol = policy_for("m", key)
+            assert pol.klass == OBSERVE, key
+            assert pol.direction == direction, key
+
+    def test_dropped_rows_never_baseline(self, tmp_path):
+        hist = _store(tmp_path)
+        _fill(hist, [5.0, 5.1, 5.2])
+        hist.append_bench_record(_rec(value=None, error="boom"),
+                                 run_id="dead", ts=200.0)
+        hist.append_bench_record(_rec(value=5.1), run_id="next", ts=201.0)
+        det = RegressionDetector(min_samples=3)
+        rows = hist.load()
+        base = det.baselines_for(rows, rows[-1])
+        assert len(base) == 3 and all(not r.get("dropped") for r in base)
+
+
+# ---- triage ----
+
+class TestTriage:
+    def test_bundle_anatomy(self, tmp_path):
+        hist = _store(tmp_path)
+        base_census = {"fn": "bench_step", "shape_sig": "256x8/aa",
+                       "compiles": 1, "flops": 1e6}
+        _fill(hist, [5.0, 5.2, 4.9], compile_census=base_census,
+              phases={"encode_ms": 10.0, "compile_ms": 90.0},
+              plane_fetch={"bytes_moved": 2312},
+              trace_id="t-base")
+        hist.append_bench_record(
+            _rec(value=12.0,
+                 compile_census={"fn": "bench_step",
+                                 "shape_sig": "256x8/bb",
+                                 "compiles": 2, "flops": 1e6},
+                 phases={"encode_ms": 25.0, "compile_ms": 91.0},
+                 plane_fetch={"bytes_moved": 9999},
+                 trace_id="t-bad", journal_cursor=17),
+            run_id="bad", ts=300.0)
+        rows = hist.load()
+        det = RegressionDetector(min_samples=3)
+        verdicts = det.check_run(rows, "bad")
+        v = next(x for x in verdicts if x.key == "value"
+                 and x.status == "regressed")
+        bundle = build_bundle(v, rows[-1], det.baselines_for(rows, rows[-1]))
+        assert bundle["kind"] == "perf-regression"
+        assert bundle["verdict"]["baseline_median"] == 5.0
+        assert [w["value"] for w in bundle["baselineWindow"]] == \
+            [5.0, 5.2, 4.9]
+        assert bundle["censusDiff"]["added"] == ["bench_step@256x8/bb"]
+        assert bundle["censusDiff"]["removed"] == ["bench_step@256x8/aa"]
+        assert bundle["phaseDiff"]["phases.encode_ms"]["delta"] == 15.0
+        assert bundle["counterDiff"]["plane_fetch.bytes_moved"][
+            "current"] == 9999
+        assert bundle["traceId"] == "t-bad"
+        assert bundle["journalCursor"] == 17
+        path = write_bundle(bundle, str(tmp_path / "tri"))
+        assert path and json.load(open(path))["metric"] == v.metric
+
+    def test_census_variant_count_drift(self):
+        cur = {"compile_census": [
+            {"fn": "f", "shape_sig": "s", "compiles": 3}]}
+        base = {"compile_census": [
+            {"fn": "f", "shape_sig": "s", "compiles": 1}]}
+        d = census_diff(cur, base)
+        assert d["changed"]["f@s"]["compiles"] == {"baseline": 1,
+                                                  "current": 3}
+
+
+# ---- registry parity (PARITY.md: served identically on both surfaces) --
+
+class TestMetricsParity:
+    def test_families_on_both_exposition_surfaces(self, tmp_path):
+        reg = Registry()
+        register_exposition(reg)
+        try:
+            hist = PerfHistory(str(tmp_path / "hist"), registry=reg)
+            hist.append_bench_record(_rec(value=5.0), run_id="a", ts=1.0)
+            hist.append_bench_record(_rec(value=5.1), run_id="b", ts=2.0)
+            hist.append_bench_record(_rec(value=None, error="x"),
+                                     run_id="c", ts=3.0)
+            hist.append_bench_record(_rec(value=50.0), run_id="d", ts=4.0)
+            det = RegressionDetector(min_samples=2, registry=reg)
+            verdicts = det.check_run(hist.load(), "d")
+            assert gating_regressions(verdicts)
+            # the sidecar's Metricz RPC body is registry exposition text;
+            # /metrics serves expose_all_text — identical families on both
+            metricz = reg.expose_text() + default_registry.expose_text()
+            slash_metrics = expose_all_text()
+            for needle in [
+                # 4 appends: dropped rows still count as observed runs
+                'cluster_autoscaler_bench_runs_total'
+                '{backend="cpu-floor",mode="smoke"} 4',
+                'cluster_autoscaler_perf_regressions_total{',
+                'severity="critical"',
+                'cluster_autoscaler_perf_history_dropped_total'
+                '{reason="null-value',
+            ]:
+                assert needle in metricz, needle
+                assert needle in slash_metrics, needle
+        finally:
+            unregister_exposition(reg)
+
+
+# ---- CLI ----
+
+class TestCli:
+    def test_log_gate_exit_codes(self, tmp_path, capsys):
+        hist_dir = str(tmp_path / "h")
+        lines = tmp_path / "lines.jsonl"
+        lines.write_text(
+            "\n".join(json.dumps(_rec(value=v)) for v in (5.0, 5.1)) + "\n")
+        assert cli.main(["log", "--history", hist_dir, "--run-id", "a",
+                         str(lines)]) == 0
+        assert cli.main(["log", "--history", hist_dir, "--run-id", "b",
+                         str(lines)]) == 0
+        ok = tmp_path / "ok.jsonl"
+        ok.write_text(json.dumps(_rec(value=5.05)) + "\n")
+        assert cli.main(["log", "--history", hist_dir, "--run-id", "c",
+                         str(ok)]) == 0
+        assert cli.main(["gate", "--history", hist_dir,
+                         "--min-samples", "2"]) == 0
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(json.dumps(_rec(value=40.0)) + "\n")
+        assert cli.main(["log", "--history", hist_dir, "--run-id", "d",
+                         str(bad)]) == 0
+        bundles = str(tmp_path / "tri")
+        report = str(tmp_path / "report.md")
+        assert cli.main(["gate", "--history", hist_dir,
+                         "--min-samples", "2", "--bundle-dir", bundles,
+                         "--report", report]) == 2
+        assert os.listdir(bundles)
+        assert "regressed" in open(report).read()
+        # advisory mode reports but never fails the build
+        assert cli.main(["gate", "--history", hist_dir,
+                         "--min-samples", "2", "--advisory"]) == 0
+        assert cli.main(["check", "--history", hist_dir,
+                         "--min-samples", "2"]) == 0
+        # tampering is exit 3, distinct from a regression's exit 2
+        store_file = PerfHistory(hist_dir).files()[0]
+        body = open(store_file).read().replace('"value":40.0',
+                                               '"value":4.0')
+        open(store_file, "w").write(body)
+        assert cli.main(["gate", "--history", hist_dir]) == 3
+        capsys.readouterr()
+
+    def test_seed_migration_of_repo_evidence(self, tmp_path, capsys):
+        files = sorted(
+            os.path.join(REPO, f) for f in os.listdir(REPO)
+            if f.startswith(("BENCH_r0", "MULTICHIP_r0"))
+            and f.endswith(".json"))
+        assert len(files) == 10, "seed evidence files moved?"
+        hist_dir = str(tmp_path / "seed")
+        assert cli.main(["seed", "--history", hist_dir, *files]) == 0
+        hist = PerfHistory(hist_dir)
+        st = hist.stats()
+        assert st["rows"] == 10
+        # BENCH_r02's 5.48ms is the ONLY tpu anchor; tunnel-failure
+        # rounds are dropped rows, never baselines
+        tpu = hist.rows(metric="scaleup_sim_p50_ms_50kpods_5knodes_20ng",
+                        lineage="tpu")
+        assert len(tpu) == 1
+        assert tpu[0]["metrics"]["value"] == pytest.approx(5.481)
+        assert st["dropped_rows"] == 4
+        assert st["lineages"] == {"tpu": 1, "dryrun-8dev": 5}
+        # the committed store matches what seeding produces
+        committed = os.path.join(REPO, "perf_history")
+        if os.path.isdir(committed):
+            crows = PerfHistory(committed).load(verify=True)
+            assert len(crows) == 10
+        capsys.readouterr()
+
+
+# ---- bench.py integration surface ----
+
+class TestBenchWiring:
+    def test_schema_version_matches_bench(self):
+        import bench
+
+        assert bench.SCHEMA_VERSION == SCHEMA_VERSION
+
+    def test_metric_tee_stamps_and_captures(self):
+        import io
+
+        import bench
+
+        out = io.StringIO()
+        tee = bench._MetricTee(out, stamp={"schema_version": SCHEMA_VERSION,
+                                           "run_id": "RID"})
+        tee.write('{"metric": "m", "value": 1.0}\n')
+        tee.write("[bench] progress line\n")
+        tee.write('not json {"metric"\n')
+        got = out.getvalue().splitlines()
+        stamped = json.loads(got[0])
+        assert stamped["run_id"] == "RID"
+        assert stamped["schema_version"] == SCHEMA_VERSION
+        assert got[1] == "[bench] progress line"
+        assert tee.detach()["m"]["value"] == 1.0
+        # an already-stamped line (the floor child's) is not restamped
+        out2 = io.StringIO()
+        tee2 = bench._MetricTee(out2, stamp={"run_id": "PARENT"})
+        tee2.write('{"metric": "m", "value": 2.0, "run_id": "CHILD"}\n')
+        assert json.loads(out2.getvalue())["run_id"] == "CHILD"
+
+    def test_floor_child_forwards_history(self):
+        import inspect
+
+        import bench
+
+        src = inspect.getsource(bench.run_floor_child)
+        assert '"--history"' in src  # degraded rounds bank their rows too
+
+    def test_run_id_env_propagation(self, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("KA_BENCH_RUN_ID", "outer-run")
+        assert bench.bench_run_id() == "outer-run"
+        monkeypatch.delenv("KA_BENCH_RUN_ID")
+        rid = bench.bench_run_id()
+        assert rid and os.environ.get("KA_BENCH_RUN_ID") == rid
+        monkeypatch.delenv("KA_BENCH_RUN_ID", raising=False)
