@@ -242,6 +242,10 @@ def run_floor_child(metric: str, args) -> int:
         # same contract: the delta-vs-full churn evidence survives a dead
         # tunnel on the CPU floor
         cmd += ["--world-store"]
+    if getattr(args, "lineage", ""):
+        # the lineage ring + offline index are host dict work over the
+        # journal — the provenance evidence degrades WITH the floor
+        cmd += ["--lineage", args.lineage]
     if args.chaos_local:
         # the control-loop chaos schedule is host-side orchestration — it
         # degrades WITH the floor instead of vanishing from the evidence
@@ -568,6 +572,15 @@ def main() -> None:
                          "drift report (never-null on the CPU floor — "
                          "journaling and replay are host-side; "
                          "docs/REPLAY.md)")
+    ap.add_argument("--lineage", default="", metavar="DIR",
+                    help="run a lineage_smoke phase: record the shared "
+                         "journaled story world under DIR with the live "
+                         "lineage ring on, report the ring's steady-loop "
+                         "overhead fraction, the offline LineageIndex "
+                         "build rate and why/timeline/diff query p50s, "
+                         "and verify the index reconstructs the injected "
+                         "refusal→scale-up→resolution story "
+                         "(docs/LINEAGE.md)")
     ap.add_argument("--fused", action="store_true",
                     help="fused single-dispatch loop smoke (ISSUE 17 / "
                          "docs/FUSED_LOOP.md): drive twin worlds through "
@@ -595,7 +608,8 @@ def main() -> None:
     ap.add_argument("--all", action="store_true",
                     help="run every never-null bench mode in this one "
                          "process (fused, whatif, world-store, journal, "
-                         "chaos-local, device-stats, shadow-audit) and "
+                         "lineage, chaos-local, device-stats, shadow-audit) "
+                         "and "
                          "emit a single combined JSON line at the end — "
                          "one cooperating TPU-tunnel window banks real-TPU "
                          "numbers for every mode")
@@ -630,6 +644,12 @@ def main() -> None:
             import tempfile
 
             args.journal = tempfile.mkdtemp(prefix="bench-all-journal-")
+        if not args.lineage:
+            import tempfile
+
+            # own dir: --journal wipes and replays ITS dir; the lineage
+            # story must index an undisturbed recording
+            args.lineage = tempfile.mkdtemp(prefix="bench-all-lineage-")
 
     if args.require_tpu and (args.smoke or args.floor_for):
         # --smoke IS an explicit CPU run — combining it with --require-tpu
@@ -1283,6 +1303,19 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
                 "error": f"{type(e).__name__}: {e}",
             }), flush=True)
 
+    if getattr(args, "lineage", ""):
+        try:
+            with_timeout(lambda: bench_lineage(args), seconds=600)()
+        except Exception as e:
+            print(f"[bench] lineage phase failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "lineage_smoke", "value": None,
+                "unit": "percent_overhead",
+                "error": f"{type(e).__name__}: {e}",
+            }), flush=True)
+
     if args.trace:
         try:
             with_timeout(lambda: bench_trace(args, args.trace), seconds=600)()
@@ -1294,6 +1327,7 @@ def run_bench(args, metric: str, budget: InitBudget | None = None) -> None:
 
     if args.scaledown or args.e2e or args.trace or args.tenants \
             or args.journal or args.world_store \
+            or getattr(args, "lineage", "") \
             or getattr(args, "chaos_local", False) \
             or getattr(args, "device_stats", False) \
             or getattr(args, "shadow_audit", False) \
@@ -2961,30 +2995,26 @@ def bench_chaos_local(args) -> None:
     }), flush=True)
 
 
-def bench_journal(args) -> None:
-    """--journal DIR: the record→replay round trip as bench-evidenced
-    contract. Records a short RunOnce sequence (mixed deltas: pod churn, a
-    taint flip, a node add, an unfittable burst that fires real scale-up)
-    into a flight journal, measures journaling overhead against steady loop
-    walltime (the ≤2% acceptance bound CI asserts), then replays the
-    journal in-process and reports the drift — zero on a healthy build.
-    Everything here is host-side, so the numbers exist on the CPU floor."""
-    import numpy as np
-
+def _journal_story_run(args, jdir: str) -> dict:
+    """The shared 8-loop journaled story world (--journal and --lineage
+    both drive it): pod churn every loop, a taint flip at loop 2, an
+    unfittable burst at loop 3 that fires real scale-up, burst removal at
+    loop 5. Runs the loops with the journal (and the live lineage ring)
+    on and returns the autoscaler plus per-loop walltime and overhead
+    samples — the two modes measure different numerators over the same
+    denominator."""
     from kubernetes_autoscaler_tpu.config.options import (
         AutoscalingOptions,
         NodeGroupDefaults,
     )
     from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
     from kubernetes_autoscaler_tpu.models.api import Node, Taint
-    from kubernetes_autoscaler_tpu.replay.harness import replay_journal
     from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
     from kubernetes_autoscaler_tpu.utils.testing import (
         build_test_node,
         build_test_pod,
     )
 
-    jdir = args.journal
     os.makedirs(jdir, exist_ok=True)
     for f in os.listdir(jdir):   # stale records would replay another world
         if f.startswith("journal-") and f.endswith(".jsonl"):
@@ -3020,7 +3050,8 @@ def bench_journal(args) -> None:
     a = StaticAutoscaler(fake.provider, fake, options=opts,
                          eviction_sink=fake, walltime=lambda: holder["now"])
     seq = 0
-    loop_ms, journal_ms = [], []
+    caps: dict[str, int] = {}
+    loop_ms, journal_ms, lineage_ms = [], [], []
     for k in range(loops):
         # mixed deltas: churn replaces objects (the replace-on-update
         # contract the incremental encoder and the journal both ride)
@@ -3037,20 +3068,54 @@ def bench_journal(args) -> None:
                 capacity=dict(old.capacity),
                 allocatable=dict(old.allocatable),
                 taints=[Taint("bench/flip", "1", "NoSchedule")], ready=True)
-        if k == 3:   # unfittable burst → real scale-up → node-add churn
+            # pin every group at its current target: the burst below must
+            # be REFUSED (capped-by-limits) for two loops before scale-up
+            # can help — the open-then-resolved refusal is the causal
+            # chain the lineage story reconstructs
+            for g in fake.provider.node_groups():
+                caps[g.id()] = g._max
+                g._max = g.target_size()
+        if k == 3:   # unfittable burst: refused while capped (k=3), then
+            # real scale-up once uncapped (k=4) → node-add churn
             for j in range(6):
                 fake.add_pod(build_test_pod(
                     f"burst{j}", cpu_milli=7000, mem_mib=4096,
                     owner_name="burst-rs"))
+        if k == 4:   # lift the cap: scale-up fires and the refusal resolves
+            for g in fake.provider.node_groups():
+                g._max = caps[g.id()]
         if k == 5:
             for j in range(6):
                 fake.remove_pod(f"burst{j}")
         holder["now"] = 1000.0 + 10.0 * k
         j0 = a.journal.overhead_ns
+        l0 = a.lineage_ring.overhead_ns if a.lineage_ring is not None else 0
         t0 = time.perf_counter()
         a.run_once(now=holder["now"])
         loop_ms.append((time.perf_counter() - t0) * 1000.0)
         journal_ms.append((a.journal.overhead_ns - j0) / 1e6)
+        lineage_ms.append(
+            (a.lineage_ring.overhead_ns - l0) / 1e6
+            if a.lineage_ring is not None else 0.0)
+    return {"autoscaler": a, "loops": loops, "loop_ms": loop_ms,
+            "journal_ms": journal_ms, "lineage_ms": lineage_ms}
+
+
+def bench_journal(args) -> None:
+    """--journal DIR: the record→replay round trip as bench-evidenced
+    contract. Records the shared story world (`_journal_story_run`) into
+    a flight journal, measures journaling overhead against steady loop
+    walltime (the ≤2% acceptance bound CI asserts), then replays the
+    journal in-process and reports the drift — zero on a healthy build.
+    Everything here is host-side, so the numbers exist on the CPU floor."""
+    import numpy as np
+
+    from kubernetes_autoscaler_tpu.replay.harness import replay_journal
+
+    jdir = args.journal
+    r = _journal_story_run(args, jdir)
+    a, loops = r["autoscaler"], r["loops"]
+    loop_ms, journal_ms = r["loop_ms"], r["journal_ms"]
     # steady-state overhead: the cold loop pays compiles in the denominator
     # and first-snapshot serialization in the numerator — exclude both
     steady_loop = sum(loop_ms[1:])
@@ -3082,6 +3147,94 @@ def bench_journal(args) -> None:
             "replay_ms": round(replay_ms, 1),
             "backend": report["backend"],
         },
+    }), flush=True)
+
+
+def bench_lineage(args) -> None:
+    """--lineage DIR: the decision-lineage engine as bench-evidenced
+    contract (lineage/; docs/LINEAGE.md). Drives the shared journaled
+    story world with the live ring on, measures the ring's steady-loop
+    overhead fraction (the ≤1% bound CI asserts), then builds the
+    OFFLINE LineageIndex over the journal dir and reports the index
+    build rate plus why/timeline/diff query p50s — and proves the index
+    reconstructs the injected story (burst refused → scale-up won →
+    resolved) from the journal alone. Host-side end to end: the numbers
+    exist on the CPU floor."""
+    import numpy as np
+
+    from kubernetes_autoscaler_tpu.lineage.index import LineageIndex
+
+    jdir = args.lineage
+    r = _journal_story_run(args, jdir)
+    a, loops = r["autoscaler"], r["loops"]
+    loop_ms, lineage_ms = r["loop_ms"], r["lineage_ms"]
+    # same steady-state convention as --journal: the cold loop pays
+    # compiles in the denominator — exclude loop 0 from both sides
+    steady_loop = sum(loop_ms[1:])
+    steady_ring = sum(lineage_ms[1:])
+    frac = steady_ring / steady_loop if steady_loop > 0 else 0.0
+
+    t0 = time.perf_counter()
+    idx = LineageIndex(jdir)
+    build_s = time.perf_counter() - t0
+    stats = idx.stats()
+    build_rate = stats["records"] / build_s if build_s > 0 else 0.0
+
+    # query p50s over the story's own objects (offline index, cold cache)
+    keys = list(idx.objects) or [("node", "n0")]
+    last = idx.last_loop if idx.last_loop is not None else 0
+
+    def _p50(call, reps=32):
+        samples = []
+        for i in range(reps):
+            q0 = time.perf_counter()
+            call(i)
+            samples.append((time.perf_counter() - q0) * 1000.0)
+        return round(float(np.percentile(samples, 50)), 4)
+
+    why_p50 = _p50(lambda i: idx.why(*keys[i % len(keys)]))
+    timeline_p50 = _p50(lambda i: idx.timeline(None, None))
+    diff_p50 = _p50(lambda i: idx.diff(max(last - (i % loops), 1)))
+
+    # the story contract: the index alone must yield the causal chain the
+    # world injected — a refused pod-group, the winning scale-up, and the
+    # refusal resolving after it
+    story = {"refusedGroup": None, "wonGroup": None, "resolved": False,
+             "resolvedAfterScaleUp": False}
+    for (kind, name), obj in idx.objects.items():
+        for e in obj["entries"]:
+            ev = e.get("event")
+            if kind == "pod-group" and ev == "refused" \
+                    and story["refusedGroup"] is None:
+                story["refusedGroup"] = name
+            if kind == "pod-group" and ev == "resolved":
+                story["resolved"] = True
+                if e.get("afterScaleUp"):
+                    story["resolvedAfterScaleUp"] = True
+            if kind == "nodegroup" and ev == "scale-up" and e.get("won"):
+                story["wonGroup"] = name
+    story_ok = bool(story["refusedGroup"] and story["wonGroup"]
+                    and story["resolved"])
+
+    print(json.dumps({
+        "metric": "lineage_smoke",
+        "value": round(frac * 100.0, 4),
+        "unit": "percent_overhead",
+        "backend": "host",   # the ring and index are host dict work
+        "loops": loops,
+        "lineage_overhead_ms": round(steady_ring, 3),
+        "lineage_overhead_frac": round(frac, 5),
+        "loop_p50_ms": round(float(np.percentile(loop_ms[1:], 50)), 3),
+        "index_build_ms": round(build_s * 1000.0, 3),
+        "index_build_records_per_s": round(build_rate, 1),
+        "query_p50_ms": {"why": why_p50, "timeline": timeline_p50,
+                         "diff": diff_p50},
+        "index": stats,
+        "ring": a.lineage_ring.stats() if a.lineage_ring is not None
+        else None,
+        "story": story,
+        "story_ok": story_ok,
+        "journal_dir": jdir,
     }), flush=True)
 
 

@@ -96,6 +96,37 @@ def make_mux(autoscaler: StaticAutoscaler, snapshotter: DebuggingSnapshotter):
                 payload = handle.wait(timeout=120.0)
                 self._send(200 if payload else 504, payload or "timed out",
                            "application/json")
+            elif self.path == "/whyz" or self.path.startswith("/whyz?"):
+                # decision lineage (lineage/index.py, docs/LINEAGE.md):
+                #   /whyz                 → per-object summary digest
+                #   /whyz?object=K/NAME   → that object's causal chain
+                #   /whyz?loop=K          → object-level diff across K
+                ring = autoscaler.lineage_ring
+                if ring is None:
+                    self._send(404, "lineage ring disabled "
+                                    "(--lineage-ring=false)")
+                    return
+                from urllib.parse import parse_qs, urlparse
+
+                from kubernetes_autoscaler_tpu.lineage import query as lq
+                qs = parse_qs(urlparse(self.path).query)
+                if qs.get("object"):
+                    kind, name = lq.parse_object(qs["object"][0])
+                    body = ring.why(kind, name, surface="whyz")
+                    body["segments"] = lq.coalesce_segments(
+                        body.get("entries") or [])
+                elif qs.get("loop"):
+                    try:
+                        body = ring.diff(int(qs["loop"][0]),
+                                         surface="whyz")
+                    except ValueError:
+                        self._send(400, "loop must be an integer")
+                        return
+                else:
+                    body = ring.snapshot_summary(surface="whyz")
+                self._send(200, json.dumps(body, indent=2, sort_keys=True,
+                                           default=str),
+                           "application/json")
             elif self.path == "/profilez":
                 # --profiling consumer (reference: net/http/pprof behind
                 # --profiling, main.go:264-266): per-phase wall-time stats

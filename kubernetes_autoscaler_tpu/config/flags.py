@@ -190,6 +190,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--journal-max-mb", type=float, default=64.0,
                    help="size bound for the retained journal; older files "
                         "rotate out with drop accounting")
+    p.add_argument("--lineage-ring", type=_bool, default=True,
+                   help="live decision-lineage ring: the bounded "
+                        "per-object provenance view served on /whyz, "
+                        "/snapshotz and the sidecar Explain RPC "
+                        "(lineage/index.py; pure observer)")
+    p.add_argument("--lineage-ring-objects", type=int, default=512,
+                   help="objects the lineage ring retains (LRU)")
+    p.add_argument("--lineage-ring-loops", type=int, default=128,
+                   help="loop rows the lineage ring retains")
 
     # backend supervisor / degraded-mode control loop (core/supervisor.py;
     # no reference analog — the Go autoscaler has no accelerator to lose)
@@ -386,6 +395,9 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         loop_wallclock_budget_s=args.loop_wallclock_budget,
         journal_dir=args.journal_dir,
         journal_max_mb=args.journal_max_mb,
+        lineage_ring=args.lineage_ring,
+        lineage_ring_objects=args.lineage_ring_objects,
+        lineage_ring_loops=args.lineage_ring_loops,
         backend_phase_deadline_s=args.backend_phase_deadline,
         backend_probe_deadline_s=args.backend_probe_deadline,
         backend_suspect_threshold=args.backend_suspect_threshold,

@@ -150,6 +150,13 @@ class AutoscalingOptions:
     journal_dir: str = ""                          # --journal-dir
     # size bound for the RETAINED journal (rotation + drop accounting)
     journal_max_mb: float = 64.0                   # --journal-max-mb
+    # live decision-lineage ring (lineage/index.py): the bounded per-object
+    # provenance view served on /whyz, /snapshotz and the sidecar Explain
+    # RPC. Pure observer — fed once per loop from dicts RunOnce already
+    # computed, zero extra device dispatches; False removes even that.
+    lineage_ring: bool = True                      # --lineage-ring
+    lineage_ring_objects: int = 512                # --lineage-ring-objects
+    lineage_ring_loops: int = 128                  # --lineage-ring-loops
     # backend supervisor (core/supervisor.py): the control loop's
     # healthy → suspect → degraded → recovering ladder. 0 keeps the phase
     # guards inline (no watchdog thread, zero overhead) while exceptions in

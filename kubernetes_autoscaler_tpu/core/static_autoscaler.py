@@ -298,6 +298,18 @@ class StaticAutoscaler:
         self.last_verdict_plane = None
         self.last_verdict_keys = None
         self._journal_cursor: tuple[int, str] | None = None
+        # live decision lineage (lineage/index.py): the bounded per-object
+        # provenance ring served on /whyz + /snapshotz. Fed once per loop
+        # from the SAME collect_outputs dict the journal seals — pure
+        # observer, zero extra device dispatches (--lineage-ring)
+        self.lineage_ring = None
+        if self.options.lineage_ring:
+            from kubernetes_autoscaler_tpu.lineage.index import LineageRing
+
+            self.lineage_ring = LineageRing(
+                objects=self.options.lineage_ring_objects,
+                loops=self.options.lineage_ring_loops,
+                registry=self.metrics, event_sink=self.event_sink)
         self._async_group_of: dict[str, str] = {}
         self.actuator = Actuator(provider, self.options, eviction_sink,
                                  pdb_tracker=self.pdb_tracker,
@@ -1111,6 +1123,7 @@ class StaticAutoscaler:
             # device verdicts against the host oracle, AFTER the journal
             # commit (the bundle names this loop's cursor) and BEFORE
             # supervisor.end_loop (a divergent loop must not read as clean)
+            lineage_audit = None
             if self.shadow_auditor is not None:
                 tr = trace.current_tracer()
                 # audit-only fetches are observability overhead, not part of
@@ -1125,6 +1138,12 @@ class StaticAutoscaler:
                     status.audit_bundle_path = rep.get("bundlePath", "")
                     if status.audit_bundle_path:
                         self.last_audit_bundle = status.audit_bundle_path
+                    lineage_audit = {
+                        "bundlePath": rep.get("bundlePath", ""),
+                        "traceId": tr.trace_id if tr else "",
+                        "persistent": rep["persistent"],
+                        "surfaces": sorted(
+                            {d["surface"] for d in rep["divergences"]})}
                     # the ladder: healthy→suspect on first divergence,
                     # →degraded when the post-heal re-audit diverged again
                     self.supervisor.audit_divergence(
@@ -1136,6 +1155,25 @@ class StaticAutoscaler:
                     # harvested against the healed planes next loop, even
                     # if the heal re-uploads value-identical buffers
                     self._discard_speculation("audit-divergence")
+
+            # live lineage feed: the same outputs surface the journal
+            # seals (reused when the journal already collected it — one
+            # collect per loop either way), metered inside observe()
+            if self.lineage_ring is not None:
+                louts = outputs if self.journal is not None else \
+                    self._journal_mod.collect_outputs(self, status)
+                cur = self._journal_cursor
+                self.lineage_ring.observe(
+                    loop=cur[0] if cur is not None else None,
+                    digest=cur[1] if cur is not None else "",
+                    now=now, outputs=louts,
+                    annotations={
+                        "fusedMode": status.fused_mode,
+                        "loopDeviceRoundTrips":
+                            status.loop_device_round_trips,
+                    },
+                    audit=lineage_audit,
+                    backend_state=self.supervisor.state)
 
             if self.debugging_snapshotter is not None:
                 if self.debugging_snapshotter.is_data_collection_allowed():
@@ -1455,6 +1493,10 @@ class StaticAutoscaler:
             # re-audit, the last evidence bundle (docs/OBSERVABILITY.md)
             **({"audit": self.shadow_auditor.snapshot_payload()}
                if self.shadow_auditor is not None else {}),
+            # lineage section: the live ring's per-object digest (the
+            # same store /whyz serves — docs/LINEAGE.md)
+            **({"lineage": self.lineage_ring.snapshot_summary()}
+               if self.lineage_ring is not None else {}),
         })
         if tracer is not None:
             dbg.set_trace_id(tracer.trace_id)

@@ -22,9 +22,13 @@ scale-down planner. Emission is
   * bounded in memory by `capacity` (oldest evicted first).
 
 Counters ride an attached metrics.Registry: `scale_events_total{kind,reason}`
-and `scale_events_dropped_total`. The stored ring is exported by
-`snapshot()` into `/snapshotz` payloads so a flight-recorder investigation
-sees the same verdicts the events carried.
+and `scale_events_dropped_total`. Dedup-aggregated repeats ALSO increment
+`scale_events_total` — the stored events' count aggregates and the counter
+deltas describe the same stream (pinned by test), so lineage can trust
+either. The stored ring is exported by `snapshot()` into `/snapshotz`
+payloads so a flight-recorder investigation sees the same verdicts the
+events carried, and `history(kind, obj)` serves the per-object view the
+lineage join reads without scanning the whole ring.
 """
 
 from __future__ import annotations
@@ -65,15 +69,21 @@ class EventSink:
     per_loop_quota: int = 20
     dedup_window_s: float = 600.0
     capacity: int = 512
+    history_objects: int = 256          # (kind, obj) keys in the side index
     registry: object | None = None      # optional metrics.Registry
     events: "OrderedDict[tuple, Event]" = field(default_factory=OrderedDict)
     dropped: int = 0
     deduped: int = 0
     emitted: int = 0
     _quota: klogx.LoggingQuota = field(init=False)
+    # (kind, obj) -> {reason: Event} — the same Event objects the ring
+    # holds, so a dedup count bump is visible here for free; LRU-bounded
+    # by key count, pruned when the ring evicts
+    _by_obj: "OrderedDict[tuple, OrderedDict]" = field(init=False)
 
     def __post_init__(self):
         self._quota = klogx.LoggingQuota(self.per_loop_quota)
+        self._by_obj = OrderedDict()
 
     # ---- loop framing (RunOnce calls both) ----
 
@@ -91,13 +101,19 @@ class EventSink:
         key = (kind, obj, reason)
         ev = self.events.get(key)
         if ev is not None and now - ev.last_ts <= self.dedup_window_s:
-            # aggregation: same verdict again — count it, keep one event
+            # aggregation: same verdict again — count it, keep one event.
+            # The counter still moves: the stored count aggregate and the
+            # scale_events_total delta must describe the same stream.
             ev.count += 1
             ev.last_ts = now
             if message:
                 ev.message = message
             self.deduped += 1
             self.events.move_to_end(key)
+            if self.registry is not None:
+                self.registry.counter("scale_events_total",
+                                      help=_EVENTS_HELP).inc(kind=kind,
+                                                             reason=reason)
             return
         klogx.v(self._quota, "%s %s: %s%s", kind, obj, reason,
                 f" ({message})" if message else "")
@@ -109,16 +125,55 @@ class EventSink:
                 self.registry.counter("scale_events_dropped_total",
                                       help=_DROPPED_HELP).inc()
             return
-        self.events[key] = Event(kind=kind, obj=obj, reason=reason,
-                                 message=message, first_ts=now, last_ts=now)
+        stored = Event(kind=kind, obj=obj, reason=reason,
+                       message=message, first_ts=now, last_ts=now)
+        self.events[key] = stored
         self.events.move_to_end(key)
         while len(self.events) > self.capacity:
-            self.events.popitem(last=False)
+            old_key, _ = self.events.popitem(last=False)
+            self._unindex(old_key)
+        self._index(key, stored)
         self.emitted += 1
         if self.registry is not None:
             self.registry.counter("scale_events_total",
                                   help=_EVENTS_HELP).inc(kind=kind,
                                                          reason=reason)
+
+    # ---- per-object side index ----
+
+    def _index(self, key: tuple, ev: Event) -> None:
+        okey = (key[0], key[1])
+        bucket = self._by_obj.get(okey)
+        if bucket is None:
+            bucket = OrderedDict()
+            self._by_obj[okey] = bucket
+            while len(self._by_obj) > self.history_objects:
+                self._by_obj.popitem(last=False)
+        bucket[key[2]] = ev
+        self._by_obj.move_to_end(okey)
+
+    def _unindex(self, key: tuple) -> None:
+        okey = (key[0], key[1])
+        bucket = self._by_obj.get(okey)
+        if bucket is not None:
+            bucket.pop(key[2], None)
+            if not bucket:
+                del self._by_obj[okey]
+
+    def history(self, kind: str | None, obj: str) -> list[dict]:
+        """The bounded per-object view lineage joins at query time — O(its
+        own reasons), never O(ring). kind=None merges both kinds for the
+        object name (lineage's node kind sees NoScaleDown; pod-group sees
+        NoScaleUp)."""
+        keys = [(kind, obj)] if kind is not None else \
+            [(NO_SCALE_UP, obj), (NO_SCALE_DOWN, obj)]
+        out: list[dict] = []
+        for okey in keys:
+            bucket = self._by_obj.get(okey)
+            if bucket:
+                out.extend(ev.to_dict() for ev in bucket.values())
+        out.sort(key=lambda d: d["lastTimestamp"])
+        return out
 
     # ---- export ----
 

@@ -255,6 +255,32 @@ PERFWATCH_FAMILIES = {
         "written per confirmed regression"),
 }
 
+# The reference explains decisions through scattered events + status
+# configmap prose; there is no queryable, object-centric provenance store
+# and therefore no metrics for one. The lineage engine (lineage/;
+# docs/LINEAGE.md) adds the join over every cursor-stamped evidence store,
+# and these families account for it. PARITY.md carries the same table;
+# all ride the normal Registry path, served identically by /metrics and
+# Metricz.
+LINEAGE_FAMILIES = {
+    # absent reference surface -> our provenance accounting
+    "(no decision provenance store)": (
+        "lineage_index_rows + lineage_index_bytes — the live ring's "
+        "bounded per-object entry count and approximate retained bytes "
+        "(LRU-evicted objects and middle-dropped entries are counted in "
+        "the /whyz stats payload, never silently lost)"),
+    "(no provenance freshness signal)": (
+        "lineage_index_lag_loops — loops between the journal cursor and "
+        "the lineage head; nonzero means observes were skipped (aborted "
+        "loops) and a why answer may trail the cluster"),
+    "(no explanation query accounting)": (
+        "lineage_queries_total{surface} + "
+        "lineage_overhead_seconds_total — why/timeline/diff queries by "
+        "serving surface (whyz / snapshotz / explain / api) and the "
+        "metered per-loop cost of feeding the ring (CI-bounded ≤1% like "
+        "the shadow audit)"),
+}
+
 # The reference UnremovableReason enum values our planner actually produces,
 # value-for-value (simulator/cluster.go:63-103). A dashboard filtering the
 # reference's unremovable_nodes_count{reason=...} re-points unchanged.

@@ -54,7 +54,8 @@ def _journal_files(path: str) -> list[str]:
     return files
 
 
-def load_journal(path: str) -> tuple[dict, list[dict], list[dict]]:
+def load_journal(path: str, run: str | None = None
+                 ) -> tuple[dict, list[dict], list[dict]]:
     """→ (meta, records, problems). Seals are recomputed for every record;
     a mismatch is fatal (the file is corrupt, not merely drifted). Parent
     chain breaks (rotation pruning) are collected as problems.
@@ -66,9 +67,13 @@ def load_journal(path: str) -> tuple[dict, list[dict], list[dict]]:
     oldest-first, with drop accounting). Stitching runs into one stream
     would replay run 2
     under run 1's accumulated cross-loop state (timers, backoffs) the
-    recorder never had, reporting spurious drift — so only the LAST run is
-    replayed; earlier runs are surfaced as a `previous-runs` problem, and
-    `meta` is the meta line governing the replayed run."""
+    recorder never had, reporting spurious drift — so only ONE run is
+    loaded. `run` selects it by chain head: a digest prefix of any run's
+    FIRST record (the heads the `previous-runs` problem lists); None keeps
+    the historical default, the LAST run. The other runs are surfaced as a
+    `previous-runs` problem either way (count/loops plus a per-run `runs`
+    list of head digests and loop ranges), and `meta` is the meta line
+    governing the loaded run."""
     runs: list[tuple[dict, list[dict], list[dict]]] = []
     meta: dict = {}
     records: list[dict] = []
@@ -124,12 +129,36 @@ def load_journal(path: str) -> tuple[dict, list[dict], list[dict]]:
             records.append(rec)
     if not records:
         raise JournalError(f"journal at {path!r} holds no records")
+    runs.append((meta, records, problems))
+    if run is not None:
+        matches = [r for r in runs
+                   if r[1] and r[1][0].get("digest", "").startswith(run)]
+        if not matches:
+            heads = [r[1][0].get("digest", "")[:16] for r in runs if r[1]]
+            raise JournalError(
+                f"no run with chain head {run!r} in {path!r} "
+                f"(heads: {', '.join(heads) or 'none'})")
+        if len(matches) > 1:
+            raise JournalError(
+                f"chain-head prefix {run!r} is ambiguous in {path!r}")
+        meta, records, problems = matches[0]
+    else:
+        meta, records, problems = runs[-1]
     if records[0].get("kind") != "snapshot":
         raise JournalError("journal starts with a delta record (its "
                            "snapshot base was pruned past keep_files?)")
-    if runs:
-        problems.append({"kind": "previous-runs", "count": len(runs),
-                         "loops": sum(len(r[1]) for r in runs)})
+    others = [r for r in runs if r[1] is not records]
+    if others:
+        problems.append({
+            "kind": "previous-runs", "count": len(others),
+            "loops": sum(len(r[1]) for r in others),
+            # selectable chain heads for load_journal(run=...) / the
+            # lineage CLI's --run
+            "runs": [{"head": r[1][0].get("digest", ""),
+                      "firstLoop": r[1][0].get("loop"),
+                      "lastLoop": r[1][-1].get("loop"),
+                      "records": len(r[1])} for r in others],
+        })
     return meta, records, problems
 
 

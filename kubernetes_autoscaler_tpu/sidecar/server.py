@@ -2384,6 +2384,47 @@ class SimulatorService:
 
         return self.registry.expose_text() + default_registry.expose_text()
 
+    # ---- rpc: Explain ----
+
+    def explain(self, payload: bytes = b"", tenant: str = "") -> dict:
+        """The sidecar's per-tenant lineage surface (docs/LINEAGE.md): the
+        tenant's TenantJournal ring — every ApplyDelta and sim verdict
+        digest, chained — returned ROW-FOR-ROW (the Metricz ≡ /metrics
+        parity contract: tests pin Explain records == ts.journal.snapshot()
+        exactly). Optional body `{"kinds": [...], "limit": N}` filters by
+        record kind / keeps the newest N rows, with `held`/`returned`
+        accounting so a filtered reply never masquerades as the full ring.
+        Pure read under the ring's own lock — no dispatch, no encode."""
+        req = {}
+        if payload:
+            try:
+                req = json.loads(payload.decode() or "{}")
+            except ValueError:
+                return {"error": "malformed Explain body (want JSON)"}
+        self.registry.counter(
+            "lineage_queries_total",
+            help="Lineage queries served, by surface").inc(surface="explain")
+        ts = self._tenant_peek(tenant)
+        if ts is None:
+            return {"tenant": tenant or "default", "found": False,
+                    "records": [], "held": 0, "returned": 0}
+        if ts.journal is None:
+            return {"tenant": tenant or "default", "found": True,
+                    "journal": None, "records": [], "held": 0,
+                    "returned": 0}
+        rows = ts.journal.snapshot()
+        held = len(rows)
+        kinds = req.get("kinds")
+        if kinds:
+            rows = [r for r in rows if r.get("kind") in set(kinds)]
+        limit = req.get("limit")
+        if isinstance(limit, int) and limit >= 0:
+            rows = rows[-limit:] if limit else []
+        return {"tenant": tenant or "default", "found": True,
+                "records": rows, "held": held, "returned": len(rows),
+                "cursor": list(ts.journal.cursor() or ()) or None,
+                "stats": ts.journal.stats()}
+
 
 def traced_call(service: SimulatorService, method: str, fn,
                 trace_id: str | None = None, tenant: str = "",
@@ -2596,6 +2637,9 @@ def make_grpc_server(service: SimulatorService, port: int = 50151,
         "Health": grpc.unary_unary_rpc_method_handler(
             _json_method("Health", lambda _b, tenant="": service.health(),
                          False, sample=False),
+            request_deserializer=ident, response_serializer=ident),
+        "Explain": grpc.unary_unary_rpc_method_handler(
+            _json_method("Explain", service.explain, False, sample=False),
             request_deserializer=ident, response_serializer=ident),
         "Metricz": grpc.unary_unary_rpc_method_handler(
             _metricz, request_deserializer=ident, response_serializer=ident),
@@ -2997,6 +3041,17 @@ class SimulatorClient:
 
     def health(self) -> dict:
         return self._call_json("Health", b"")
+
+    def explain(self, kinds=None, limit: int | None = None) -> dict:
+        """This tenant's TenantJournal lineage rows (the Explain RPC;
+        row-for-row the server ring's snapshot unless filtered)."""
+        body = {}
+        if kinds:
+            body["kinds"] = list(kinds)
+        if limit is not None:
+            body["limit"] = int(limit)
+        return self._call_json("Explain",
+                               json.dumps(body).encode() if body else b"")
 
     def metricz(self) -> str:
         """Prometheus text of the sidecar's Registry (rpc counters etc.)."""

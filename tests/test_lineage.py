@@ -1,0 +1,612 @@
+"""Decision lineage engine (ISSUE 20, lineage/): the offline index's
+story reconstruction over a synthetic journal, multi-run selection +
+follow-mode tail pickup, load_journal(run=) regression, the cursor
+stitching fixture (journal + flight dump + audit bundle + perfwatch
+triage bundle all linked to the same loop), the end-to-end provenance
+pin (forced audit divergence → `why node/<victim>` returns the full
+chain from the index alone, reason_extraction_dispatches unchanged),
+the EventSink history view with the dedup≡counter pin, the live /whyz
++ /snapshotz surfaces, and the sidecar Explain RPC's row-for-row
+parity with the TenantJournal ring."""
+
+import json
+import os
+
+import pytest
+
+from kubernetes_autoscaler_tpu.config.options import (
+    AutoscalingOptions,
+    NodeGroupDefaults,
+)
+from kubernetes_autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from kubernetes_autoscaler_tpu.events import EventSink
+from kubernetes_autoscaler_tpu.lineage import query as lq
+from kubernetes_autoscaler_tpu.lineage.__main__ import main as lineage_main
+from kubernetes_autoscaler_tpu.lineage.index import (
+    LineageIndex,
+    entries_from_outputs,
+)
+from kubernetes_autoscaler_tpu.metrics.metrics import Registry
+from kubernetes_autoscaler_tpu.replay import journal as rj
+from kubernetes_autoscaler_tpu.replay.harness import (
+    JournalError,
+    load_journal,
+)
+from kubernetes_autoscaler_tpu.sidecar import faults
+from kubernetes_autoscaler_tpu.utils.fakecluster import FakeCluster
+from kubernetes_autoscaler_tpu.utils.testing import (
+    build_test_node,
+    build_test_pod,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---- synthetic journal helpers -----------------------------------------
+
+def _outputs(pending=0, refused=(), scale_up=None, unremovable=(),
+             drain_fail=(), unneeded=(), deleted=(), scheduled=5):
+    su = None
+    if scale_up:
+        gid, delta = scale_up
+        su = {"scaledUp": True, "increases": {gid: delta}, "errors": {},
+              "podsHelped": delta, "podsRemaining": 0,
+              "best": {"group": gid, "nodes": delta, "pods": 3,
+                       "waste": 0.1, "price": 2.0}}
+    return {
+        "ran": True, "aborted": None,
+        "verdict": {"pending": pending, "groups": 2,
+                    "scheduledHex": (scheduled.to_bytes(4, "little")
+                                     + b"\0\0\0\0").hex()},
+        "scaleUp": su,
+        "reasons": {
+            "noScaleUp": {},
+            "groups": [{"group": i, "exemplarPod": pod, "pods": n,
+                        "reason": reason, "constraints": dict(cons)}
+                       for i, (pod, n, reason, cons) in enumerate(refused)],
+            "unremovable": dict(unremovable),
+            "drainFail": dict(drain_fail),
+        },
+        "drain": {"unneeded": list(unneeded), "deleted": list(deleted)},
+    }
+
+
+def _record(loop, parent, outputs, now=None):
+    rec = {"v": 1, "loop": loop,
+           "kind": "snapshot" if parent == "" else "delta",
+           "parent": parent, "now": now if now is not None else 1000.0 + loop,
+           "config": "cfg", "backend": {"platform": "cpu"},
+           "outputs": outputs, "digests": {}, "worldDigest": "w"}
+    if parent == "":
+        rec["world"] = {}
+    else:
+        rec["delta"] = {}
+    return rj.seal_record(rec)
+
+
+def _write_chain(path, records, meta=True, fname="journal-000000.jsonl"):
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, fname), "w") as f:
+        if meta:
+            f.write(json.dumps({"kind": "meta", "options": {},
+                                "config": "cfg", "backend": {},
+                                "createdLoop": records[0]["loop"]}) + "\n")
+        for r in records:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+
+
+def _story_chain():
+    """The canonical story: p-1 refused for taint at loops 12-13, ng-2
+    scale-up wins at 13, resolved at 14; n2 unneeded then deleted."""
+    r1 = _record(12, "", _outputs(
+        pending=3, refused=[("p-1", 3, "taint", {"taint": 2, "cpu": 3})],
+        unremovable={"n1": "ScaleDownDisabledAnnotation"},
+        unneeded=["n2"]))
+    r2 = _record(13, r1["digest"], _outputs(
+        pending=3, refused=[("p-1", 3, "taint", {"taint": 2})],
+        scale_up=("ng-2", 2)))
+    r3 = _record(14, r2["digest"], _outputs(
+        pending=0, deleted=["n2"], scheduled=8))
+    return [r1, r2, r3]
+
+
+# ---- entries_from_outputs (unit) ---------------------------------------
+
+def test_entries_from_outputs_maps_every_surface():
+    out = _outputs(pending=2,
+                   refused=[("p-0", 4, "multiple-constraints",
+                             {"cpu": 3, "taint": 1})],
+                   scale_up=("ng-1", 2), unremovable={"nA": "BlockedByPod"},
+                   drain_fail={"nB": "pdb"}, unneeded=["nC"],
+                   deleted=["nD"])
+    out["scaleUp"]["errors"] = {"ng-9": "quota"}
+    got = dict(entries_from_outputs(7, out))
+    assert got[("pod-group", "p-0")]["event"] == "refused"
+    assert got[("pod-group", "p-0")]["constraints"] == {"cpu": 3, "taint": 1}
+    assert got[("nodegroup", "ng-1")] == {
+        "loop": 7, "event": "scale-up", "delta": 2, "won": True,
+        "pods": 3, "waste": 0.1, "price": 2.0}
+    assert got[("nodegroup", "ng-9")]["event"] == "scale-up-error"
+    assert got[("node", "nA")] == {"loop": 7, "event": "unremovable",
+                                   "reason": "BlockedByPod"}
+    assert got[("node", "nB")]["event"] == "drain-fail"
+    assert got[("node", "nC")]["event"] == "unneeded"
+    assert got[("node", "nD")]["event"] == "scale-down-deleted"
+
+
+# ---- story reconstruction over a synthetic journal ---------------------
+
+def test_index_reconstructs_refused_then_resolved_story(tmp_path):
+    d = str(tmp_path / "j")
+    _write_chain(d, _story_chain())
+    idx = LineageIndex(d)
+    assert idx.stats()["problems"] == 0
+
+    why = idx.why("pod-group", "p-1")
+    assert why["found"]
+    events = [e["event"] for e in why["entries"]]
+    assert events == ["refused", "refused", "resolved"]
+    assert why["entries"][-1]["pendingSince"] == 12
+    assert why["entries"][-1]["afterScaleUp"] == {"loop": 13, "won": "ng-2"}
+    # the rendered causal chain carries the story in one read
+    text = lq.render_why(why)
+    assert "pending since loop 12" in text
+    assert "taint" in text
+    assert "resolved after loop 13 scale-up won ng-2" in text
+
+    why_n2 = idx.why("node", "n2")
+    assert [e["event"] for e in why_n2["entries"]] == \
+        ["unneeded", "scale-down-deleted"]
+
+    rows = idx.timeline(13, 14)
+    assert [r["loop"] for r in rows] == [13, 14]
+    assert rows[0]["scaleUp"]["won"] == "ng-2"
+
+    diff = idx.diff(14)
+    changed = {e["object"]: e for e in diff["changed"]}
+    assert changed["pod-group/p-1"]["was"]["event"] == "refused"
+    assert changed["pod-group/p-1"]["now"]["event"] == "resolved"
+    appeared = {e["object"]: e for e in diff["appeared"]}
+    assert appeared["node/n2"]["event"] == "scale-down-deleted"
+    assert diff["pendingDelta"] == -3
+
+
+def test_index_tolerates_torn_tail_and_bad_lines(tmp_path):
+    d = str(tmp_path / "j")
+    recs = _story_chain()
+    _write_chain(d, recs)
+    fp = os.path.join(d, "journal-000000.jsonl")
+    with open(fp, "a") as f:
+        f.write("not json at all\n")
+        f.write('{"torn": ')          # no trailing newline
+    idx = LineageIndex(d)
+    # complete records all ingested; the bad line is a problem, not a crash
+    assert idx.stats()["records"] == 3
+    kinds = {p["kind"] for p in idx.problems}
+    assert "bad-line" in kinds
+    # the torn tail is left unconsumed: completing the line ingests it
+    r4 = _record(15, recs[-1]["digest"], _outputs(pending=0))
+    with open(fp, "r+") as f:
+        body = f.read()
+        f.seek(len(body) - len('{"torn": '))
+        f.truncate()
+        f.write(json.dumps(r4, sort_keys=True) + "\n")
+    assert idx.refresh() == 1
+    assert idx.last_loop == 15
+
+
+def test_index_multi_run_selection_and_reset(tmp_path):
+    d = str(tmp_path / "j")
+    run1 = _story_chain()
+    r1b = _record(0, "", _outputs(pending=1, refused=[
+        ("q-1", 1, "cpu", {"cpu": 1})]))
+    r2b = _record(1, r1b["digest"], _outputs(pending=0))
+    _write_chain(d, run1 + [r1b, r2b])
+    # default: the LATEST run only — run 1's objects are gone
+    idx = LineageIndex(d)
+    assert idx.run_head == r1b["digest"]
+    assert not idx.why("pod-group", "p-1")["found"]
+    assert idx.why("pod-group", "q-1")["found"]
+    assert len(idx.runs) == 2
+    # pinning run 1 by chain-head prefix indexes ONLY its chain
+    idx1 = LineageIndex(d, run=run1[0]["digest"][:12])
+    assert idx1.why("pod-group", "p-1")["found"]
+    assert not idx1.why("pod-group", "q-1")["found"]
+
+
+def test_follow_picks_up_record_appended_mid_tail(tmp_path):
+    d = str(tmp_path / "j")
+    recs = _story_chain()
+    _write_chain(d, recs)
+    idx = LineageIndex(d)
+    assert idx.last_loop == 14
+    fp = os.path.join(d, "journal-000000.jsonl")
+    appended = []
+
+    def fake_sleep(_s):
+        # the tail appears WHILE following (the live-writer interleave)
+        if not appended:
+            r4 = _record(15, recs[-1]["digest"],
+                         _outputs(pending=0, unneeded=["n9"]))
+            with open(fp, "a") as f:
+                f.write(json.dumps(r4, sort_keys=True) + "\n")
+            appended.append(r4)
+
+    seen = []
+    arrived = lq.follow(idx, lambda n, i: seen.append((n, i.last_loop)),
+                        poll_s=0, max_wait_s=30.0, until_loop=15,
+                        sleep=fake_sleep)
+    assert arrived
+    assert seen == [(1, 15)]
+    assert idx.why("node", "n9")["found"]
+
+
+def test_lineage_cli_story_and_exit_codes(tmp_path, capsys):
+    d = str(tmp_path / "j")
+    _write_chain(d, _story_chain())
+    assert lineage_main([d, "why", "pod-group/p-1"]) == 0
+    out = capsys.readouterr().out
+    assert "pending since loop 12" in out
+    assert lineage_main([d, "--json", "timeline", "--loops", "12..13"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert [r["loop"] for r in rows] == [12, 13]
+    assert lineage_main([d, "why", "node/absent"]) == 1
+    capsys.readouterr()
+    assert lineage_main([d, "runs"]) == 0
+    assert lineage_main([d, "stats"]) == 0
+
+
+# ---- load_journal(run=) regression (satellite) -------------------------
+
+def test_load_journal_run_selection(tmp_path):
+    d = str(tmp_path / "j")
+    run1 = _story_chain()
+    r1b = _record(0, "", _outputs(pending=1))
+    _write_chain(d, run1 + [r1b])
+    # default unchanged: last run, previous-runs problem with the heads
+    meta, records, problems = load_journal(d)
+    assert [r["loop"] for r in records] == [0]
+    prev = [p for p in problems if p["kind"] == "previous-runs"]
+    assert len(prev) == 1
+    assert prev[0]["count"] == 1 and prev[0]["loops"] == 3
+    assert prev[0]["runs"][0]["head"] == run1[0]["digest"]
+    assert prev[0]["runs"][0]["firstLoop"] == 12
+    assert prev[0]["runs"][0]["lastLoop"] == 14
+    # run= selects the surfaced head; the OTHER run becomes the problem
+    meta1, records1, problems1 = load_journal(
+        d, run=run1[0]["digest"][:12])
+    assert [r["loop"] for r in records1] == [12, 13, 14]
+    prev1 = [p for p in problems1 if p["kind"] == "previous-runs"]
+    assert prev1 and prev1[0]["runs"][0]["head"] == r1b["digest"]
+    # unknown / ambiguous prefixes fail loudly
+    with pytest.raises(JournalError, match="no run with chain head"):
+        load_journal(d, run="ffffffff")
+    with pytest.raises(JournalError, match="ambiguous"):
+        load_journal(d, run="")
+
+
+# ---- EventSink history view + dedup≡counter pin (satellite) ------------
+
+def test_event_sink_history_and_dedup_counts_match_counter_deltas():
+    reg = Registry()
+    sink = EventSink(registry=reg, per_loop_quota=100)
+    sink.begin_loop()
+    sink.emit("NoScaleUp", "p-1", "taint", now=1.0)
+    sink.emit("NoScaleUp", "p-1", "taint", now=2.0)   # dedup → count 2
+    sink.emit("NoScaleUp", "p-1", "cpu", now=3.0)
+    sink.emit("NoScaleDown", "n-1", "BlockedByPod", now=4.0)
+    sink.end_loop()
+    # bounded per-object view, no ring scan
+    hist = sink.history("NoScaleUp", "p-1")
+    assert {(h["reason"], h["count"]) for h in hist} == \
+        {("taint", 2), ("cpu", 1)}
+    assert sink.history(None, "p-1") == hist
+    assert sink.history("NoScaleDown", "p-1") == []
+    # THE PIN: dedup-aggregated counts == scale_events_total deltas
+    ctr = reg.counter("scale_events_total")
+    for h in hist:
+        assert ctr.value(kind="NoScaleUp", reason=h["reason"]) == h["count"]
+    assert ctr.value(kind="NoScaleDown", reason="BlockedByPod") == 1
+
+
+def test_event_sink_history_pruned_with_ring_eviction():
+    sink = EventSink(capacity=2, per_loop_quota=100)
+    sink.begin_loop()
+    sink.emit("NoScaleUp", "a", "cpu", now=1.0)
+    sink.emit("NoScaleUp", "b", "cpu", now=2.0)
+    sink.emit("NoScaleUp", "c", "cpu", now=3.0)   # evicts a
+    assert sink.history("NoScaleUp", "a") == []
+    assert len(sink.history("NoScaleUp", "c")) == 1
+
+
+# ---- live run: cursor stitching + provenance pin -----------------------
+
+def _world_with_idle_node(n_nodes=6, pending=8):
+    fake = FakeCluster()
+    tmpl = build_test_node("tmpl", cpu_milli=8000, mem_mib=16384, pods=64)
+    fake.add_node_group("ng1", tmpl, min_size=0, max_size=100)
+    for i in range(n_nodes):
+        nd = build_test_node(f"n{i}", cpu_milli=8000, mem_mib=16384,
+                             pods=64)
+        fake.add_existing_node("ng1", nd)
+        if i > 0:       # n0 stays empty: the scale-down candidate/victim
+            fake.add_pod(build_test_pod(
+                f"r{i}", cpu_milli=5000, mem_mib=2048,
+                owner_name=f"rs{i % 3}", node_name=nd.name))
+    for i in range(pending):
+        fake.add_pod(build_test_pod(f"p{i}", cpu_milli=400, mem_mib=256,
+                                    owner_name="prs"))
+    return fake
+
+
+def _autoscaler(fake, holder, tmp_path, **kw):
+    base = dict(
+        shadow_audit=True,
+        shadow_audit_dir=str(tmp_path / "audit"),
+        shadow_audit_budget_ms=50.0,
+        journal_dir=str(tmp_path / "journal"),
+        flight_recorder_dir=str(tmp_path / "flight"),
+        loop_wallclock_budget_s=1e-9,      # every loop dumps the flight ring
+        node_shape_bucket=64, group_shape_bucket=16,
+        max_new_nodes_static=64, max_pods_per_node=16,
+        enable_dynamic_resource_allocation=False,
+        enable_csi_node_aware_scheduling=False,
+        scale_down_delay_after_add_s=0.0,
+        node_group_defaults=NodeGroupDefaults(
+            scale_down_unneeded_time_s=3600.0),
+    )
+    base.update(kw)
+    reg = Registry()
+    return StaticAutoscaler(
+        fake.provider, fake, options=AutoscalingOptions(**base),
+        registry=reg, eviction_sink=fake,
+        walltime=lambda: holder["now"]), reg
+
+
+def test_cursor_stitching_links_all_four_stores_to_one_loop(tmp_path):
+    """Satellite fixture: one run producing a journal + flight dump +
+    audit bundle + perfwatch triage bundle; the index links all four to
+    the same loop and `why` renders each pointer."""
+    fake = _world_with_idle_node()
+    holder = {"now": 1000.0}
+    a, reg = _autoscaler(fake, holder, tmp_path)
+    for k in range(2):
+        holder["now"] = 1000.0 + 10 * k
+        a.run_once(now=holder["now"])
+    faults.install([{"hook": "verdict_plane", "kind": "flip_bit",
+                     "times": 1}], seed=7)
+    holder["now"] = 1020.0
+    st = a.run_once(now=holder["now"])
+    assert st.audit_divergence and st.audit_bundle_path
+    div_loop, div_digest = a._journal_cursor
+    # a perfwatch triage bundle stamped with the SAME cursor (the shape
+    # perfwatch/triage.py persists)
+    triage = str(tmp_path / "journal" / "perf-sim_loop-default-1.json")
+    with open(triage, "w") as f:
+        json.dump({"kind": "perf-regression", "metric": "sim_loop_ms",
+                   "journalCursor": [div_loop, div_digest],
+                   "traceId": "t-triage"}, f)
+
+    idx = LineageIndex(str(tmp_path / "journal"))
+    row = idx.loops[div_loop]
+    kinds = {art["kind"] for art in row["artifacts"]}
+    assert {"audit-bundle", "flight-dump", "perf-triage"} <= kinds
+    paths = {art["kind"]: art["path"] for art in row["artifacts"]}
+    assert paths["audit-bundle"] == st.audit_bundle_path
+    assert paths["flight-dump"].endswith(".trace.json")
+    assert paths["perf-triage"] == triage
+    # `why` for an object active at the divergent loop renders each pointer
+    text = lq.render_why(idx.why("node", "n0"))
+    assert "audit-bundle" in text
+    assert "flight-dump" in text
+    assert "perf-triage" in text
+    # the derived ladder transition came from the bundle, not a re-replay
+    assert {"from": "healthy", "to": "suspect",
+            "cause": "audit_divergence"} == \
+        {k: v for k, v in idx.transitions[0].items() if k != "loop"}
+
+
+def test_provenance_pin_why_victim_full_chain_from_index_alone(tmp_path):
+    """Acceptance pin: forced persistent divergence → degraded; `why
+    node/<victim>` returns reason-bit history, the audit bundle path,
+    the flight dump, and the suspect→degraded transitions from the
+    index alone; reason_extraction_dispatches unchanged by the ring."""
+    fake = _world_with_idle_node()
+    holder = {"now": 1000.0}
+    a, reg = _autoscaler(fake, holder, tmp_path)
+    for k in range(2):
+        holder["now"] = 1000.0 + 10 * k
+        a.run_once(now=holder["now"])
+    faults.install([{"hook": "verdict_plane", "kind": "flip_bit",
+                     "times": 0}], seed=7)
+    holder["now"] = 1020.0
+    a.run_once(now=holder["now"])
+    assert a.supervisor.state == "suspect"
+    holder["now"] = 1030.0
+    a.run_once(now=holder["now"])
+    assert a.supervisor.state == "degraded"
+    # one loop INTO degraded: the withheld scale-down marks its would-be
+    # victims with the audit's own reason
+    holder["now"] = 1040.0
+    a.run_once(now=holder["now"])
+    disp = a.planner.phases.events.get("reason_extraction_dispatches", 0)
+
+    idx = LineageIndex(str(tmp_path / "journal"))
+    why = idx.why("node", "n0")
+    assert why["found"]
+    # reason-bit / verdict history: unneeded while healthy, then the
+    # degraded-mode withholding marks the would-be victim
+    events = [e["event"] for e in why["entries"]]
+    assert "unneeded" in events
+    assert any(e["event"] == "unremovable"
+               and "AuditDivergence" in str(e.get("reason"))
+               for e in why["entries"])
+    arts = {x["kind"] for x in why["artifacts"]}
+    assert "audit-bundle" in arts
+    assert "flight-dump" in arts
+    bundle = [x for x in why["artifacts"]
+              if x["kind"] == "audit-bundle"][0]
+    assert os.path.isfile(bundle["path"])
+    trans = {(t["from"], t["to"]) for t in why["transitions"]}
+    assert ("healthy", "suspect") in trans
+    assert ("suspect", "degraded") in trans
+    # the whole chain came from the index — no replay, no dispatches
+    # the live ring adds ZERO device work: an identical run with the
+    # ring disabled reports the same dispatch count
+    faults.clear()
+    fake2 = _world_with_idle_node()
+    holder2 = {"now": 1000.0}
+    a2, _ = _autoscaler(fake2, holder2, tmp_path / "off",
+                        lineage_ring=False)
+    for k in range(2):
+        holder2["now"] = 1000.0 + 10 * k
+        a2.run_once(now=holder2["now"])
+    faults.install([{"hook": "verdict_plane", "kind": "flip_bit",
+                     "times": 0}], seed=7)
+    for k in (2, 3, 4):
+        holder2["now"] = 1000.0 + 10 * k
+        a2.run_once(now=holder2["now"])
+    disp2 = a2.planner.phases.events.get("reason_extraction_dispatches", 0)
+    assert disp == disp2
+    assert a.lineage_ring is not None and a2.lineage_ring is None
+
+
+# ---- live surfaces: ring metrics, /whyz, /snapshotz --------------------
+
+def test_live_ring_serves_why_and_metrics(tmp_path):
+    fake = _world_with_idle_node()
+    holder = {"now": 1000.0}
+    a, reg = _autoscaler(fake, holder, tmp_path, shadow_audit=False,
+                         loop_wallclock_budget_s=0.0)
+    for k in range(3):
+        holder["now"] = 1000.0 + 10 * k
+        a.run_once(now=holder["now"])
+    ring = a.lineage_ring
+    why = ring.why("node", "n0", surface="whyz")
+    assert why["found"]
+    assert any(e["event"] == "unneeded" for e in why["entries"])
+    summary = ring.snapshot_summary()
+    assert summary["loops"] is not None
+    assert any(o["object"] == "node/n0" for o in summary["objects"])
+    # lineage_* families flow through the registry exposition
+    text = reg.expose_text()
+    assert "lineage_index_rows" in text
+    assert "lineage_overhead_seconds_total" in text
+    assert reg.counter("lineage_queries_total").value(surface="whyz") >= 1
+    # the ring rides /snapshotz via _feed_snapshot_observability
+    assert ring.entries > 0 and ring.bytes > 0
+
+
+def test_whyz_mux_handler_serves_ring(tmp_path):
+    import threading
+    from http.client import HTTPConnection
+    from http.server import ThreadingHTTPServer
+
+    from kubernetes_autoscaler_tpu.__main__ import make_mux
+
+    fake = _world_with_idle_node()
+    holder = {"now": 1000.0}
+    a, reg = _autoscaler(fake, holder, tmp_path, shadow_audit=False,
+                         loop_wallclock_budget_s=0.0)
+    a.run_once(now=1000.0)
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), make_mux(a, None))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        conn = HTTPConnection("127.0.0.1", srv.server_address[1],
+                              timeout=10)
+        conn.request("GET", "/whyz?object=node/n0")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200
+        assert body["object"] == "node/n0" and body["found"]
+        assert "segments" in body
+        conn.request("GET", "/whyz")
+        top = json.loads(conn.getresponse().read())
+        assert any(o["object"] == "node/n0" for o in top["objects"])
+    finally:
+        srv.shutdown()
+
+
+def test_lineage_families_documented_and_exposed(tmp_path):
+    """The lineage_* mapping exists (parity.LINEAGE_FAMILIES names every
+    absent reference surface -> our provenance family, mirrored in
+    PARITY.md "Decision lineage"), and the named families reach the
+    exposition once a live ring observes and serves a query."""
+    from pathlib import Path
+
+    from kubernetes_autoscaler_tpu.lineage.index import LineageRing
+    from kubernetes_autoscaler_tpu.metrics import parity
+
+    for ref, ours in parity.LINEAGE_FAMILIES.items():
+        assert ours and len(ours) > 20, ref
+    doc = " ".join(parity.LINEAGE_FAMILIES.values())
+    for fam in ("lineage_index_rows", "lineage_index_bytes",
+                "lineage_index_lag_loops", "lineage_queries_total",
+                "lineage_overhead_seconds_total"):
+        assert fam in doc, fam
+    parity_md = (Path(parity.__file__).parents[2] / "PARITY.md").read_text()
+    assert "## Decision lineage" in parity_md
+    assert "LINEAGE_FAMILIES" in parity_md
+    reg = Registry()
+    ring = LineageRing(registry=reg)
+    ring.observe(loop=0, digest="d0", now=1.0,
+                 outputs=_outputs(unneeded=["n0"]))
+    ring.why("node", "n0", surface="api")
+    text = reg.expose_text()
+    for fam in ("lineage_index_rows", "lineage_index_bytes",
+                "lineage_index_lag_loops", "lineage_queries_total",
+                "lineage_overhead_seconds_total"):
+        assert fam in text, fam
+
+
+# ---- sidecar Explain RPC ≡ TenantJournal ring (parity) -----------------
+
+def test_explain_rpc_row_for_row_parity_with_tenant_journal():
+    pytest.importorskip("grpc")
+    from kubernetes_autoscaler_tpu.sidecar import native_api
+    if not native_api.available():
+        pytest.skip("native codec not buildable")
+    from kubernetes_autoscaler_tpu.sidecar.server import (
+        SimulatorClient,
+        SimulatorService,
+        make_grpc_server,
+    )
+    from kubernetes_autoscaler_tpu.sidecar.wire import DeltaWriter
+
+    service = SimulatorService(node_bucket=16, group_bucket=16)
+    server, port = make_grpc_server(service, port=0)
+    server.start()
+    try:
+        client = SimulatorClient(port, tenant="acme")
+        w = DeltaWriter()
+        for i in range(2):
+            w.upsert_node(build_test_node(
+                f"n{i}", cpu_milli=2000, mem_mib=4096))
+        for i in range(4):
+            w.upsert_pod(build_test_pod(
+                f"p{i}", cpu_milli=400, mem_mib=256, owner_name="rs"))
+        client.apply_delta(w)
+        client.scale_up_sim(max_new_nodes=4)
+        out = client.explain()
+        assert out["found"] and out["tenant"] == "acme"
+        ts = service._tenant_peek("acme")
+        ring_rows = ts.journal.snapshot()
+        # THE PARITY PIN: row-for-row identical to the server-side ring
+        assert out["records"] == ring_rows
+        assert out["held"] == len(ring_rows) == out["returned"]
+        assert out["cursor"] == list(ts.journal.cursor())
+        # filters account for what they hide
+        lim = client.explain(limit=1)
+        assert lim["returned"] == 1 and lim["held"] == len(ring_rows)
+        assert lim["records"] == ring_rows[-1:]
+        # query accounting
+        assert service.registry.counter("lineage_queries_total").value(
+            surface="explain") == 2
+    finally:
+        server.stop(0)
